@@ -1,0 +1,83 @@
+"""Benchmark: points/sec/chip on the BASELINE.json scale-up config.
+
+Runs the 16-D make_blobs scale-up benchmark (BASELINE.json config 2,
+shrunk to what one chip holds comfortably) through the public DBSCAN API
+on the real device, times steady-state (post-compile), and prints ONE
+JSON line.  ``vs_baseline``: the reference publishes no numbers
+(BASELINE.md — ``published: {}``), so the comparison is against a
+single-node sklearn DBSCAN run on the same data/host, the reference's
+own per-partition engine and correctness oracle.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def _make_data(n, dim, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.uniform(-10, 10, size=(32, dim))
+    assign = rng.integers(0, 32, size=n)
+    return (centers[assign] + rng.normal(scale=0.4, size=(n, dim))).astype(
+        np.float32
+    )
+
+
+def main():
+    n = int(os.environ.get("BENCH_N", 200_000))
+    dim = int(os.environ.get("BENCH_DIM", 16))
+    # 16-D gaussian blobs with sigma=0.4: typical intra-cluster pair
+    # distance is ~sigma*sqrt(2*dim) ~ 2.26, so eps=2.4 recovers blobs.
+    eps, min_samples = 2.4, 10
+    X = _make_data(n, dim)
+
+    from pypardis_tpu import DBSCAN
+
+    import jax
+
+    n_chips = jax.device_count()
+
+    def run():
+        model = DBSCAN(eps=eps, min_samples=min_samples, block=2048)
+        labels = model.fit_predict(X)
+        return labels
+
+    run()  # compile warm-up
+    t0 = time.perf_counter()
+    labels = run()
+    dt = time.perf_counter() - t0
+    pts_per_sec_chip = n / dt / n_chips
+
+    # sklearn single-node baseline on the same data (subsampled if huge,
+    # scaled linearly — sklearn is the reference's compute engine).
+    from sklearn.cluster import DBSCAN as SKDBSCAN
+
+    sk_n = min(n, 50_000)
+    t0 = time.perf_counter()
+    SKDBSCAN(eps=eps, min_samples=min_samples).fit(X[:sk_n])
+    sk_dt = time.perf_counter() - t0
+    sk_pts_per_sec = sk_n / sk_dt
+
+    print(
+        json.dumps(
+            {
+                "metric": f"points_per_sec_per_chip_dbscan_{dim}d_{n}pts",
+                "value": round(pts_per_sec_chip, 1),
+                "unit": "points/sec/chip",
+                "vs_baseline": round(pts_per_sec_chip / sk_pts_per_sec, 3),
+            }
+        )
+    )
+    # Sanity line on stderr only — stdout stays a single JSON line.
+    print(
+        f"clusters={labels.max() + 1} noise={(labels == -1).sum()} "
+        f"t={dt:.2f}s sklearn@{sk_n}={sk_dt:.2f}s",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

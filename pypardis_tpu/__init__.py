@@ -1,0 +1,43 @@
+"""pypardis_tpu — TPU-native distributed density-based clustering.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of
+mathematiguy/pypardis ("pyParDis DBSCAN"): dimension-agnostic, distributed
+DBSCAN over datasets too large for one worker.  Where the reference
+(``/root/reference/dbscan``) distributes work with Spark RDDs and delegates
+math to sklearn, this package shards points over a ``jax.sharding.Mesh``,
+computes eps-neighborhoods with tiled MXU matmul kernels, and merges
+cluster labels with XLA collectives — no driver round-trips in the hot
+path.
+
+Public surface mirrors the reference package (``dbscan/__init__.py:3-21``):
+``DBSCAN``, ``KDPartitioner``, ``BoundingBox``, ``ClusterAggregator``, the
+three split strategies, plus the TPU-native extensions under ``ops`` /
+``parallel``.
+"""
+
+__version__ = (0, 1, 0)
+__version_str__ = ".".join(map(str, __version__))
+
+from .geometry import BoundingBox
+from .aggregator import ClusterAggregator, default_value
+from .partition import (
+    KDPartitioner,
+    median_search_split,
+    mean_var_split,
+    min_var_split,
+)
+from .dbscan import DBSCAN, dbscan_partition, map_cluster_id
+
+__all__ = [
+    "BoundingBox",
+    "ClusterAggregator",
+    "default_value",
+    "KDPartitioner",
+    "median_search_split",
+    "mean_var_split",
+    "min_var_split",
+    "DBSCAN",
+    "dbscan_partition",
+    "map_cluster_id",
+    "__version__",
+]

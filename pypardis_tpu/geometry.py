@@ -1,0 +1,160 @@
+"""Axis-aligned bounding-box algebra.
+
+TPU-native re-design of the reference geometry layer
+(``/root/reference/dbscan/geometry.py:5-100``).  Two deliberate departures
+from the reference:
+
+* ``all_space`` / empty boxes use ±inf, fixing the reference's sign bug
+  where ``sys.float_info.min`` (smallest *positive* float, geometry.py:25)
+  excluded every negative coordinate from "all space".
+* In addition to the scalar ``BoundingBox`` object (API parity), a
+  vectorized :class:`BoxStack` holds many boxes as ``(P, k)`` arrays so
+  containment of N points in P boxes is one broadcasted comparison — the
+  shape XLA wants, instead of the reference's per-box Python ``filter``
+  closures (dbscan.py:146-147).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class BoundingBox:
+    """An axis-aligned box in k dimensions.
+
+    Semantics match ``dbscan/geometry.py``: inclusive ``contains``
+    (geometry.py:89-96), ``split`` children share the boundary plane
+    (geometry.py:56-71), ``expand`` is additive or proportional
+    (geometry.py:73-87).
+    """
+
+    __slots__ = ("lower", "upper")
+
+    def __init__(self, lower=None, upper=None, k=None, all_space=False):
+        if lower is not None:
+            self.lower = np.asarray(lower, dtype=np.float64)
+            self.upper = (
+                np.asarray(upper, dtype=np.float64)
+                if upper is not None
+                else self.lower.copy()
+            )
+        elif k is not None:
+            if all_space:
+                self.lower = np.full(k, -np.inf)
+                self.upper = np.full(k, np.inf)
+            else:
+                # Empty box: union with anything yields the other operand.
+                self.lower = np.full(k, np.inf)
+                self.upper = np.full(k, -np.inf)
+        else:
+            self.lower = None
+            self.upper = None
+
+    @property
+    def k(self) -> int:
+        return len(self.lower)
+
+    def intersection(self, other: "BoundingBox") -> "BoundingBox":
+        return BoundingBox(
+            lower=np.maximum(self.lower, other.lower),
+            upper=np.minimum(self.upper, other.upper),
+        )
+
+    def union(self, other: "BoundingBox") -> "BoundingBox":
+        return BoundingBox(
+            lower=np.minimum(self.lower, other.lower),
+            upper=np.maximum(self.upper, other.upper),
+        )
+
+    def split(self, dim: int, value: float):
+        """Split along ``dim`` at ``value`` → (left, right).
+
+        Both children include the split plane (geometry.py:56-71); point
+        assignment disambiguates with a strict ``<`` on the left side.
+        """
+        left = BoundingBox(lower=self.lower.copy(), upper=self.upper.copy())
+        left.upper[dim] = value
+        right = BoundingBox(lower=self.lower.copy(), upper=self.upper.copy())
+        right.lower[dim] = value
+        return left, right
+
+    def expand(self, eps=0, how: str = "add") -> "BoundingBox":
+        if how == "add":
+            return BoundingBox(self.lower - eps, self.upper + eps)
+        elif how == "multiply":
+            span = self.upper - self.lower
+            return BoundingBox(self.lower - eps * span, self.upper + eps * span)
+        raise ValueError(f"how must be 'add' or 'multiply', got {how!r}")
+
+    def contains(self, vector) -> bool:
+        vector = np.asarray(vector)
+        return bool(
+            np.all(self.lower <= vector) and np.all(self.upper >= vector)
+        )
+
+    def contains_points(self, points: np.ndarray) -> np.ndarray:
+        """Vectorized containment: (N, k) points → (N,) bool mask."""
+        points = np.asarray(points)
+        return np.all(
+            (points >= self.lower) & (points <= self.upper), axis=-1
+        )
+
+    def volume(self) -> float:
+        return float(np.prod(np.maximum(self.upper - self.lower, 0.0)))
+
+    def __eq__(self, other):
+        if not isinstance(other, BoundingBox):
+            return NotImplemented
+        return np.array_equal(self.lower, other.lower) and np.array_equal(
+            self.upper, other.upper
+        )
+
+    def __repr__(self):
+        return f"BoundingBox(lower={self.lower}\n\tupper={self.upper})"
+
+
+class BoxStack:
+    """P bounding boxes stored as two (P, k) arrays.
+
+    The reference materializes each neighborhood with a per-box Python
+    closure over the whole dataset (dbscan.py:141-151).  On TPU the same
+    query — which of P expanded boxes contain each of N points — is a
+    single broadcasted comparison producing an (N, P) membership matrix.
+    """
+
+    __slots__ = ("lower", "upper")
+
+    def __init__(self, lower: np.ndarray, upper: np.ndarray):
+        self.lower = np.asarray(lower, dtype=np.float64)
+        self.upper = np.asarray(upper, dtype=np.float64)
+        assert self.lower.shape == self.upper.shape
+
+    @classmethod
+    def from_boxes(cls, boxes) -> "BoxStack":
+        boxes = list(boxes)
+        return cls(
+            np.stack([b.lower for b in boxes]),
+            np.stack([b.upper for b in boxes]),
+        )
+
+    def __len__(self) -> int:
+        return self.lower.shape[0]
+
+    @property
+    def k(self) -> int:
+        return self.lower.shape[1]
+
+    def __getitem__(self, i: int) -> BoundingBox:
+        return BoundingBox(lower=self.lower[i], upper=self.upper[i])
+
+    def expand(self, eps=0) -> "BoxStack":
+        return BoxStack(self.lower - eps, self.upper + eps)
+
+    def membership(self, points: np.ndarray) -> np.ndarray:
+        """(N, k) points → (N, P) bool: point n inside box p (inclusive)."""
+        points = np.asarray(points)
+        return np.all(
+            (points[:, None, :] >= self.lower[None, :, :])
+            & (points[:, None, :] <= self.upper[None, :, :]),
+            axis=-1,
+        )

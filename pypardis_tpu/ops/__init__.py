@@ -1,0 +1,25 @@
+"""Numeric kernels: tiled eps-neighborhood ops and label propagation.
+
+This subpackage replaces the reference's entire numeric hot loop — the
+``sklearn.cluster.DBSCAN`` call inside each Spark partition
+(``/root/reference/dbscan/dbscan.py:28-30``) — with TPU-native kernels:
+pairwise interactions stream through MXU-friendly tiles without ever
+materializing the N x N matrix, and DBSCAN's sequential region-query
+expansion becomes parallel connected components over the core-point graph
+(fixed-shape min-label propagation under ``lax.while_loop``).
+"""
+
+from .distances import (
+    neighbor_counts,
+    min_neighbor_label,
+    pairwise_sq_dists,
+)
+from .labels import dbscan_fixed_size, densify_labels
+
+__all__ = [
+    "neighbor_counts",
+    "min_neighbor_label",
+    "pairwise_sq_dists",
+    "dbscan_fixed_size",
+    "densify_labels",
+]

@@ -1,0 +1,122 @@
+"""Parallel DBSCAN labeling as core-graph connected components.
+
+Textbook DBSCAN (and sklearn's implementation, which the reference calls at
+``/root/reference/dbscan/dbscan.py:28-30``) expands clusters sequentially
+by region queries — unusable under XLA's static-trace model.  The parallel
+formulation: a point is *core* iff >= min_samples valid points lie within
+eps; clusters are the connected components of the graph on core points with
+edges at distance <= eps; border points attach to any adjacent core point;
+everything else is noise.
+
+Components are found by min-label propagation with pointer-jumping
+shortcuts (the FastSV/Shiloach-Vishkin family): each core point starts
+labeled with its own index, repeatedly takes the min label among its core
+eps-neighbors (one tiled N^2 pass on the MXU), then chases labels
+transitively (cheap gathers) until a fixpoint.  Everything is
+fixed-shape: `lax.while_loop` over a bounded iteration count.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .distances import min_neighbor_label, neighbor_counts
+
+_INT_INF = jnp.iinfo(jnp.int32).max
+
+
+def _pointer_jump(f: jnp.ndarray, active: jnp.ndarray) -> jnp.ndarray:
+    """Chase f -> f[f] to a fixpoint (path shortcutting).
+
+    ``f`` holds point indices for ``active`` points and INT32_MAX
+    elsewhere; jumps only read entries belonging to active points, whose
+    values are always valid indices.
+    """
+
+    def cond(state):
+        f, changed = state
+        return changed
+
+    def body(state):
+        f, _ = state
+        tgt = jnp.clip(f, 0, f.shape[0] - 1)
+        nxt = jnp.where(active, f[tgt], f)
+        return nxt, jnp.any(nxt != f)
+
+    f, _ = jax.lax.while_loop(cond, body, (f, jnp.bool_(True)))
+    return f
+
+
+@functools.partial(
+    jax.jit, static_argnames=("metric", "block", "max_rounds")
+)
+def dbscan_fixed_size(
+    points: jnp.ndarray,
+    eps: float,
+    min_samples: int,
+    mask: jnp.ndarray,
+    metric: str = "euclidean",
+    block: int = 1024,
+    max_rounds: int = 64,
+):
+    """DBSCAN over a fixed-capacity padded point set.
+
+    ``points``: (N, d), N a multiple of ``block``; ``mask``: (N,) bool
+    validity.  Returns ``(labels, core)``:
+
+    * ``labels``: (N,) int32 — the *root point index* of the point's
+      cluster (min index over the component's core points), or -1 for
+      noise/invalid.  Dense 0..C-1 ids are a host-side afterthought
+      (:func:`densify_labels`); keeping roots on device makes labels
+      globally meaningful across shards.
+    * ``core``: (N,) bool — the eps/min_samples core test, matching
+      sklearn's ``core_sample_indices_`` that the reference reads at
+      dbscan.py:30.
+    """
+    n = points.shape[0]
+    counts = neighbor_counts(points, eps, mask, metric=metric, block=block)
+    core = (counts >= min_samples) & mask
+
+    idx = jnp.arange(n, dtype=jnp.int32)
+    f0 = jnp.where(core, idx, _INT_INF)
+
+    def cond(state):
+        f, changed, rounds = state
+        return changed & (rounds < max_rounds)
+
+    def body(state):
+        f, _, rounds = state
+        # Hook: min label among core eps-neighbors (self included).
+        g = min_neighbor_label(points, f, eps, core, metric=metric, block=block)
+        f_new = jnp.where(core, jnp.minimum(f, g), f)
+        # Shortcut: chase pointers to the current root.
+        f_new = _pointer_jump(f_new, core)
+        return f_new, jnp.any(f_new != f), rounds + 1
+
+    f, _, _ = jax.lax.while_loop(cond, body, (f0, jnp.bool_(True), 0))
+
+    # Border points: nearest-core-label attach; noise: no core neighbor.
+    border = min_neighbor_label(points, f, eps, core, metric=metric, block=block)
+    labels = jnp.where(
+        core, f, jnp.where(mask & (border != _INT_INF), border, -1)
+    ).astype(jnp.int32)
+    return labels, core
+
+
+def densify_labels(root_labels: np.ndarray) -> np.ndarray:
+    """Host-side: map root-index labels to dense 0..C-1 ids, noise -> -1.
+
+    Clusters are numbered by ascending root index, so ids are
+    deterministic — the analogue of the reference's driver-side global-id
+    assignment (aggregator.py:46-48) without the driver bottleneck.
+    """
+    root_labels = np.asarray(root_labels)
+    out = np.full(root_labels.shape, -1, dtype=np.int32)
+    valid = root_labels >= 0
+    uniq, inv = np.unique(root_labels[valid], return_inverse=True)
+    out[valid] = inv.astype(np.int32)
+    return out

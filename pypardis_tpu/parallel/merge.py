@@ -1,0 +1,83 @@
+"""Host-side label-merge utilities.
+
+The primary merge is in-graph (``sharded.sharded_step``): scatter-min
+propagation + ``pmin`` collectives, replicated over the mesh.  That path
+carries O(N) int32 arrays per device; for point counts where N-sized
+replicated arrays stop fitting alongside the data, the merge can instead
+run on host over *compact occurrence tables* — this module is that path,
+and the pure-Python reference implementation the native (C++) resolver
+is tested against.
+
+Semantics are identical to the reference's ``ClusterAggregator``
+(aggregator.py:38-63): only points that are core in their home partition
+link clusters; merged clusters take the minimum id.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+from ..aggregator import UnionFind
+
+
+def resolve_label_edges(edges: np.ndarray, ids: np.ndarray) -> Dict[int, int]:
+    """Union a (E, 2) table of label-equivalence edges.
+
+    ``ids``: the universe of label ids in play (1-D).  Returns
+    {label id -> canonical (minimum) label id of its component}.
+    """
+    ids = np.asarray(ids)
+    edges = np.asarray(edges).reshape(-1, 2)
+    index = {int(v): i for i, v in enumerate(ids)}
+    uf = UnionFind(len(ids))
+    for a, b in edges:
+        uf.union(index[int(a)], index[int(b)])
+    roots = uf.roots()
+    return {int(v): int(ids[roots[i]]) for i, v in enumerate(ids)}
+
+
+def merge_occurrences(
+    home_label: np.ndarray,
+    core: np.ndarray,
+    occ_gid: np.ndarray,
+    occ_label: np.ndarray,
+) -> Tuple[np.ndarray, Dict[int, int]]:
+    """Merge per-partition labels from halo-duplicate occurrence tables.
+
+    ``home_label``: (N,) each point's label from its home partition
+    (root gid, -1 noise).  ``core``: (N,) home-run core flags.
+    ``occ_gid``/``occ_label``: flattened halo occurrences — point gid and
+    the label that point received in a *foreign* partition run.
+
+    Implements the reference merge rule (aggregator.py:38-40): an
+    occurrence links its label to the point's home label only if the
+    point is core at home and labeled non-noise in the foreign run.
+    Returns (final_labels, mapping).
+    """
+    home_label = np.asarray(home_label)
+    core = np.asarray(core, dtype=bool)
+    occ_gid = np.asarray(occ_gid).reshape(-1)
+    occ_label = np.asarray(occ_label).reshape(-1)
+
+    link = (
+        (occ_gid >= 0)
+        & (occ_gid < len(home_label))
+        & (occ_label >= 0)
+    )
+    link &= core[np.clip(occ_gid, 0, len(home_label) - 1)]
+    a = home_label[occ_gid[link]]
+    b = occ_label[link]
+    keep = a >= 0
+    edges = np.stack([a[keep], b[keep]], axis=1)
+
+    ids = np.unique(
+        np.concatenate([home_label[home_label >= 0], edges.reshape(-1)])
+    )
+    mapping = resolve_label_edges(edges, ids)
+    lut = np.full(int(ids.max()) + 2 if len(ids) else 1, -1, np.int64)
+    for k, v in mapping.items():
+        lut[k] = v
+    final = np.where(home_label >= 0, lut[np.clip(home_label, 0, None)], -1)
+    return final.astype(np.int32), mapping

@@ -1,0 +1,19 @@
+"""Mesh construction helpers."""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh
+
+
+def default_mesh(n_devices: int | None = None, axis_name: str = "p") -> Mesh:
+    """A 1-D mesh over the first ``n_devices`` visible devices.
+
+    Spatial data parallelism with halo overlap — the reference's one
+    distribution strategy (SURVEY §2) — needs a single mesh axis; the
+    KD-partition → device mapping rides on it.
+    """
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(devices, (axis_name,))

@@ -1,0 +1,314 @@
+"""Sharded DBSCAN: per-shard clustering + in-graph global label merge.
+
+Replaces the reference pipeline stages 2-5 (SURVEY §3.1; reference
+``dbscan/dbscan.py:114-165``):
+
+* neighborhood duplication (dbscan.py:136-151) → fixed-capacity halo
+  slabs per KD partition, built host-side from one vectorized box
+  membership query;
+* ``partitionBy`` shuffle (dbscan.py:116-118) → arrays whose leading
+  (partition) axis is sharded over the device mesh;
+* per-partition sklearn DBSCAN (dbscan.py:12-34) → the tiled
+  min-propagation kernel (:mod:`pypardis_tpu.ops`), vmapped over each
+  device's partitions;
+* driver-side ``ClusterAggregator`` merge + broadcast (dbscan.py:158-161,
+  the README.md:60 driver-memory bottleneck) → scatter-min label
+  propagation over a bipartite point<->cluster graph, combined across the
+  mesh with ``pmin`` — merge happens on device, inside the same jit.
+
+Merge semantics match the reference's rules: only points that are core
+in their *home* partition link clusters (aggregator.py:38-40 — non-core
+border points must not cause merges), and merged clusters take the
+minimum id (aggregator.py:45 — here, the minimum root point id).
+
+Why the 2*eps halo makes home-run results exact (reference README.md:20):
+every point within eps of a partition's box has its full eps-ball inside
+the box expanded by 2*eps, so owned points' core status, cluster
+connectivity, and border attachment are all decided correctly in the
+home run; cross-partition links are recovered from halo duplicates that
+are core somewhere.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..geometry import BoxStack
+from ..ops.labels import dbscan_fixed_size
+from ..utils import clamp_block, round_up
+
+_INT_INF = jnp.iinfo(jnp.int32).max
+
+
+def build_shards(points, partitioner, eps, n_shards, block):
+    """Lay out points as (P, cap, k) owned slabs + (P, hcap, k) halo slabs.
+
+    ``P`` is the partition count rounded up to a multiple of the mesh
+    size (empty partitions are fully masked).  The halo of partition p
+    is every point inside its box expanded by 2*eps but not owned by p —
+    the reference's duplication semantics (dbscan.py:141-151) without a
+    shuffle.  Global point ids ride along so labels are meaningful
+    across shards; padded slots carry gid == N (a dump row in the
+    scatter arrays).
+    """
+    points = np.asarray(points, dtype=np.float64)
+    n, k = points.shape
+    center = points.mean(axis=0)
+    pts32 = (points - center).astype(np.float32)
+
+    labels = sorted(partitioner.partitions)
+    p_real = len(labels)
+    p_total = round_up(max(p_real, n_shards), n_shards)
+
+    stack = BoxStack.from_boxes(partitioner.bounding_boxes[l] for l in labels)
+    # membership of every point in every expanded box: (N, P_real)
+    member = stack.expand(2 * eps).membership(points)
+    owned_idx = [partitioner.partitions[l] for l in labels]
+    halo_idx = []
+    for j, idx in enumerate(owned_idx):
+        m = member[:, j].copy()
+        m[idx] = False
+        halo_idx.append(np.nonzero(m)[0])
+
+    cap = round_up(max(len(i) for i in owned_idx), block)
+    hcap = round_up(max(max((len(h) for h in halo_idx), default=1), 1), block)
+
+    owned = np.zeros((p_total, cap, k), np.float32)
+    owned_mask = np.zeros((p_total, cap), bool)
+    owned_gid = np.full((p_total, cap), n, np.int32)
+    halo = np.zeros((p_total, hcap, k), np.float32)
+    halo_mask = np.zeros((p_total, hcap), bool)
+    halo_gid = np.full((p_total, hcap), n, np.int32)
+    for j in range(p_real):
+        oi, hi = owned_idx[j], halo_idx[j]
+        owned[j, : len(oi)] = pts32[oi]
+        owned_mask[j, : len(oi)] = True
+        owned_gid[j, : len(oi)] = oi
+        halo[j, : len(hi)] = pts32[hi]
+        halo_mask[j, : len(hi)] = True
+        halo_gid[j, : len(hi)] = hi
+
+    stats = {
+        "halo_factor": float(sum(len(h) for h in halo_idx)) / max(n, 1),
+        "owned_cap": cap,
+        "halo_cap": hcap,
+        "n_shard_partitions": p_total,
+        "pad_waste": float(p_total * cap) / max(n, 1) - 1.0,
+    }
+    return (owned, owned_mask, owned_gid, halo, halo_mask, halo_gid), stats
+
+
+# ---------------------------------------------------------------------------
+# the jitted sharded step
+# ---------------------------------------------------------------------------
+
+
+def _merge_loop(lab_map, home_label, core_g, h_gid, h_lab, h_core, axis,
+                max_rounds):
+    """Min-label propagation over the bipartite point<->cluster graph.
+
+    ``lab_map``: (N+1,) replicated — cluster key (root gid) -> current
+    canonical label.  ``home_label``/``core_g``: (N+1,) replicated.
+    ``h_gid``/``h_lab``: this device's halo occurrences (flattened).
+    Per round: points take the min canonical label over all their
+    occurrences (home vectorized + halo scatter-min, pmin across mesh),
+    clusters take the min over their member points, then pointer-jump.
+    """
+    n1 = lab_map.shape[0]
+
+    def lookup(lm, lab):
+        safe = jnp.clip(lab, 0, n1 - 1)
+        return jnp.where(lab >= 0, lm[safe], _INT_INF)
+
+    def body(state):
+        lab_map, _, rounds = state
+        # point_min[g]: min canonical label over g's occurrences (core only)
+        pm_home = jnp.where(
+            core_g, lookup(lab_map, home_label), _INT_INF
+        )
+        halo_vals = jnp.where(h_core, lookup(lab_map, h_lab), _INT_INF)
+        pm_halo = (
+            jnp.full((n1,), _INT_INF, jnp.int32).at[h_gid].min(halo_vals)
+        )
+        pm_halo = jax.lax.pmin(pm_halo, axis)
+        pm = jnp.minimum(pm_home, pm_halo)
+
+        # cluster_min[l]: min point_min over member occurrences
+        new_map = lab_map
+        home_tgt = jnp.where(core_g, home_label, n1 - 1)
+        new_map = new_map.at[jnp.clip(home_tgt, 0, n1 - 1)].min(
+            jnp.where(core_g & (home_label >= 0), pm, _INT_INF)
+        )
+        halo_tgt = jnp.where(h_core & (h_lab >= 0), h_lab, n1 - 1)
+        local = jnp.full((n1,), _INT_INF, jnp.int32).at[halo_tgt].min(
+            jnp.where(h_core & (h_lab >= 0), pm[h_gid], _INT_INF)
+        )
+        new_map = jnp.minimum(new_map, jax.lax.pmin(local, axis))
+
+        # pointer jump: chase canonical labels to a fixpoint
+        def jump_body(st):
+            m, _ = st
+            nxt = jnp.where(
+                m != _INT_INF, m[jnp.clip(m, 0, n1 - 1)], m
+            )
+            return nxt, jnp.any(nxt != m)
+
+        new_map, _ = jax.lax.while_loop(
+            lambda st: st[1], jump_body, (new_map, jnp.bool_(True))
+        )
+        return new_map, jnp.any(new_map != lab_map), rounds + 1
+
+    lab_map, _, _ = jax.lax.while_loop(
+        lambda st: st[1] & (st[2] < max_rounds),
+        body,
+        (lab_map, jnp.bool_(True), 0),
+    )
+    return lab_map
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "eps", "min_samples", "metric", "block", "mesh", "axis", "n_points",
+    ),
+)
+def sharded_step(
+    owned, owned_mask, owned_gid, halo, halo_mask, halo_gid,
+    *, eps, min_samples, metric, block, mesh, axis, n_points,
+):
+    """One fully-sharded clustering step: local DBSCAN + global merge.
+
+    All inputs have leading (partition) axis sharded over ``mesh``;
+    outputs are replicated (N,) final labels and core flags.  This is
+    the whole distributed hot path in one compiled program.
+    """
+    n1 = n_points + 1
+
+    def per_device(o, om, og, h, hm, hg):
+        # o: (L, cap, k) — this device's partitions.
+        pts = jnp.concatenate([o, h], axis=1)
+        msk = jnp.concatenate([om, hm], axis=1)
+        gid = jnp.concatenate([og, hg], axis=1)
+
+        def one_part(p, m):
+            return dbscan_fixed_size(
+                p, eps, min_samples, m, metric=metric, block=block
+            )
+        labels, core = jax.vmap(one_part)(pts, msk)
+        # local root index -> global cluster key (root point gid)
+        glabel = jnp.where(
+            labels >= 0,
+            jnp.take_along_axis(gid, jnp.clip(labels, 0, None), axis=1),
+            -1,
+        ).astype(jnp.int32)
+
+        l_cap = o.shape[1]
+        own_glab, halo_glab = glabel[:, :l_cap], glabel[:, l_cap:]
+        # Only home-run core status feeds the merge (aggregator.py:38-40
+        # semantics); halo-run core flags are intentionally unused.
+        own_core = core[:, :l_cap]
+
+        # Replicated (N+1,) per-point facts from owned slots (each gid is
+        # owned by exactly one shard; padded slots hit the dump row n1-1).
+        og_flat = og.reshape(-1)
+        home_label = (
+            jnp.full((n1,), -1, jnp.int32)
+            .at[og_flat]
+            .max(own_glab.reshape(-1))
+        )
+        home_label = jax.lax.pmax(home_label, axis)
+        core_g = (
+            jnp.zeros((n1,), jnp.bool_)
+            .at[og_flat]
+            .max(own_core.reshape(-1))
+        )
+        core_g = jax.lax.pmax(core_g, axis)
+        home_label = home_label.at[n1 - 1].set(-1)
+        core_g = core_g.at[n1 - 1].set(False)
+
+        # Halo occurrence tables for the merge (this device's shards).
+        h_gid = hg.reshape(-1)
+        h_lab = halo_glab.reshape(-1)
+        h_core = core_g[jnp.clip(h_gid, 0, n1 - 1)] & (h_gid < n_points)
+
+        # lab_map over cluster keys starts as the identity; propagation
+        # only ever reads entries at live label values.
+        lab_map = jnp.arange(n1, dtype=jnp.int32)
+
+        lab_map = _merge_loop(
+            lab_map, home_label, core_g, h_gid, h_lab, h_core, axis,
+            max_rounds=32,
+        )
+
+        final = jnp.where(
+            home_label >= 0,
+            lab_map[jnp.clip(home_label, 0, n1 - 1)],
+            -1,
+        )
+        final = jnp.where(final == _INT_INF, -1, final)
+        return final[:n_points], core_g[:n_points]
+
+    spec = P("p", None, None)
+    spec2 = P("p", None)
+    return jax.shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(spec, spec2, spec2, spec, spec2, spec2),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )(owned, owned_mask, owned_gid, halo, halo_mask, halo_gid)
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+
+def sharded_dbscan(
+    points,
+    partitioner,
+    eps: float,
+    min_samples: int,
+    metric="euclidean",
+    block: int = 1024,
+    mesh: Optional[Mesh] = None,
+):
+    """Cluster ``points`` over the device mesh.
+
+    Returns ``(labels, core, stats)`` where labels are global root-gid
+    labels (-1 noise) for the original point order.
+    """
+    from ..ops.distances import _norm_metric
+    from .mesh import default_mesh
+
+    metric = _norm_metric(metric)
+    if mesh is None:
+        mesh = default_mesh()
+    n_shards = mesh.devices.size
+    axis = mesh.axis_names[0]
+
+    # Size tile blocks to the data: tiny problems shouldn't pay for
+    # 1024-wide padding, big ones keep the MXU-friendly width.
+    approx = max(len(p) for p in partitioner.partitions.values())
+    block = clamp_block(block, approx)
+
+    arrays, stats = build_shards(points, partitioner, eps, n_shards, block)
+    sharding = NamedSharding(mesh, P(axis))
+    arrays = tuple(jax.device_put(a, sharding) for a in arrays)
+    labels, core = sharded_step(
+        *arrays,
+        eps=float(eps),
+        min_samples=int(min_samples),
+        metric=metric,
+        block=block,
+        mesh=mesh,
+        axis=axis,
+        n_points=len(points),
+    )
+    return np.asarray(labels), np.asarray(core), stats

@@ -1,0 +1,5 @@
+"""Shared utilities: shape arithmetic, metrics, checkpointing."""
+
+from .shaping import clamp_block, round_up
+
+__all__ = ["round_up", "clamp_block"]

@@ -1,0 +1,23 @@
+"""Static-shape arithmetic shared by the host-side drivers.
+
+XLA compiles one program per shape, so every capacity in the framework is
+rounded to a tile-block multiple; these helpers are the single home for
+that arithmetic.
+"""
+
+from __future__ import annotations
+
+
+def round_up(x: int, multiple: int) -> int:
+    """Smallest multiple of ``multiple`` >= x."""
+    return -(-x // multiple) * multiple
+
+
+def clamp_block(block: int, n: int, floor: int = 128) -> int:
+    """Shrink a tile block for small problems, keep MXU width for big ones.
+
+    Returns a power-of-two-ish block <= ``block`` that is no wider than
+    the problem needs (next power of two above ``n``) and no narrower
+    than ``floor`` (a full lane tile).
+    """
+    return min(block, max(floor, 1 << (max(n, 1) - 1).bit_length()))

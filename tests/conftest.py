@@ -1,0 +1,48 @@
+"""Test harness: fake an 8-device mesh on CPU.
+
+The reference tested distribution implicitly via Spark local mode (SURVEY
+§4); the TPU equivalent is XLA's host-platform device splitting, so
+shard_map halo exchange and label-merge collectives run in CI without
+TPU hardware.
+
+Note: this image's sitecustomize pre-imports jax and pins
+``JAX_PLATFORMS=axon``, so env vars are too late — we must override via
+``jax.config`` before any backend initialization.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _assert_eight_devices():
+    assert jax.device_count() == 8, jax.devices()
+
+
+@pytest.fixture
+def blobs750():
+    """The reference's de-facto correctness baseline: the sklearn
+    plot_dbscan demo setup (make_blobs 2-D, 750 pts, eps=0.3,
+    min_samples=10) — README.md:42, plots/*/clusters.png."""
+    from sklearn.datasets import make_blobs
+    from sklearn.preprocessing import StandardScaler
+
+    centers = [[1, 1], [-1, -1], [1, -1]]
+    X, _ = make_blobs(
+        n_samples=750, centers=centers, cluster_std=0.4, random_state=0
+    )
+    return StandardScaler().fit_transform(X).astype(np.float64)

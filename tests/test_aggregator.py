@@ -1,0 +1,67 @@
+"""ClusterAggregator merge cases vs reference aggregator.py:38-63."""
+
+import numpy as np
+
+from pypardis_tpu.aggregator import ClusterAggregator, UnionFind, default_value
+
+
+def test_default_value_is_max():
+    import sys
+
+    assert default_value() == sys.maxsize
+
+
+def test_new_cluster_created():
+    agg = ClusterAggregator()
+    agg + (0, ["0:0"])
+    assert agg.fwd["0:0"] == 0
+    assert agg.next_global_id == 1
+
+
+def test_noise_and_noncore_skipped():
+    agg = ClusterAggregator()
+    agg + (0, ["0:-1"])
+    agg + (1, ["1:2*"])
+    assert len(agg.rev) == 0
+    assert agg.next_global_id == 0
+
+
+def test_min_id_merge():
+    agg = ClusterAggregator()
+    agg + (0, ["0:0"])   # global 0
+    agg + (1, ["1:0"])   # global 1
+    agg + (2, ["0:0", "1:0"])  # merges 1 into 0
+    assert agg.fwd["0:0"] == 0 and agg.fwd["1:0"] == 0
+    assert 1 not in agg.rev
+
+
+def test_transitive_three_way_merge():
+    agg = ClusterAggregator()
+    agg + (0, ["a"])
+    agg + (1, ["b"])
+    agg + (2, ["c"])
+    agg + (3, ["a", "b"])
+    agg + (4, ["b", "c"])
+    assert agg.fwd["a"] == agg.fwd["b"] == agg.fwd["c"] == 0
+    assert set(agg.rev.keys()) == {0}
+
+
+def test_combine_two_aggregators():
+    a = ClusterAggregator()
+    a + (0, ["a"])
+    a + (1, ["b"])
+    b = ClusterAggregator()
+    b + (0, ["b", "c"])
+    a + b
+    assert a.fwd["b"] == a.fwd["c"]
+
+
+def test_union_find_min_id_roots():
+    uf = UnionFind(6)
+    uf.union(4, 2)
+    uf.union(2, 0)
+    uf.union(5, 3)
+    roots = uf.roots()
+    assert roots[0] == roots[2] == roots[4] == 0
+    assert roots[3] == roots[5] == 3
+    assert roots[1] == 1

@@ -1,0 +1,67 @@
+"""User-facing DBSCAN API surface (reference dbscan.py:56-165 parity)."""
+
+import numpy as np
+from sklearn.cluster import DBSCAN as SKDBSCAN
+from sklearn.metrics import adjusted_rand_score
+
+from pypardis_tpu import DBSCAN
+
+
+def test_fit_predict_blobs(blobs750):
+    model = DBSCAN(eps=0.3, min_samples=10)
+    labels = model.fit_predict(blobs750)
+    sk = SKDBSCAN(eps=0.3, min_samples=10).fit(blobs750)
+    assert adjusted_rand_score(sk.labels_, labels) >= 0.99
+    np.testing.assert_array_equal(labels == -1, sk.labels_ == -1)
+
+
+def test_train_with_keyed_records(blobs750):
+    # Reference input contract: RDD of (key, vector) pairs (dbscan.py:107).
+    records = [(f"pt{i}", v) for i, v in enumerate(blobs750)]
+    model = DBSCAN(eps=0.3, min_samples=10)
+    model.train(records)
+    result = model.assignments()
+    assert len(result) == len(blobs750)
+    keys = [k for k, _ in result]
+    assert keys[0] == "pt0"
+    labels = np.array([l for _, l in result])
+    assert (labels >= -1).all() and labels.max() >= 0
+
+
+def test_attribute_surface(blobs750):
+    model = DBSCAN(eps=0.3, min_samples=10)
+    model.fit(blobs750)
+    assert model.bounding_boxes is not None
+    assert model.expanded_boxes is not None
+    assert model.result is not None
+    assert model.labels_ is not None
+    assert model.core_sample_mask_ is not None
+    assert model.metrics_["points_per_sec"] > 0
+    # expanded boxes are the 2*eps inflation (dbscan.py:144)
+    for l, box in model.bounding_boxes.items():
+        np.testing.assert_allclose(
+            model.expanded_boxes[l].lower, box.lower - 2 * 0.3
+        )
+
+
+def test_dbscan_partition_wire_format(blobs750):
+    from pypardis_tpu import dbscan_partition
+
+    records = [((i, 7), v) for i, v in enumerate(blobs750[:100])]
+    out = list(
+        dbscan_partition(records, {"eps": 0.3, "min_samples": 5})
+    )
+    assert len(out) == 100
+    for key, label in out:
+        part, rest = label.split(":")
+        assert part == "7"
+        int(rest.rstrip("*"))  # parses
+
+
+def test_map_cluster_id():
+    from pypardis_tpu import map_cluster_id
+
+    mapping = {"0:1": 5}
+    assert map_cluster_id((3, ["0:1*"]), mapping) == (3, 5)
+    assert map_cluster_id((4, ["0:-1"]), mapping) == (4, -1)
+    assert map_cluster_id((5, ["9:9"]), mapping) == (5, -1)

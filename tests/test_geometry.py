@@ -1,0 +1,69 @@
+"""BoundingBox algebra vs reference semantics (geometry.py:34-96)."""
+
+import numpy as np
+
+from pypardis_tpu.geometry import BoundingBox, BoxStack
+
+
+def test_intersection_union():
+    a = BoundingBox([0, 0], [2, 2])
+    b = BoundingBox([1, -1], [3, 1])
+    i = a.intersection(b)
+    np.testing.assert_array_equal(i.lower, [1, 0])
+    np.testing.assert_array_equal(i.upper, [2, 1])
+    u = a.union(b)
+    np.testing.assert_array_equal(u.lower, [0, -1])
+    np.testing.assert_array_equal(u.upper, [3, 2])
+
+
+def test_all_space_contains_negatives():
+    # Fixes the reference's sys.float_info.min sign bug (geometry.py:25).
+    box = BoundingBox(k=3, all_space=True)
+    assert box.contains([-1e300, 0.0, 1e300])
+
+
+def test_empty_box_union_identity():
+    empty = BoundingBox(k=2)
+    b = BoundingBox([1, 2], [3, 4])
+    u = empty.union(b)
+    np.testing.assert_array_equal(u.lower, b.lower)
+    np.testing.assert_array_equal(u.upper, b.upper)
+
+
+def test_split_shares_plane():
+    box = BoundingBox([0, 0], [4, 4])
+    left, right = box.split(0, 1.5)
+    assert left.upper[0] == 1.5 and right.lower[0] == 1.5
+    # both children contain the plane (inclusive semantics)
+    assert left.contains([1.5, 2]) and right.contains([1.5, 2])
+
+
+def test_expand_add_multiply():
+    box = BoundingBox([0, 0], [2, 4])
+    e = box.expand(0.5)
+    np.testing.assert_array_equal(e.lower, [-0.5, -0.5])
+    np.testing.assert_array_equal(e.upper, [2.5, 4.5])
+    m = box.expand(0.5, how="multiply")
+    np.testing.assert_array_equal(m.lower, [-1, -2])
+    np.testing.assert_array_equal(m.upper, [3, 6])
+
+
+def test_contains_inclusive():
+    box = BoundingBox([0, 0], [1, 1])
+    assert box.contains([0, 0]) and box.contains([1, 1])
+    assert not box.contains([1.0001, 0.5])
+
+
+def test_boxstack_membership_matches_scalar():
+    rng = np.random.default_rng(0)
+    boxes = [
+        BoundingBox([0, 0], [1, 1]),
+        BoundingBox([0.5, 0.5], [2, 2]),
+        BoundingBox([-1, -1], [0, 0]),
+    ]
+    stack = BoxStack.from_boxes(boxes)
+    pts = rng.uniform(-1.5, 2.5, size=(50, 2))
+    mem = stack.membership(pts)
+    for p in range(3):
+        expected = np.array([boxes[p].contains(x) for x in pts])
+        np.testing.assert_array_equal(mem[:, p], expected)

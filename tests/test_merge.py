@@ -1,0 +1,46 @@
+"""Host-side merge utilities vs reference ClusterAggregator semantics."""
+
+import numpy as np
+
+from pypardis_tpu.parallel.merge import merge_occurrences, resolve_label_edges
+
+
+def test_resolve_edges_min_id():
+    ids = np.array([3, 7, 9, 12])
+    mapping = resolve_label_edges(np.array([[7, 3], [9, 12]]), ids)
+    assert mapping[7] == 3 and mapping[3] == 3
+    assert mapping[12] == 9 and mapping[9] == 9
+
+
+def test_merge_core_links_clusters():
+    # points 0,1 in cluster 0 (home part A); points 2,3 in cluster 2
+    # (home part B); point 1 is core and appears in B's run labeled 2.
+    home = np.array([0, 0, 2, 2])
+    core = np.array([True, True, True, True])
+    final, mapping = merge_occurrences(home, core, [1], [2])
+    assert (final == 0).all()
+    assert mapping[2] == 0
+
+
+def test_noncore_occurrence_does_not_merge():
+    # point 1 is a border point (non-core): its duplicate in B must NOT
+    # merge clusters (reference README.md:27-29).
+    home = np.array([0, 0, 2, 2])
+    core = np.array([True, False, True, True])
+    final, _ = merge_occurrences(home, core, [1], [2])
+    np.testing.assert_array_equal(final, [0, 0, 2, 2])
+
+
+def test_noise_occurrence_ignored():
+    home = np.array([0, 0, 2, 2])
+    core = np.array([True, True, True, True])
+    final, _ = merge_occurrences(home, core, [1], [-1])
+    np.testing.assert_array_equal(final, [0, 0, 2, 2])
+
+
+def test_transitive_merge_across_three_partitions():
+    home = np.array([0, 0, 2, 2, 4, 4])
+    core = np.ones(6, bool)
+    # 1 links cluster 0<->2; 3 links cluster 2<->4
+    final, _ = merge_occurrences(home, core, [1, 3], [2, 4])
+    assert (final == 0).all()

@@ -1,0 +1,86 @@
+"""Single-device DBSCAN kernel vs the sklearn oracle.
+
+Oracle policy per SURVEY §4: compare with ARI (border points reachable
+from multiple clusters are legitimately assignment-ambiguous,
+reference README.md:28-33); assert exact agreement on core points and
+noise status.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+from sklearn.cluster import DBSCAN as SKDBSCAN
+from sklearn.metrics import adjusted_rand_score
+
+from pypardis_tpu.ops import dbscan_fixed_size, densify_labels, neighbor_counts
+
+
+def _pad(X, block=256):
+    n = len(X)
+    cap = -(-n // block) * block
+    pts = np.zeros((cap, X.shape[1]), np.float32)
+    pts[:n] = X
+    mask = np.zeros(cap, bool)
+    mask[:n] = True
+    return pts, mask, n
+
+
+def _run(X, eps, min_samples, metric="euclidean", block=256):
+    pts, mask, n = _pad(X, block)
+    labels, core = dbscan_fixed_size(
+        jnp.asarray(pts), eps, min_samples, jnp.asarray(mask),
+        metric=metric, block=block,
+    )
+    return densify_labels(np.asarray(labels)[:n]), np.asarray(core)[:n]
+
+
+def test_neighbor_counts_bruteforce():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(100, 3))
+    pts, mask, n = _pad(X, 64)
+    counts = np.asarray(
+        neighbor_counts(jnp.asarray(pts), 0.8, jnp.asarray(mask), block=64)
+    )[:n]
+    d2 = ((X[:, None, :] - X[None, :, :]) ** 2).sum(-1)
+    expected = (d2 <= 0.8**2).sum(1)
+    np.testing.assert_array_equal(counts, expected)
+
+
+@pytest.mark.parametrize("metric", ["euclidean", "cityblock"])
+def test_blobs_vs_sklearn(blobs750, metric):
+    eps, min_samples = 0.3, 10
+    ours, core = _run(blobs750, eps, min_samples, metric=metric)
+    sk = SKDBSCAN(eps=eps, min_samples=min_samples, metric=metric).fit(
+        blobs750
+    )
+    sk_core = np.zeros(len(blobs750), bool)
+    sk_core[sk.core_sample_indices_] = True
+
+    np.testing.assert_array_equal(core, sk_core)
+    # noise agreement is exact
+    np.testing.assert_array_equal(ours == -1, sk.labels_ == -1)
+    assert adjusted_rand_score(sk.labels_, ours) >= 0.99
+    # core points agree exactly up to relabeling: same partition on cores
+    assert adjusted_rand_score(sk.labels_[sk_core], ours[sk_core]) == 1.0
+
+
+def test_uniform_noise_no_clusters():
+    rng = np.random.default_rng(1)
+    X = rng.uniform(-10, 10, size=(200, 4))
+    ours, core = _run(X, 0.1, 5)
+    assert (ours == -1).all()
+    assert not core.any()
+
+
+def test_single_dense_cluster():
+    rng = np.random.default_rng(2)
+    X = rng.normal(scale=0.05, size=(300, 2))
+    ours, core = _run(X, 0.3, 5)
+    assert (ours == 0).all()
+
+
+def test_padding_invariance(blobs750):
+    a, _ = _run(blobs750, 0.3, 10, block=128)
+    b, _ = _run(blobs750, 0.3, 10, block=512)
+    np.testing.assert_array_equal(a, b)

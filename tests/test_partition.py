@@ -1,0 +1,80 @@
+"""KDPartitioner vs reference semantics (partition.py:98-183)."""
+
+import numpy as np
+import pytest
+
+from pypardis_tpu.partition import (
+    KDPartitioner,
+    mean_var_split,
+    median_search_split,
+    min_var_split,
+)
+
+
+def test_median_search_split_exact():
+    v = np.array([5.0, 1.0, 3.0, 2.0, 4.0])
+    below, b = median_search_split(v)
+    assert b == 3.0
+    assert below.sum() == 2  # strictly-below semantics (partition.py:27-30)
+
+
+def test_mean_var_split_balanced():
+    rng = np.random.default_rng(0)
+    v = rng.normal(size=10_001)
+    below, b = mean_var_split(v)
+    # mean +/- 0.9 sigma candidates guarantee balance within ~0.9 sigma mass
+    frac = below.mean()
+    assert 0.3 < frac < 0.7
+
+
+def test_min_var_split_picks_max_variance_axis():
+    rng = np.random.default_rng(1)
+    pts = np.stack([rng.normal(scale=0.1, size=500),
+                    rng.normal(scale=5.0, size=500)], axis=1)
+    axis, below, b = min_var_split(pts)
+    assert axis == 1
+
+
+@pytest.mark.parametrize("method", ["min_var", "rotation", "mean_var",
+                                    "median_search"])
+def test_partitioner_covers_all_points(method):
+    rng = np.random.default_rng(2)
+    pts = rng.normal(size=(2000, 3))
+    part = KDPartitioner(pts, max_partitions=8, split_method=method)
+    assert part.n_partitions == 8
+    all_idx = np.sort(np.concatenate(list(part.partitions.values())))
+    np.testing.assert_array_equal(all_idx, np.arange(2000))
+    # every point is inside its partition's box
+    for label, idx in part.partitions.items():
+        box = part.bounding_boxes[label]
+        assert box.contains_points(pts[idx]).all()
+
+
+def test_partition_balance():
+    rng = np.random.default_rng(3)
+    pts = rng.normal(size=(10_000, 2))
+    part = KDPartitioner(pts, max_partitions=8)
+    sizes = part.partition_sizes()
+    # mean_var candidates bound imbalance (partition.py:55-59)
+    assert sizes.max() < 3.5 * sizes.min()
+
+
+def test_invalid_split_method_falls_back():
+    pts = np.random.default_rng(4).normal(size=(100, 2))
+    part = KDPartitioner(pts, max_partitions=4, split_method="bogus")
+    assert part.split_method == "min_var"  # partition.py:129-130 semantics
+
+
+def test_route_matches_training_assignment():
+    rng = np.random.default_rng(5)
+    pts = rng.normal(size=(3000, 3))
+    part = KDPartitioner(pts, max_partitions=16)
+    np.testing.assert_array_equal(part.route(pts), part.result)
+
+
+def test_result_labels_consistent():
+    rng = np.random.default_rng(6)
+    pts = rng.normal(size=(500, 2))
+    part = KDPartitioner(pts, max_partitions=4)
+    for label, idx in part.partitions.items():
+        assert (part.result[idx] == label).all()

@@ -1,0 +1,86 @@
+"""Distributed path on the 8-device CPU mesh vs the sklearn oracle.
+
+Invariants from SURVEY §4: every point gets exactly one global label;
+core-connected points share a label regardless of partition count;
+result invariant (on core points) to max_partitions in {1, 4, 16}.
+"""
+
+import numpy as np
+import pytest
+from sklearn.cluster import DBSCAN as SKDBSCAN
+from sklearn.metrics import adjusted_rand_score
+
+import jax
+
+from pypardis_tpu import DBSCAN
+from pypardis_tpu.parallel import default_mesh, sharded_dbscan
+from pypardis_tpu.partition import KDPartitioner
+from pypardis_tpu.ops.labels import densify_labels
+
+
+def _oracle_check(X, labels, core, eps, min_samples):
+    sk = SKDBSCAN(eps=eps, min_samples=min_samples).fit(X)
+    sk_core = np.zeros(len(X), bool)
+    sk_core[sk.core_sample_indices_] = True
+    np.testing.assert_array_equal(core, sk_core)
+    np.testing.assert_array_equal(labels == -1, sk.labels_ == -1)
+    assert adjusted_rand_score(sk.labels_, labels) >= 0.99
+    assert adjusted_rand_score(sk.labels_[sk_core], labels[sk_core]) == 1.0
+
+
+def test_mesh_has_8_devices():
+    assert default_mesh().devices.size == 8
+
+
+def test_sharded_blobs_matches_sklearn(blobs750):
+    eps, ms = 0.3, 10
+    part = KDPartitioner(blobs750, max_partitions=8)
+    labels, core, stats = sharded_dbscan(
+        blobs750, part, eps=eps, min_samples=ms, block=128
+    )
+    assert stats["halo_factor"] > 0  # duplication actually happened
+    _oracle_check(blobs750, densify_labels(labels), core, eps, ms)
+
+
+def test_api_uses_sharded_path(blobs750):
+    model = DBSCAN(eps=0.3, min_samples=10, block=128)
+    labels = model.fit_predict(blobs750)
+    assert model.metrics_["n_partitions"] == 8
+    _oracle_check(blobs750, labels, model.core_sample_mask_, 0.3, 10)
+
+
+@pytest.mark.parametrize("max_partitions", [8, 16])
+def test_partition_count_invariance(max_partitions):
+    rng = np.random.default_rng(7)
+    X = np.concatenate(
+        [
+            rng.normal(loc=[0, 0], scale=0.15, size=(300, 2)),
+            rng.normal(loc=[3, 3], scale=0.15, size=(300, 2)),
+            rng.uniform(-2, 5, size=(60, 2)),
+        ]
+    )
+    eps, ms = 0.25, 8
+    model = DBSCAN(eps=eps, min_samples=ms, max_partitions=max_partitions,
+                   block=128)
+    labels = model.fit_predict(X)
+    _oracle_check(X, labels, model.core_sample_mask_, eps, ms)
+
+
+def test_cluster_spanning_many_partitions():
+    # A single long dense chain must come back as ONE cluster even when
+    # the KD tree slices it across every device (transitive merge).
+    t = np.linspace(0, 20, 2000)
+    X = np.stack([t, np.sin(t)], axis=1)
+    rng = np.random.default_rng(8)
+    X = X + rng.normal(scale=0.005, size=X.shape)
+    model = DBSCAN(eps=0.2, min_samples=4, max_partitions=8, block=128)
+    labels = model.fit_predict(X)
+    assert (labels == labels[0]).all()
+    assert labels[0] != -1
+
+
+def test_every_point_exactly_one_label(blobs750):
+    model = DBSCAN(eps=0.3, min_samples=10, block=128)
+    labels = model.fit_predict(blobs750)
+    assert labels.shape == (len(blobs750),)
+    assert labels.dtype == np.int32
