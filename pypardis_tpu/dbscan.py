@@ -28,7 +28,7 @@ import numpy as np
 from .aggregator import ClusterAggregator
 from .geometry import BoundingBox
 from .ops import dbscan_fixed_size, densify_labels
-from .partition import KDPartitioner
+from .partition import KDPartitioner, spatial_order
 from .utils import clamp_block, round_up
 
 
@@ -53,14 +53,22 @@ def _as_keys_points(data):
     return np.arange(len(pts)), pts
 
 
-def _pad_and_run(points, eps, min_samples, metric, block):
-    """Center, pad to a block multiple, run the kernel, slice back.
+def _pad_and_run(
+    points, eps, min_samples, metric, block, precision="high", sort=True
+):
+    """Center, spatially sort, pad to a block multiple, run the kernel,
+    un-sort and slice back.
 
     Centering (subtracting the dataset mean) is load-bearing: squared
     distances are computed in float32 via the |x|^2+|y|^2-2xy expansion,
     whose absolute error scales with coordinate magnitude — e.g. GPS
     data in projected meters (~1e6) would lose all precision near eps.
     Centering preserves distances and bounds magnitudes.
+
+    Spatial sorting (KD leaves in Morton order) makes contiguous kernel
+    tiles spatially tight so tile-level bbox pruning skips most of the
+    N^2 interaction; labels are root *indices*, so they are mapped back
+    through the permutation before returning.
     """
     import jax.numpy as jnp
 
@@ -68,6 +76,10 @@ def _pad_and_run(points, eps, min_samples, metric, block):
     n, k = points.shape
     block = clamp_block(block, n)
     cap = round_up(n, block)
+    order = None
+    if sort and n > 2 * block:
+        order = spatial_order(points, leaf_size=block)
+        points = points[order]
     pts = np.zeros((cap, k), np.float32)
     pts[:n] = points - points.mean(axis=0)
     mask = np.zeros(cap, bool)
@@ -79,8 +91,21 @@ def _pad_and_run(points, eps, min_samples, metric, block):
         jnp.asarray(mask),
         metric=metric,
         block=block,
+        precision=precision,
     )
-    return np.asarray(roots)[:n], np.asarray(core)[:n]
+    # np.array (not asarray): device buffers are read-only views.
+    roots, core = np.array(roots[:n]), np.array(core[:n])
+    if order is not None:
+        # Map sorted-space root indices back to original point ids, then
+        # scatter rows back to the original order.
+        valid = roots >= 0
+        roots[valid] = order[roots[valid]]
+        inv_roots = np.empty(n, roots.dtype)
+        inv_core = np.empty(n, core.dtype)
+        inv_roots[order] = roots
+        inv_core[order] = core
+        roots, core = inv_roots, inv_core
+    return roots, core
 
 
 def dbscan_partition(iterable, params):
@@ -139,6 +164,7 @@ class DBSCAN:
         split_method: str = "min_var",
         block: int = 1024,
         mesh=None,
+        precision: str = "high",
     ):
         self.eps = float(eps)
         self.min_samples = int(min_samples)
@@ -147,6 +173,7 @@ class DBSCAN:
         self.split_method = split_method
         self.block = int(block)
         self.mesh = mesh
+        self.precision = precision
         # Reference attribute surface (dbscan.py:93-102).
         self.data = None
         self.result = None
@@ -215,7 +242,8 @@ class DBSCAN:
     def _train_single(self, points: np.ndarray) -> None:
         t0 = time.perf_counter()
         roots, core = _pad_and_run(
-            points, self.eps, self.min_samples, self.metric, self.block
+            points, self.eps, self.min_samples, self.metric, self.block,
+            precision=self.precision,
         )
         self.core_sample_mask_ = core
         self.labels_ = densify_labels(roots)
@@ -262,6 +290,7 @@ class DBSCAN:
             metric=self.metric,
             block=self.block,
             mesh=self.mesh,
+            precision=self.precision,
         )
         self.labels_ = densify_labels(labels)
         self.core_sample_mask_ = core

@@ -52,7 +52,7 @@ def _pointer_jump(f: jnp.ndarray, active: jnp.ndarray) -> jnp.ndarray:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("metric", "block", "max_rounds")
+    jax.jit, static_argnames=("metric", "block", "max_rounds", "precision")
 )
 def dbscan_fixed_size(
     points: jnp.ndarray,
@@ -62,6 +62,7 @@ def dbscan_fixed_size(
     metric: str = "euclidean",
     block: int = 1024,
     max_rounds: int = 64,
+    precision: str = "high",
 ):
     """DBSCAN over a fixed-capacity padded point set.
 
@@ -78,7 +79,9 @@ def dbscan_fixed_size(
       dbscan.py:30.
     """
     n = points.shape[0]
-    counts = neighbor_counts(points, eps, mask, metric=metric, block=block)
+    counts = neighbor_counts(
+        points, eps, mask, metric=metric, block=block, precision=precision
+    )
     core = (counts >= min_samples) & mask
 
     idx = jnp.arange(n, dtype=jnp.int32)
@@ -91,7 +94,10 @@ def dbscan_fixed_size(
     def body(state):
         f, _, rounds = state
         # Hook: min label among core eps-neighbors (self included).
-        g = min_neighbor_label(points, f, eps, core, metric=metric, block=block)
+        g = min_neighbor_label(
+            points, f, eps, core, metric=metric, block=block,
+            precision=precision, row_mask=core,
+        )
         f_new = jnp.where(core, jnp.minimum(f, g), f)
         # Shortcut: chase pointers to the current root.
         f_new = _pointer_jump(f_new, core)
@@ -100,7 +106,10 @@ def dbscan_fixed_size(
     f, _, _ = jax.lax.while_loop(cond, body, (f0, jnp.bool_(True), 0))
 
     # Border points: nearest-core-label attach; noise: no core neighbor.
-    border = min_neighbor_label(points, f, eps, core, metric=metric, block=block)
+    border = min_neighbor_label(
+        points, f, eps, core, metric=metric, block=block,
+        precision=precision, row_mask=mask,
+    )
     labels = jnp.where(
         core, f, jnp.where(mask & (border != _INT_INF), border, -1)
     ).astype(jnp.int32)

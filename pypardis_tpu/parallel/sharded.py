@@ -41,6 +41,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..geometry import BoxStack
 from ..ops.labels import dbscan_fixed_size
+from ..partition import spatial_order
 from ..utils import clamp_block, round_up
 
 _INT_INF = jnp.iinfo(jnp.int32).max
@@ -75,6 +76,14 @@ def build_shards(points, partitioner, eps, n_shards, block):
         m = member[:, j].copy()
         m[idx] = False
         halo_idx.append(np.nonzero(m)[0])
+
+    # Spatially sort each slab (KD leaves in Morton order) so the
+    # kernel's tile-level bbox pruning bites within every shard.
+    def _sorted_slab(idx):
+        return idx[spatial_order(points[idx], leaf_size=block)] if len(idx) else idx
+
+    owned_idx = [_sorted_slab(i) for i in owned_idx]
+    halo_idx = [_sorted_slab(i) for i in halo_idx]
 
     cap = round_up(max(len(i) for i in owned_idx), block)
     hcap = round_up(max(max((len(h) for h in halo_idx), default=1), 1), block)
@@ -176,11 +185,13 @@ def _merge_loop(lab_map, home_label, core_g, h_gid, h_lab, h_core, axis,
     jax.jit,
     static_argnames=(
         "eps", "min_samples", "metric", "block", "mesh", "axis", "n_points",
+        "precision",
     ),
 )
 def sharded_step(
     owned, owned_mask, owned_gid, halo, halo_mask, halo_gid,
     *, eps, min_samples, metric, block, mesh, axis, n_points,
+    precision="high",
 ):
     """One fully-sharded clustering step: local DBSCAN + global merge.
 
@@ -198,9 +209,17 @@ def sharded_step(
 
         def one_part(p, m):
             return dbscan_fixed_size(
-                p, eps, min_samples, m, metric=metric, block=block
+                p, eps, min_samples, m, metric=metric, block=block,
+                precision=precision,
             )
-        labels, core = jax.vmap(one_part)(pts, msk)
+        if pts.shape[0] == 1:
+            # One partition per device (the common layout): call directly
+            # so the kernel's lax.cond tile pruning stays a real branch —
+            # vmap would lower cond to select and execute both sides.
+            l1, c1 = one_part(pts[0], msk[0])
+            labels, core = l1[None], c1[None]
+        else:
+            labels, core = jax.vmap(one_part)(pts, msk)
         # local root index -> global cluster key (root point gid)
         glabel = jnp.where(
             labels >= 0,
@@ -278,6 +297,7 @@ def sharded_dbscan(
     metric="euclidean",
     block: int = 1024,
     mesh: Optional[Mesh] = None,
+    precision: str = "high",
 ):
     """Cluster ``points`` over the device mesh.
 
@@ -310,5 +330,6 @@ def sharded_dbscan(
         mesh=mesh,
         axis=axis,
         n_points=len(points),
+        precision=precision,
     )
     return np.asarray(labels), np.asarray(core), stats

@@ -84,6 +84,61 @@ def min_var_split(points: np.ndarray):
     return axis, below, boundary
 
 
+def morton_codes(points: np.ndarray, bits: int = 10, max_axes: int = 6):
+    """Morton (Z-order) codes for (N, k) points, uint64.
+
+    Axes beyond ``max_axes`` are dropped (highest-variance axes kept) so
+    codes fit in 64 bits; quantization is ``bits`` per axis over the
+    data's range.
+    """
+    points = np.asarray(points, dtype=np.float64)
+    if points.ndim != 2:
+        raise ValueError(f"points must be (N, k), got {points.shape}")
+    max_axes = min(max_axes, 64 // bits)  # interleaved code must fit uint64
+    if points.shape[1] > max_axes:
+        axes = np.argsort(points.var(axis=0))[::-1][:max_axes]
+        points = points[:, np.sort(axes)]
+    k = points.shape[1]
+    lo = points.min(axis=0)
+    span = np.maximum(points.max(axis=0) - lo, 1e-300)
+    q = np.minimum(
+        ((points - lo) / span * (1 << bits)).astype(np.uint64), (1 << bits) - 1
+    )
+    codes = np.zeros(len(points), dtype=np.uint64)
+    for b in range(bits - 1, -1, -1):
+        for a in range(k):
+            codes = (codes << np.uint64(1)) | ((q[:, a] >> np.uint64(b)) & np.uint64(1))
+    return codes
+
+
+def spatial_order(
+    points: np.ndarray, leaf_size: int = 1024, seed: int = 0
+) -> np.ndarray:
+    """An index permutation grouping spatially nearby points.
+
+    Splits the point set into balanced KD leaves of ~``leaf_size`` points
+    (exact-median splits), orders leaves along a Morton curve of their
+    centroids, and concatenates leaf members.  Contiguous tile blocks of
+    the permuted layout then have tight bounding boxes, which is what
+    makes tile-level pruning in :mod:`pypardis_tpu.ops` effective: the
+    O(N^2) pairwise interaction collapses to O(N x local density).
+    """
+    points = np.asarray(points, dtype=np.float64)
+    n = len(points)
+    n_leaves = min(4096, max(1, n // max(int(leaf_size), 1)))
+    if n_leaves <= 1:
+        return np.arange(n)
+    part = KDPartitioner(
+        points,
+        max_partitions=n_leaves,
+        split_method="median_search",
+        seed=seed,
+    )
+    return np.concatenate(
+        [part.partitions[l] for l in part.leaf_order_morton()]
+    )
+
+
 class KDPartitioner:
     """Binary-tree spatial partitioner over an in-memory point set.
 
@@ -224,6 +279,19 @@ class KDPartitioner:
     def partition_sizes(self) -> np.ndarray:
         labels = sorted(self.partitions)
         return np.array([len(self.partitions[l]) for l in labels])
+
+    def leaf_order_morton(self) -> np.ndarray:
+        """Leaf labels ordered along a Morton curve of leaf centroids.
+
+        Consecutive leaves in this order are spatially close, so point
+        layouts built from it give the tile-pruning kernels tight,
+        coherent tile bounding boxes.
+        """
+        labels = sorted(self.partitions)
+        cent = np.stack(
+            [self.points[self.partitions[l]].mean(axis=0) for l in labels]
+        )
+        return np.asarray(labels)[np.argsort(morton_codes(cent))]
 
     def route(self, points: np.ndarray) -> np.ndarray:
         """Assign new points to partitions by replaying the split tree."""
