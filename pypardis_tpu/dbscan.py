@@ -65,9 +65,9 @@ def _pad_and_run(
     data in projected meters (~1e6) would lose all precision near eps.
     Centering preserves distances and bounds magnitudes.
 
-    Spatial sorting (KD leaves in Morton order) makes contiguous kernel
-    tiles spatially tight so tile-level bbox pruning skips most of the
-    N^2 interaction; labels are root *indices*, so they are mapped back
+    Spatial sorting (Morton order) makes contiguous kernel tiles
+    spatially tight so tile-level bbox pruning skips most of the N^2
+    interaction; labels are root *indices*, so they are mapped back
     through the permutation before returning.
     """
     import jax.numpy as jnp
@@ -78,7 +78,7 @@ def _pad_and_run(
     cap = round_up(n, block)
     order = None
     if sort and n > 2 * block:
-        order = spatial_order(points, leaf_size=block)
+        order = spatial_order(points)
         points = points[order]
     pts = np.zeros((cap, k), np.float32)
     pts[:n] = points - points.mean(axis=0)
@@ -128,6 +128,7 @@ def dbscan_partition(iterable, params):
         params["min_samples"],
         params.get("metric", "euclidean"),
         block=256,
+        precision=params.get("precision", "high"),
     )
     labels = densify_labels(roots)
     for i in range(len(x)):

@@ -77,10 +77,10 @@ def build_shards(points, partitioner, eps, n_shards, block):
         m[idx] = False
         halo_idx.append(np.nonzero(m)[0])
 
-    # Spatially sort each slab (KD leaves in Morton order) so the
-    # kernel's tile-level bbox pruning bites within every shard.
+    # Spatially sort each slab (Morton order) so the kernel's tile-level
+    # bbox pruning bites within every shard.
     def _sorted_slab(idx):
-        return idx[spatial_order(points[idx], leaf_size=block)] if len(idx) else idx
+        return idx[spatial_order(points[idx])] if len(idx) else idx
 
     owned_idx = [_sorted_slab(i) for i in owned_idx]
     halo_idx = [_sorted_slab(i) for i in halo_idx]
