@@ -54,7 +54,8 @@ def _as_keys_points(data):
 
 
 def _pad_and_run(
-    points, eps, min_samples, metric, block, precision="high", sort=True
+    points, eps, min_samples, metric, block, precision="high", sort=True,
+    backend="auto",
 ):
     """Center, spatially sort, pad to a block multiple, run the kernel,
     un-sort and slice back.
@@ -92,6 +93,7 @@ def _pad_and_run(
         metric=metric,
         block=block,
         precision=precision,
+        backend=backend,
     )
     # np.array (not asarray): device buffers are read-only views.
     roots, core = np.array(roots[:n]), np.array(core[:n])
@@ -129,6 +131,7 @@ def dbscan_partition(iterable, params):
         params.get("metric", "euclidean"),
         block=256,
         precision=params.get("precision", "high"),
+        backend=params.get("backend", "auto"),
     )
     labels = densify_labels(roots)
     for i in range(len(x)):
@@ -166,6 +169,7 @@ class DBSCAN:
         block: int = 1024,
         mesh=None,
         precision: str = "high",
+        kernel_backend: str = "auto",
     ):
         self.eps = float(eps)
         self.min_samples = int(min_samples)
@@ -175,6 +179,7 @@ class DBSCAN:
         self.block = int(block)
         self.mesh = mesh
         self.precision = precision
+        self.kernel_backend = kernel_backend
         # Reference attribute surface (dbscan.py:93-102).
         self.data = None
         self.result = None
@@ -244,7 +249,7 @@ class DBSCAN:
         t0 = time.perf_counter()
         roots, core = _pad_and_run(
             points, self.eps, self.min_samples, self.metric, self.block,
-            precision=self.precision,
+            precision=self.precision, backend=self.kernel_backend,
         )
         self.core_sample_mask_ = core
         self.labels_ = densify_labels(roots)
@@ -292,6 +297,7 @@ class DBSCAN:
             block=self.block,
             mesh=self.mesh,
             precision=self.precision,
+            backend=self.kernel_backend,
         )
         self.labels_ = densify_labels(labels)
         self.core_sample_mask_ = core

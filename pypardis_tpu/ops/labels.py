@@ -29,6 +29,42 @@ from .distances import min_neighbor_label, neighbor_counts
 _INT_INF = jnp.iinfo(jnp.int32).max
 
 
+def resolve_backend(
+    backend: str, metric: str, n: int = 0, block: int = 1
+) -> str:
+    """Resolve "auto" to "pallas" on TPU (Euclidean only) else "xla".
+
+    The Pallas kernels require Mosaic (TPU) and the matmul distance
+    decomposition; everything else — CPU test meshes, cityblock — runs
+    the pure-XLA tiled path with identical semantics.  Problems smaller
+    than a few tiles also stay on XLA: a hand-scheduled kernel buys
+    nothing there, and sub-millisecond XLA programs sidestep launch
+    overhead entirely.  Shards at or above 2^24 points stay on XLA too
+    (the Pallas label kernel carries labels as exact-below-2^24 float32).
+    """
+    from .distances import _norm_metric
+    from .pallas_kernels import MAX_LABEL_POINTS
+
+    metric = _norm_metric(metric)
+    if backend == "auto":
+        return (
+            "pallas"
+            if metric == "euclidean"
+            and jax.default_backend() == "tpu"
+            and n >= 4 * block
+            and n < MAX_LABEL_POINTS
+            else "xla"
+        )
+    if backend not in ("xla", "pallas"):
+        raise ValueError(f"backend must be auto|xla|pallas, got {backend!r}")
+    if backend == "pallas" and metric != "euclidean":
+        raise ValueError(
+            f"backend='pallas' supports only the euclidean metric, got "
+            f"{metric!r}; use backend='auto' or 'xla'"
+        )
+    return backend
+
+
 def _pointer_jump(f: jnp.ndarray, active: jnp.ndarray) -> jnp.ndarray:
     """Chase f -> f[f] to a fixpoint (path shortcutting).
 
@@ -52,7 +88,8 @@ def _pointer_jump(f: jnp.ndarray, active: jnp.ndarray) -> jnp.ndarray:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("metric", "block", "max_rounds", "precision")
+    jax.jit,
+    static_argnames=("metric", "block", "max_rounds", "precision", "backend"),
 )
 def dbscan_fixed_size(
     points: jnp.ndarray,
@@ -63,6 +100,7 @@ def dbscan_fixed_size(
     block: int = 1024,
     max_rounds: int = 64,
     precision: str = "high",
+    backend: str = "auto",
 ):
     """DBSCAN over a fixed-capacity padded point set.
 
@@ -79,9 +117,26 @@ def dbscan_fixed_size(
       dbscan.py:30.
     """
     n = points.shape[0]
-    counts = neighbor_counts(
-        points, eps, mask, metric=metric, block=block, precision=precision
-    )
+    if resolve_backend(backend, metric, n, block) == "pallas":
+        from .pallas_kernels import (
+            min_neighbor_label_pallas,
+            neighbor_counts_pallas,
+        )
+
+        count_fn = functools.partial(
+            neighbor_counts_pallas, block=block, precision=precision
+        )
+        minlab_fn = functools.partial(
+            min_neighbor_label_pallas, block=block, precision=precision
+        )
+    else:
+        count_fn = functools.partial(
+            neighbor_counts, metric=metric, block=block, precision=precision
+        )
+        minlab_fn = functools.partial(
+            min_neighbor_label, metric=metric, block=block, precision=precision
+        )
+    counts = count_fn(points, eps, mask)
     core = (counts >= min_samples) & mask
 
     idx = jnp.arange(n, dtype=jnp.int32)
@@ -94,10 +149,7 @@ def dbscan_fixed_size(
     def body(state):
         f, _, rounds = state
         # Hook: min label among core eps-neighbors (self included).
-        g = min_neighbor_label(
-            points, f, eps, core, metric=metric, block=block,
-            precision=precision, row_mask=core,
-        )
+        g = minlab_fn(points, f, eps, core, row_mask=core)
         f_new = jnp.where(core, jnp.minimum(f, g), f)
         # Shortcut: chase pointers to the current root.
         f_new = _pointer_jump(f_new, core)
@@ -106,10 +158,7 @@ def dbscan_fixed_size(
     f, _, _ = jax.lax.while_loop(cond, body, (f0, jnp.bool_(True), 0))
 
     # Border points: nearest-core-label attach; noise: no core neighbor.
-    border = min_neighbor_label(
-        points, f, eps, core, metric=metric, block=block,
-        precision=precision, row_mask=mask,
-    )
+    border = minlab_fn(points, f, eps, core, row_mask=mask)
     labels = jnp.where(
         core, f, jnp.where(mask & (border != _INT_INF), border, -1)
     ).astype(jnp.int32)

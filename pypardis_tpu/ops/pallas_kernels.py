@@ -1,0 +1,380 @@
+"""Pallas TPU kernels for the eps-neighborhood hot loop.
+
+The XLA path in :mod:`pypardis_tpu.ops.distances` expresses the tiled
+pairwise interaction as ``lax.map`` over row tiles with a ``lax.scan`` +
+``lax.cond`` over column tiles.  These kernels implement the same two
+primitives — eps-neighbor counting and min-label-over-neighbors — as
+hand-scheduled Mosaic programs:
+
+* one grid program per **row tile**; the row block and all tile bounding
+  boxes live in VMEM;
+* column tiles stay in **HBM** and are DMA'd into VMEM scratch buffers
+  only when their bounding box lies within eps of the row tile's — the
+  pruned tiles cost neither FLOPs nor HBM bandwidth;
+* the distance tile ``|x|^2 + |y|^2 - 2 x @ y.T`` is computed on the MXU
+  and consumed immediately by the compare-and-reduce in registers, so the
+  N x N interaction never touches HBM.
+
+Layout notes (Mosaic DMA slices must be tile-aligned):
+
+* coordinates are zero-padded to a multiple of 128 lanes so a column
+  block DMA ``(1, block, d_pad)`` is lane-aligned;
+* per-point scalars (squared norms, labels) travel as ``(nt, 1, block)``
+  float32 rows — a ``(1, 1, block)`` slice is aligned, and arrives in
+  exactly the ``(1, bj)`` broadcast layout the kernel consumes.  Labels
+  therefore ride as float32, which is exact for indices < 2^24; the
+  no-label sentinel is ``+inf``.
+
+Masking convention: callers pre-mask the *column* operand — invalid /
+non-source points get coordinates ``BIG`` (squared distance overflows
+past any eps) and labels ``+inf``.  No boolean mask ever enters the
+kernel.
+
+Only the Euclidean metric goes through Pallas (cityblock has no matmul
+decomposition and stays on the XLA path).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_INT_INF = jnp.iinfo(jnp.int32).max
+_F_INF = float("inf")  # python float: jnp scalars become captured consts in kernels
+# Masked-out column points get these coordinates: BIG^2 overflows fp32 to
+# inf, so d2 is inf (or NaN for BIG-vs-BIG pairs) and the <= eps^2
+# adjacency test is always False.
+BIG = jnp.float32(1e19)
+# float32 labels are exact up to 2^24.
+MAX_LABEL_POINTS = 1 << 24
+
+
+def _pallas_precision(precision):
+    """Mosaic's dot lowering supports only DEFAULT (single-pass bf16) and
+    HIGHEST (fp32) — map the XLA-path's bf16_3x default up to HIGHEST."""
+    from .distances import _norm_precision
+
+    p = _norm_precision(precision)
+    return (
+        jax.lax.Precision.DEFAULT
+        if p == jax.lax.Precision.DEFAULT
+        else jax.lax.Precision.HIGHEST
+    )
+
+
+def _tile_gap2(lo_ref, hi_ref, i, rlo_ref, rhi_ref, j):
+    """Squared box-to-box gap between row tile i and column tile j."""
+    lo_i = rlo_ref[pl.ds(i, 1), :]
+    hi_i = rhi_ref[pl.ds(i, 1), :]
+    lo_j = lo_ref[pl.ds(j, 1), :]
+    hi_j = hi_ref[pl.ds(j, 1), :]
+    gap = jnp.maximum(jnp.maximum(lo_j - hi_i, lo_i - hi_j), 0.0)
+    return jnp.sum(gap * gap)
+
+
+def _sq_dists(x, xx, ybuf, ysq, precision):
+    """(bi, d) rows vs (bj, d) cols -> (bi, bj) squared distances.
+
+    ``xx``: (bi, 1) row squared norms; ``ysq``: (1, bj) column squared
+    norms (inf for masked columns).
+    """
+    t = jax.lax.dot_general(
+        x,
+        ybuf,
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=precision,
+    )
+    return xx + ysq - 2.0 * t
+
+
+def _count_kernel(
+    eps2_ref, lo_ref, hi_ref, glo_ref, ghi_ref, x_ref, yhbm_ref, ysq_ref,
+    out_ref, ybuf, sbuf, ysem, ssem,
+    *, precision, group,
+):
+    i = pl.program_id(0)
+    ng = glo_ref.shape[0]
+    eps2 = eps2_ref[0]
+    x = x_ref[:]
+    xx = jnp.sum(x * x, axis=1, keepdims=True)
+    out_ref[0] = jnp.zeros_like(out_ref[0])
+
+    def tile_body(j, _):
+        gap2 = _tile_gap2(lo_ref, hi_ref, i, lo_ref, hi_ref, j)
+
+        @pl.when(gap2 <= eps2)
+        def _():
+            ydma = pltpu.make_async_copy(yhbm_ref.at[j], ybuf, ysem)
+            sdma = pltpu.make_async_copy(ysq_ref.at[j], sbuf, ssem)
+            ydma.start()
+            sdma.start()
+            ydma.wait()
+            sdma.wait()
+            d2 = _sq_dists(x, xx, ybuf[:], sbuf[0], precision)
+            adj = (d2 <= eps2).astype(jnp.int32)
+            out_ref[0] += jnp.sum(adj, axis=1, keepdims=True)
+
+        return 0
+
+    def group_body(g, _):
+        # Group-level skip: one gap test covers `group` column tiles.
+        ggap2 = _tile_gap2(glo_ref, ghi_ref, i, lo_ref, hi_ref, g)
+
+        @pl.when(ggap2 <= eps2)
+        def _():
+            jax.lax.fori_loop(g * group, (g + 1) * group, tile_body, 0)
+
+        return 0
+
+    jax.lax.fori_loop(0, ng, group_body, 0)
+
+
+def _minlab_kernel(
+    eps2_ref, lo_ref, hi_ref, rlo_ref, rhi_ref, glo_ref, ghi_ref, x_ref,
+    yhbm_ref, ysq_ref, ylab_ref, out_ref,
+    ybuf, sbuf, lbuf, ysem, ssem, lsem,
+    *, precision, group,
+):
+    i = pl.program_id(0)
+    ng = glo_ref.shape[0]
+    eps2 = eps2_ref[0]
+    x = x_ref[:]
+    xx = jnp.sum(x * x, axis=1, keepdims=True)
+    out_ref[0] = jnp.full_like(out_ref[0], _F_INF)
+
+    def tile_body(j, _):
+        gap2 = _tile_gap2(lo_ref, hi_ref, i, rlo_ref, rhi_ref, j)
+
+        @pl.when(gap2 <= eps2)
+        def _():
+            ydma = pltpu.make_async_copy(yhbm_ref.at[j], ybuf, ysem)
+            sdma = pltpu.make_async_copy(ysq_ref.at[j], sbuf, ssem)
+            ldma = pltpu.make_async_copy(ylab_ref.at[j], lbuf, lsem)
+            ydma.start()
+            sdma.start()
+            ldma.start()
+            ydma.wait()
+            sdma.wait()
+            ldma.wait()
+            d2 = _sq_dists(x, xx, ybuf[:], sbuf[0], precision)
+            cand = jnp.where(d2 <= eps2, lbuf[0], _F_INF)
+            out_ref[0] = jnp.minimum(
+                out_ref[0], jnp.min(cand, axis=1, keepdims=True)
+            )
+
+        return 0
+
+    def group_body(g, _):
+        ggap2 = _tile_gap2(glo_ref, ghi_ref, i, rlo_ref, rhi_ref, g)
+
+        @pl.when(ggap2 <= eps2)
+        def _():
+            jax.lax.fori_loop(g * group, (g + 1) * group, tile_body, 0)
+
+        return 0
+
+    jax.lax.fori_loop(0, ng, group_body, 0)
+
+
+def _pad_lanes(x: jnp.ndarray, d_pad: int) -> jnp.ndarray:
+    n, d = x.shape
+    if d == d_pad:
+        return x
+    return jnp.concatenate([x, jnp.zeros((n, d_pad - d), x.dtype)], axis=1)
+
+
+def _prep(points, mask, block, d_pad):
+    """Mask columns to BIG; compute tile bounds, squared norms, padded
+    column blocks."""
+    n, d = points.shape
+    nt = n // block
+    pts_m = jnp.where(mask[:, None], points.astype(jnp.float32), BIG)
+    tiles = pts_m.reshape(nt, block, d)
+    # Bounds over masked coords: invalid points sit at +BIG, which would
+    # inflate the upper bound — mask them back out with the inverted-box
+    # convention (lo=+BIG, hi=-BIG for empty tiles).
+    m = mask.reshape(nt, block)[..., None]
+    lo = jnp.min(jnp.where(m, tiles, BIG), axis=1)
+    hi = jnp.max(jnp.where(m, tiles, -BIG), axis=1)
+    # Squared norms of masked coords overflow to +inf, which keeps masked
+    # columns out of every adjacency no matter what the matmul returns.
+    ysq = jnp.sum(pts_m * pts_m, axis=1).reshape(nt, 1, block)
+    ycols = _pad_lanes(pts_m, d_pad).reshape(nt, block, d_pad)
+    return ycols, ysq, lo, hi
+
+
+GROUP = 16  # column tiles covered by one group-level gap test
+
+
+def _group_bounds(lo, hi):
+    """Coarse bounds over GROUP-sized runs of column tiles, padded with
+    inverted boxes so padded tiles always prune."""
+    nt, d = lo.shape
+    ng = -(-nt // GROUP)
+    pad = ng * GROUP - nt
+    lo_p = jnp.concatenate([lo, jnp.full((pad, d), BIG)], axis=0)
+    hi_p = jnp.concatenate([hi, jnp.full((pad, d), -BIG)], axis=0)
+    glo = jnp.min(lo_p.reshape(ng, GROUP, d), axis=1)
+    ghi = jnp.max(hi_p.reshape(ng, GROUP, d), axis=1)
+    return lo_p, hi_p, glo, ghi
+
+
+def _pallas_block(block: int, n: int, d_pad: int) -> int:
+    """Largest row/column tile that keeps the fp32 distance tile plus
+    operand blocks comfortably inside VMEM and divides n."""
+    b = min(block, n)
+    while b > 128 and (
+        2 * b * b * 4 + 3 * b * d_pad * 4 > 10 * 1024 * 1024 or n % b != 0
+    ):
+        b //= 2
+    return b
+
+
+def _round_up_128(d: int) -> int:
+    return -(-d // 128) * 128
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block", "precision", "interpret")
+)
+def neighbor_counts_pallas(
+    points: jnp.ndarray,
+    eps,
+    mask: jnp.ndarray,
+    block: int = 1024,
+    precision: str = "high",
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Pallas analogue of :func:`pypardis_tpu.ops.distances.neighbor_counts`
+    (Euclidean only)."""
+    n, d = points.shape
+    d_pad = _round_up_128(d)
+    block = _pallas_block(block, n, d_pad)
+    assert n % block == 0, (n, block)
+    nt = n // block
+    ycols, ysq, lo, hi = _prep(points, mask, block, d_pad)
+    xrows = ycols.reshape(n, d_pad)
+    lo_p, hi_p, glo, ghi = _group_bounds(lo, hi)
+    ntp, ng = lo_p.shape[0], glo.shape[0]
+    eps2 = jnp.asarray(eps, jnp.float32).reshape(1) ** 2
+
+    counts = pl.pallas_call(
+        functools.partial(
+            _count_kernel,
+            precision=_pallas_precision(precision),
+            group=GROUP,
+        ),
+        grid=(nt,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((ntp, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((ntp, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((ng, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((ng, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (block, d_pad), lambda i: (i, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(memory_space=pltpu.HBM),
+            pl.BlockSpec(memory_space=pltpu.HBM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, block, 1), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((nt, block, 1), jnp.int32),
+        scratch_shapes=[
+            pltpu.VMEM((block, d_pad), jnp.float32),
+            pltpu.VMEM((1, block), jnp.float32),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+        interpret=interpret,
+    )(eps2, lo_p, hi_p, glo, ghi, xrows, ycols, ysq)
+    return jnp.where(mask, counts.reshape(-1), 0)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block", "precision", "interpret")
+)
+def min_neighbor_label_pallas(
+    points: jnp.ndarray,
+    labels: jnp.ndarray,
+    eps,
+    src_mask: jnp.ndarray,
+    block: int = 1024,
+    precision: str = "high",
+    interpret: bool = False,
+    row_mask: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Pallas analogue of
+    :func:`pypardis_tpu.ops.distances.min_neighbor_label` (Euclidean).
+
+    Labels travel as float32 (exact below 2^24); INT32_MAX maps to +inf
+    and back.
+    """
+    n, d = points.shape
+    if n >= MAX_LABEL_POINTS:
+        raise ValueError(
+            f"pallas label kernel supports < 2^24 points per shard, got {n}"
+        )
+    d_pad = _round_up_128(d)
+    block = _pallas_block(block, n, d_pad)
+    assert n % block == 0, (n, block)
+    nt = n // block
+    ycols, ysq, lo, hi = _prep(points, src_mask, block, d_pad)
+    if row_mask is None:
+        rlo, rhi = lo, hi
+    else:
+        _, _, rlo, rhi = _prep(points, row_mask, block, d_pad)
+    lo_p, hi_p, glo, ghi = _group_bounds(lo, hi)
+    ntp, ng = lo_p.shape[0], glo.shape[0]
+    # Row operand: raw coordinates — rows outside row_mask still get
+    # outputs; callers mask them.
+    xrows = _pad_lanes(points.astype(jnp.float32), d_pad)
+    labf = jnp.where(
+        src_mask & (labels != _INT_INF), labels.astype(jnp.float32), _F_INF
+    ).reshape(nt, 1, block)
+    eps2 = jnp.asarray(eps, jnp.float32).reshape(1) ** 2
+
+    best = pl.pallas_call(
+        functools.partial(
+            _minlab_kernel,
+            precision=_pallas_precision(precision),
+            group=GROUP,
+        ),
+        grid=(nt,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((ntp, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((ntp, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((nt, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((nt, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((ng, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((ng, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (block, d_pad), lambda i: (i, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(memory_space=pltpu.HBM),
+            pl.BlockSpec(memory_space=pltpu.HBM),
+            pl.BlockSpec(memory_space=pltpu.HBM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, block, 1), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((nt, block, 1), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((block, d_pad), jnp.float32),
+            pltpu.VMEM((1, block), jnp.float32),
+            pltpu.VMEM((1, block), jnp.float32),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+        interpret=interpret,
+    )(eps2, lo_p, hi_p, rlo, rhi, glo, ghi, xrows, ycols, ysq, labf)
+    best = best.reshape(-1)
+    return jnp.where(jnp.isfinite(best), best.astype(jnp.int32), _INT_INF)

@@ -185,13 +185,13 @@ def _merge_loop(lab_map, home_label, core_g, h_gid, h_lab, h_core, axis,
     jax.jit,
     static_argnames=(
         "eps", "min_samples", "metric", "block", "mesh", "axis", "n_points",
-        "precision",
+        "precision", "backend",
     ),
 )
 def sharded_step(
     owned, owned_mask, owned_gid, halo, halo_mask, halo_gid,
     *, eps, min_samples, metric, block, mesh, axis, n_points,
-    precision="high",
+    precision="high", backend="auto",
 ):
     """One fully-sharded clustering step: local DBSCAN + global merge.
 
@@ -207,19 +207,22 @@ def sharded_step(
         msk = jnp.concatenate([om, hm], axis=1)
         gid = jnp.concatenate([og, hg], axis=1)
 
-        def one_part(p, m):
+        def one_part(p, m, be):
             return dbscan_fixed_size(
                 p, eps, min_samples, m, metric=metric, block=block,
-                precision=precision,
+                precision=precision, backend=be,
             )
         if pts.shape[0] == 1:
             # One partition per device (the common layout): call directly
-            # so the kernel's lax.cond tile pruning stays a real branch —
-            # vmap would lower cond to select and execute both sides.
-            l1, c1 = one_part(pts[0], msk[0])
+            # so Pallas kernels / lax.cond tile pruning stay usable —
+            # under vmap, cond lowers to select and pallas_call batching
+            # is unsupported for these hand-written DMA kernels.
+            l1, c1 = one_part(pts[0], msk[0], backend)
             labels, core = l1[None], c1[None]
         else:
-            labels, core = jax.vmap(one_part)(pts, msk)
+            labels, core = jax.vmap(
+                functools.partial(one_part, be="xla")
+            )(pts, msk)
         # local root index -> global cluster key (root point gid)
         glabel = jnp.where(
             labels >= 0,
@@ -298,6 +301,7 @@ def sharded_dbscan(
     block: int = 1024,
     mesh: Optional[Mesh] = None,
     precision: str = "high",
+    backend: str = "auto",
 ):
     """Cluster ``points`` over the device mesh.
 
@@ -331,5 +335,6 @@ def sharded_dbscan(
         axis=axis,
         n_points=len(points),
         precision=precision,
+        backend=backend,
     )
     return np.asarray(labels), np.asarray(core), stats
