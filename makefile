@@ -1,0 +1,32 @@
+# Dev shell for pypardis_tpu (parity: reference makefile:10-38, minus the
+# docker registry lifecycle — the TPU runtime is provisioned, not built).
+
+PY ?= python
+
+.PHONY: all wheel native test bench demo clean
+
+all: native test
+
+# Reference `make egg` built the Spark-shippable artifact
+# (makefile:10-11); the TPU equivalent is a wheel.
+wheel:
+	$(PY) setup.py bdist_wheel
+
+# Build the native merge library explicitly (it also auto-builds on
+# first import of pypardis_tpu._native).
+native:
+	g++ -O3 -shared -fPIC -o pypardis_tpu/_native/libpypardis_native.so \
+		pypardis_tpu/_native/unionfind.cpp
+
+test:
+	$(PY) -m pytest tests/ -q
+
+bench:
+	$(PY) bench.py
+
+demo:
+	$(PY) -m pypardis_tpu.demo
+
+clean:
+	rm -rf build dist *.egg-info pypardis_tpu/_native/*.so
+	find . -name __pycache__ -type d -exec rm -rf {} +

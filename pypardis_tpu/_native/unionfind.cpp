@@ -1,0 +1,72 @@
+// Native merge-phase primitives for pypardis_tpu.
+//
+// The reference resolves cross-partition cluster equivalences with a
+// driver-side Python dict aggregation (reference dbscan/aggregator.py:19-63,
+// the README.md:60 driver-memory bottleneck).  The TPU framework's
+// primary merge runs on-device (parallel/sharded.py); this library backs
+// the *host-side* merge utilities (parallel/merge.py) with an array
+// union-find in C++, so resolving multi-million-edge equivalence tables
+// is near-linear and allocation-free instead of a Python dict walk.
+//
+// Semantics match ClusterAggregator: min-id linking — the root of every
+// component is the minimum id it contains (aggregator.py:45's downward
+// merges).
+//
+// Built as a plain shared library (no pybind11 in this image); loaded
+// via ctypes from pypardis_tpu/_native/__init__.py.
+
+#include <cstdint>
+
+namespace {
+
+int64_t find_root(int64_t* parent, int64_t x) {
+  int64_t root = x;
+  while (parent[root] != root) root = parent[root];
+  // Path compression.
+  while (parent[x] != root) {
+    int64_t next = parent[x];
+    parent[x] = root;
+    x = next;
+  }
+  return root;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Union-find over n_nodes dense nodes.  edges: (n_edges, 2) int64 pairs,
+// entries outside [0, n_nodes) are ignored.  out_parent: (n_nodes,)
+// int64, receives the fully-compressed min-id root of every node.
+void uf_resolve_dense(const int64_t* edges, int64_t n_edges,
+                      int64_t n_nodes, int64_t* out_parent) {
+  for (int64_t i = 0; i < n_nodes; ++i) out_parent[i] = i;
+  for (int64_t e = 0; e < n_edges; ++e) {
+    int64_t a = edges[2 * e];
+    int64_t b = edges[2 * e + 1];
+    if (a < 0 || b < 0 || a >= n_nodes || b >= n_nodes) continue;
+    int64_t ra = find_root(out_parent, a);
+    int64_t rb = find_root(out_parent, b);
+    if (ra == rb) continue;
+    // Min-id wins (matches aggregator.py:45).
+    if (ra < rb) {
+      out_parent[rb] = ra;
+    } else {
+      out_parent[ra] = rb;
+    }
+  }
+  for (int64_t i = 0; i < n_nodes; ++i) find_root(out_parent, i);
+}
+
+// Relabel: out[i] = lut[labels[i]] for labels in [0, n_lut), else fill.
+// The trivial loop is here so multi-GB relabel passes skip numpy fancy
+// indexing's temporary allocations.
+void relabel_i32(const int32_t* labels, int64_t n, const int32_t* lut,
+                 int64_t n_lut, int32_t fill, int32_t* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    int32_t l = labels[i];
+    out[i] = (l >= 0 && l < n_lut) ? lut[l] : fill;
+  }
+}
+
+}  // extern "C"

@@ -19,7 +19,7 @@ from typing import Dict, Tuple
 
 import numpy as np
 
-from ..aggregator import UnionFind
+from .._native import uf_resolve_dense
 
 
 def resolve_label_edges(edges: np.ndarray, ids: np.ndarray) -> Dict[int, int]:
@@ -27,15 +27,29 @@ def resolve_label_edges(edges: np.ndarray, ids: np.ndarray) -> Dict[int, int]:
 
     ``ids``: the universe of label ids in play (1-D).  Returns
     {label id -> canonical (minimum) label id of its component}.
+
+    Ids are mapped to dense indices with a vectorized sorted-search and
+    the union loop runs in the native (C++) resolver when available —
+    the Python fallback has identical min-id semantics.  Because
+    ``np.unique``-style sorted ids preserve order, the dense min-root
+    maps back to the minimum original id of the component.
     """
-    ids = np.asarray(ids)
+    ids_sorted = np.unique(np.asarray(ids))
     edges = np.asarray(edges).reshape(-1, 2)
-    index = {int(v): i for i, v in enumerate(ids)}
-    uf = UnionFind(len(ids))
-    for a, b in edges:
-        uf.union(index[int(a)], index[int(b)])
-    roots = uf.roots()
-    return {int(v): int(ids[roots[i]]) for i, v in enumerate(ids)}
+    dense = np.searchsorted(ids_sorted, edges)
+    if len(edges):
+        # searchsorted returns insertion points for missing ids — make
+        # that loud (the dict-based predecessor raised KeyError).
+        clipped = np.clip(dense, 0, len(ids_sorted) - 1)
+        if not np.array_equal(ids_sorted[clipped], edges):
+            missing = edges[(ids_sorted[clipped] != edges).any(axis=1)][0]
+            raise KeyError(
+                f"edge references id(s) not in the id universe: {missing}"
+            )
+    roots = uf_resolve_dense(dense, len(ids_sorted))
+    return {
+        int(v): int(ids_sorted[roots[i]]) for i, v in enumerate(ids_sorted)
+    }
 
 
 def merge_occurrences(

@@ -220,6 +220,12 @@ def sharded_step(
             l1, c1 = one_part(pts[0], msk[0], backend)
             labels, core = l1[None], c1[None]
         else:
+            if backend == "pallas":
+                raise ValueError(
+                    "backend='pallas' requires one partition per device "
+                    "(the vmapped multi-partition layout runs XLA kernels);"
+                    " use backend='auto' or max_partitions <= mesh size"
+                )
             labels, core = jax.vmap(
                 functools.partial(one_part, be="xla")
             )(pts, msk)

@@ -1,0 +1,77 @@
+"""Native (C++) merge resolver vs the pure-Python implementation."""
+
+import numpy as np
+import pytest
+
+from pypardis_tpu._native import (
+    native_available,
+    relabel_i32,
+    uf_resolve_dense,
+)
+from pypardis_tpu.aggregator import UnionFind
+from pypardis_tpu.parallel.merge import resolve_label_edges
+
+
+def test_native_builds_on_this_image():
+    # g++ is baked into the image; the library must compile and load.
+    assert native_available()
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_uf_matches_python_unionfind(seed):
+    rng = np.random.default_rng(seed)
+    n = 500
+    edges = rng.integers(0, n, size=(2000, 2))
+    roots = uf_resolve_dense(edges, n)
+
+    uf = UnionFind(n)
+    for a, b in edges:
+        uf.union(int(a), int(b))
+    assert np.array_equal(roots, uf.roots())
+    # Min-id invariant: every root is the min of its component.
+    for r in np.unique(roots):
+        assert r == np.min(np.nonzero(roots == r)[0])
+
+
+def test_uf_ignores_out_of_range_edges():
+    edges = np.array([[0, 1], [-1, 2], [2, 999], [1, 2]])
+    roots = uf_resolve_dense(edges, 4)
+    assert roots.tolist() == [0, 0, 0, 3]
+
+
+def test_uf_transitive_chain():
+    # A long chain exercises path compression: 0-1, 1-2, ..., n-2 - n-1.
+    n = 10_000
+    chain = np.stack([np.arange(n - 1), np.arange(1, n)], axis=1)
+    roots = uf_resolve_dense(chain[::-1], n)  # reversed order: worst case
+    assert (roots == 0).all()
+
+
+def test_relabel_i32():
+    labels = np.array([0, 2, -1, 5, 3], np.int32)
+    lut = np.array([10, 11, 12, 13], np.int32)
+    out = relabel_i32(labels, lut, fill=-1)
+    assert out.tolist() == [10, 12, -1, -1, 13]
+
+
+def test_resolve_label_edges_sparse_ids():
+    # Non-dense, unsorted id universe — mapping must go through the
+    # sorted-search and come back as original ids.
+    ids = np.array([700, 13, 42, 99])
+    edges = np.array([[42, 700], [99, 13]])
+    mapping = resolve_label_edges(edges, ids)
+    assert mapping == {42: 42, 700: 42, 13: 13, 99: 13}
+
+
+def test_resolve_label_edges_missing_id_raises():
+    import pytest
+
+    with pytest.raises(KeyError):
+        resolve_label_edges(np.array([[5, 9]]), np.array([3, 7, 9, 12]))
+
+
+def test_resolve_label_edges_duplicate_ids():
+    mapping = resolve_label_edges(
+        np.array([[7, 9]]), np.array([9, 7, 7, 9])
+    )
+    assert mapping == {7: 7, 9: 7}
