@@ -27,6 +27,13 @@ from .partition import (
     min_var_split,
 )
 from .dbscan import DBSCAN, dbscan_partition, map_cluster_id
+from .config import DBSCANConfig
+from .checkpoint import (
+    load_model,
+    load_partitioner,
+    save_model,
+    save_partitioner,
+)
 
 __all__ = [
     "BoundingBox",
@@ -37,7 +44,12 @@ __all__ = [
     "mean_var_split",
     "min_var_split",
     "DBSCAN",
+    "DBSCANConfig",
     "dbscan_partition",
     "map_cluster_id",
+    "save_model",
+    "load_model",
+    "save_partitioner",
+    "load_partitioner",
     "__version__",
 ]

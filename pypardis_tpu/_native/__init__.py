@@ -35,11 +35,17 @@ def _build() -> bool:
 
 
 def _load():
-    if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(
-        _SRC
-    ):
-        if not _build():
-            return None
+    try:
+        stale = not os.path.exists(_LIB) or (
+            os.path.exists(_SRC)
+            and os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+        )
+    except OSError:
+        stale = False
+    if stale and not _build():
+        return None
+    if not os.path.exists(_LIB):
+        return None
     try:
         lib = ctypes.CDLL(_LIB)
         lib.uf_resolve_dense.argtypes = [

@@ -1,0 +1,151 @@
+"""Checkpoint / resume.
+
+The reference has none: ``train`` is monolithic and every intermediate
+(bounding boxes, cluster dict, cached RDDs — reference dbscan.py:99-102)
+lives only in driver memory (SURVEY §5).  Here the two things worth
+persisting are cheap and explicit:
+
+* the **partition tree** — axis/boundary metadata, a few KB — so new
+  points can be routed to partitions without re-partitioning;
+* the **model result** — labels, core mask, boxes, hyperparameters — so
+  ``assignments()`` / ``cluster_mapping()`` work after a restart without
+  re-clustering.
+
+Storage is a plain ``.npz`` (numpy) — no orbax dependency needed for
+kilobyte-scale metadata plus label vectors.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from .geometry import BoundingBox
+from .partition import KDPartitioner, route_tree
+
+
+def save_partitioner(part: KDPartitioner, path: str) -> None:
+    """Persist the split tree + boxes (not the points)."""
+    labels = sorted(part.bounding_boxes)
+    lower = np.stack([part.bounding_boxes[l].lower for l in labels])
+    upper = np.stack([part.bounding_boxes[l].upper for l in labels])
+    tree = np.asarray(part.tree, dtype=np.float64).reshape(-1, 5)
+    np.savez(
+        path,
+        kind="kd_partition_tree",
+        k=part.k,
+        split_method=part.split_method,
+        labels=np.asarray(labels),
+        lower=lower,
+        upper=upper,
+        tree=tree,
+    )
+
+
+class PartitionTree:
+    """A loaded partition tree: routing + boxes without the data."""
+
+    def __init__(self, k, split_method, labels, lower, upper, tree):
+        self.k = int(k)
+        self.split_method = str(split_method)
+        self.bounding_boxes = {
+            int(l): BoundingBox(lower=lo, upper=up)
+            for l, lo, up in zip(labels, lower, upper)
+        }
+        self.tree = [
+            (int(p), int(a), float(b), int(lf), int(rt))
+            for p, a, b, lf, rt in tree
+        ]
+
+    @property
+    def n_partitions(self) -> int:
+        return len(self.bounding_boxes)
+
+    def route(self, points: np.ndarray) -> np.ndarray:
+        """Replay the split tree (shared with KDPartitioner.route)."""
+        return route_tree(self.tree, points)
+
+
+def load_partitioner(path: str) -> PartitionTree:
+    with np.load(path, allow_pickle=False) as z:
+        if str(z["kind"]) != "kd_partition_tree":
+            raise ValueError(f"{path} is not a partition-tree checkpoint")
+        return PartitionTree(
+            z["k"], z["split_method"], z["labels"], z["lower"], z["upper"],
+            z["tree"],
+        )
+
+
+def save_model(model, path: str) -> None:
+    """Persist a trained DBSCAN's results + hyperparameters."""
+    if model.labels_ is None:
+        raise ValueError("model is untrained; nothing to checkpoint")
+    boxes = model.bounding_boxes or {}
+    labels = sorted(boxes)
+    params = {
+        "eps": model.eps,
+        "min_samples": model.min_samples,
+        "metric": model.metric
+        if isinstance(model.metric, str)
+        else getattr(model.metric, "__name__", "euclidean"),
+        "max_partitions": model.max_partitions,
+        "split_method": model.split_method,
+        "block": model.block,
+        "precision": model.precision,
+        "kernel_backend": model.kernel_backend,
+    }
+    np.savez(
+        path,
+        kind="dbscan_model",
+        params=json.dumps(params),
+        labels_=model.labels_,
+        core_sample_mask_=model.core_sample_mask_,
+        keys=np.asarray(model._keys),
+        box_labels=np.asarray(labels, dtype=np.int64),
+        box_lower=np.stack([boxes[l].lower for l in labels])
+        if labels
+        else np.zeros((0, 0)),
+        box_upper=np.stack([boxes[l].upper for l in labels])
+        if labels
+        else np.zeros((0, 0)),
+        metrics=json.dumps(model.metrics_),
+    )
+
+
+def load_model(path: str):
+    """Rebuild a DBSCAN whose result surface works without retraining."""
+    from .dbscan import DBSCAN
+
+    with np.load(path, allow_pickle=False) as z:
+        if str(z["kind"]) != "dbscan_model":
+            raise ValueError(f"{path} is not a DBSCAN model checkpoint")
+        params = json.loads(str(z["params"]))
+        model = DBSCAN(
+            eps=params["eps"],
+            min_samples=params["min_samples"],
+            metric=params["metric"],
+            max_partitions=params["max_partitions"],
+            split_method=params["split_method"],
+            block=params["block"],
+            precision=params["precision"],
+            kernel_backend=params["kernel_backend"],
+        )
+        model.labels_ = z["labels_"]
+        model.core_sample_mask_ = z["core_sample_mask_"]
+        model._keys = z["keys"]
+        model.bounding_boxes = {
+            int(l): BoundingBox(lower=lo, upper=up)
+            for l, lo, up in zip(
+                z["box_labels"], z["box_lower"], z["box_upper"]
+            )
+        }
+        model.expanded_boxes = {
+            l: b.expand(2 * model.eps)
+            for l, b in model.bounding_boxes.items()
+        }
+        model.metrics_ = json.loads(str(z["metrics"]))
+        model.result = list(
+            zip(model._keys.tolist(), model.labels_.tolist())
+        )
+    return model

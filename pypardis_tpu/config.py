@@ -1,0 +1,44 @@
+"""Config surface.
+
+The reference's configuration is constructor kwargs only — no files, env
+vars, or CLI (reference dbscan.py:74-75, partition.py:111-112; SURVEY
+§5).  The dataclass mirrors that surface one-to-one, adds the TPU-native
+knobs, and gives the validation/defaulting the reference did inline
+(silent ``split_method`` fallback at partition.py:129-130 is reproduced
+by ``KDPartitioner`` itself).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+
+@dataclasses.dataclass
+class DBSCANConfig:
+    """Everything ``DBSCAN(...)`` accepts, as serializable data."""
+
+    eps: float = 0.5
+    min_samples: int = 5
+    metric: Any = "euclidean"
+    max_partitions: Optional[int] = None
+    split_method: str = "min_var"
+    block: int = 1024
+    precision: str = "high"
+    kernel_backend: str = "auto"
+
+    def build(self, mesh=None):
+        from .dbscan import DBSCAN
+
+        return DBSCAN(mesh=mesh, **dataclasses.asdict(self))
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        if callable(d["metric"]):
+            d["metric"] = getattr(d["metric"], "__name__", "euclidean")
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "DBSCANConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})

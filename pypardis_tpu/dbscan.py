@@ -30,6 +30,7 @@ from .geometry import BoundingBox
 from .ops import dbscan_fixed_size, densify_labels
 from .partition import KDPartitioner, spatial_order
 from .utils import clamp_block, round_up
+from .utils.log import log_phase
 
 
 def _as_keys_points(data):
@@ -221,6 +222,13 @@ class DBSCAN:
         self.metrics_["points_per_sec"] = len(points) / max(
             self.metrics_["total_s"], 1e-9
         )
+        log_phase(
+            "train",
+            n=len(points),
+            clusters=int(self.labels_.max()) + 1 if len(points) else 0,
+            **{k: round(v, 4) for k, v in self.metrics_.items()
+               if isinstance(v, float)},
+        )
         self.result = list(zip(self._keys.tolist(), self.labels_.tolist()))
         return self
 
@@ -305,6 +313,24 @@ class DBSCAN:
         self.metrics_.update(stats)
         self.metrics_["n_partitions"] = part.n_partitions
         self.cluster_dict = None  # built lazily by cluster_mapping()
+
+    def save(self, path: str) -> None:
+        """Checkpoint the trained model (labels, boxes, hyperparams)."""
+        from .checkpoint import save_model
+
+        save_model(self, path)
+
+    @classmethod
+    def load(cls, path: str) -> "DBSCAN":
+        """Restore a checkpointed model; result surface works without
+        retraining (the reference had no persistence at all, SURVEY §5)."""
+        from .checkpoint import load_model
+
+        return load_model(path)
+
+    @classmethod
+    def from_config(cls, config, mesh=None) -> "DBSCAN":
+        return config.build(mesh=mesh)
 
     def cluster_mapping(self) -> ClusterAggregator:
         """Host-side ClusterAggregator over the final labels, for parity

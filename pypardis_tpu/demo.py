@@ -62,10 +62,12 @@ def run_demo(n: int = 750, eps: float = 0.3, min_samples: int = 10,
         pass
 
     if out:
-        # The partitioning figures show the same KD split the clustering
-        # would use when distributed (4 boxes by default, matching the
-        # reference's plots/).
-        part = KDPartitioner(X, max_partitions=max_partitions or 4)
+        # Prefer the split the clustering actually used (sharded runs
+        # populate partitioner_); single-device runs have no split, so
+        # build an illustrative one matching the reference's 4-box plots.
+        part = model.partitioner_ or KDPartitioner(
+            X, max_partitions=max_partitions or 4
+        )
         _plots(X, labels, part, out)
     return labels
 

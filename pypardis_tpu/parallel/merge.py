@@ -40,6 +40,11 @@ def resolve_label_edges(edges: np.ndarray, ids: np.ndarray) -> Dict[int, int]:
     if len(edges):
         # searchsorted returns insertion points for missing ids — make
         # that loud (the dict-based predecessor raised KeyError).
+        if len(ids_sorted) == 0:
+            raise KeyError(
+                f"edge references id(s) not in the empty id universe: "
+                f"{edges[0]}"
+            )
         clipped = np.clip(dense, 0, len(ids_sorted) - 1)
         if not np.array_equal(ids_sorted[clipped], edges):
             missing = edges[(ids_sorted[clipped] != edges).any(axis=1)][0]

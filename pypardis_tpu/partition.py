@@ -111,6 +111,26 @@ def morton_codes(points: np.ndarray, bits: int = 10, max_axes: int = 6):
     return codes
 
 
+def route_tree(tree, points: np.ndarray) -> np.ndarray:
+    """Assign points to partitions by replaying a split tree.
+
+    ``tree``: iterable of (parent_label, axis, boundary, left_label,
+    right_label) in construction order — the format produced by
+    :class:`KDPartitioner` and round-tripped by
+    :mod:`pypardis_tpu.checkpoint`.  Left children keep the parent
+    label; points with coordinate >= boundary go right (strict ``<``
+    stays left, matching the reference's split semantics,
+    partition.py:27-30).
+    """
+    points = np.asarray(points, dtype=np.float64)
+    labels = np.zeros(len(points), dtype=np.int32)
+    for parent, axis, boundary, _left, right in tree:
+        mask = labels == int(parent)
+        go_right = mask & (points[:, int(axis)] >= boundary)
+        labels[go_right] = int(right)
+    return labels
+
+
 def spatial_order(points: np.ndarray) -> np.ndarray:
     """An index permutation grouping spatially nearby points.
 
@@ -270,11 +290,4 @@ class KDPartitioner:
 
     def route(self, points: np.ndarray) -> np.ndarray:
         """Assign new points to partitions by replaying the split tree."""
-        points = np.asarray(points, dtype=np.float64)
-        labels = np.zeros(len(points), dtype=np.int32)
-        for parent, axis, boundary, left, right in self.tree:
-            mask = labels == parent
-            go_right = mask & (points[:, axis] >= boundary)
-            labels[go_right] = right
-            # left keeps the parent label — nothing to write.
-        return labels
+        return route_tree(self.tree, points)

@@ -1,0 +1,41 @@
+"""Structured logging.
+
+The reference's entire observability surface is a module flag that is
+never read (``LOGGING = False``, reference dbscan.py:9).  This module is
+the working version: a package logger plus the same flag name as a
+convenience switch.  ``LOGGING = True`` (or standard ``logging``
+configuration) enables per-phase driver logs.
+"""
+
+from __future__ import annotations
+
+import logging
+
+# Parity with the reference's flag name (dbscan.py:9) — but read.
+LOGGING = False
+
+_logger = logging.getLogger("pypardis_tpu")
+
+
+def get_logger() -> logging.Logger:
+    return _logger
+
+
+def enable(level: int = logging.INFO) -> None:
+    """Convenience switch: attach a stderr handler at ``level``."""
+    global LOGGING
+    LOGGING = True
+    if not _logger.handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(
+            logging.Formatter("[%(name)s %(levelname)s] %(message)s")
+        )
+        _logger.addHandler(h)
+    _logger.setLevel(level)
+
+
+def log_phase(phase: str, **fields) -> None:
+    """One structured line per pipeline phase (no-op unless enabled)."""
+    if LOGGING or _logger.isEnabledFor(logging.INFO):
+        kv = " ".join(f"{k}={v}" for k, v in fields.items())
+        _logger.info("%s %s", phase, kv)

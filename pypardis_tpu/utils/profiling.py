@@ -1,0 +1,58 @@
+"""Per-phase timing + device tracing.
+
+The reference has no instrumentation at all — its only observability was
+the Spark web UI and a dead ``LOGGING`` flag (reference dbscan.py:9,
+SURVEY §5).  Here the driver phases (partition / shard / cluster / merge)
+report wall time through :class:`PhaseTimer`, and :func:`trace` wraps
+``jax.profiler`` so a device trace of the whole pipeline is one context
+manager away (view in TensorBoard / Perfetto).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict
+
+
+class PhaseTimer:
+    """Accumulate named phase durations; device-synchronizing on exit.
+
+    >>> t = PhaseTimer()
+    >>> with t.phase("cluster"):
+    ...     labels = kernel(...)
+    >>> t.as_dict()  # {"cluster_s": 0.123}
+    """
+
+    def __init__(self, sync: bool = False):
+        self.phases: Dict[str, float] = {}
+        self._sync = sync
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield self
+        finally:
+            if self._sync:
+                import jax
+
+                # Barrier on every device — a trivial op on the default
+                # device alone would under-report sharded phases.
+                for dev in jax.devices():
+                    jax.device_put(0, dev).block_until_ready()
+            self.phases[f"{name}_s"] = self.phases.get(
+                f"{name}_s", 0.0
+            ) + (time.perf_counter() - t0)
+
+    def as_dict(self) -> Dict[str, float]:
+        return dict(self.phases)
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """Capture a jax.profiler device trace of the enclosed block."""
+    import jax
+
+    with jax.profiler.trace(logdir):
+        yield

@@ -1,0 +1,97 @@
+"""Aux subsystems: checkpoint/resume, config, logging, profiling.
+
+The reference has none of these (SURVEY §5) — these tests pin the
+TPU-native replacements.
+"""
+
+import logging
+
+import numpy as np
+import pytest
+
+from pypardis_tpu import (
+    DBSCAN,
+    DBSCANConfig,
+    KDPartitioner,
+    load_model,
+    load_partitioner,
+    save_partitioner,
+)
+from pypardis_tpu.utils.log import enable, get_logger, log_phase
+from pypardis_tpu.utils.profiling import PhaseTimer
+
+
+def test_partitioner_checkpoint_roundtrip(tmp_path, blobs750):
+    part = KDPartitioner(blobs750, max_partitions=8)
+    path = str(tmp_path / "tree.npz")
+    save_partitioner(part, path)
+    tree = load_partitioner(path)
+    assert tree.n_partitions == part.n_partitions
+    assert tree.k == part.k
+    # Routing through the loaded tree matches the original assignment.
+    assert np.array_equal(tree.route(blobs750), part.route(blobs750))
+    for l, box in part.bounding_boxes.items():
+        assert tree.bounding_boxes[l] == box
+
+
+def test_model_checkpoint_roundtrip(tmp_path, blobs750):
+    model = DBSCAN(eps=0.3, min_samples=10).fit(blobs750)
+    path = str(tmp_path / "model.npz")
+    model.save(path)
+    back = DBSCAN.load(path)
+    assert np.array_equal(back.labels_, model.labels_)
+    assert np.array_equal(back.core_sample_mask_, model.core_sample_mask_)
+    assert back.eps == model.eps
+    assert back.assignments() == model.assignments()
+    assert back.bounding_boxes.keys() == model.bounding_boxes.keys()
+
+
+def test_untrained_model_save_raises(tmp_path):
+    with pytest.raises(ValueError):
+        DBSCAN().save(str(tmp_path / "x.npz"))
+
+
+def test_checkpoint_kind_mismatch(tmp_path, blobs750):
+    part = KDPartitioner(blobs750, max_partitions=4)
+    path = str(tmp_path / "tree.npz")
+    save_partitioner(part, path)
+    with pytest.raises(ValueError):
+        load_model(path)
+
+
+def test_config_roundtrip():
+    cfg = DBSCANConfig(eps=0.7, min_samples=3, block=256)
+    model = cfg.build()
+    assert model.eps == 0.7 and model.min_samples == 3
+    d = cfg.to_dict()
+    assert DBSCANConfig.from_dict(d) == cfg
+    # Unknown keys are ignored, not fatal.
+    assert DBSCANConfig.from_dict({**d, "bogus": 1}) == cfg
+
+
+def test_config_build_is_trainable(blobs750):
+    labels = DBSCANConfig(eps=0.3, min_samples=10).build().fit_predict(
+        blobs750
+    )
+    assert labels.max() == 2
+
+
+def test_logging_phase(caplog):
+    enable(logging.INFO)
+    with caplog.at_level(logging.INFO, logger="pypardis_tpu"):
+        log_phase("cluster", n=10, t=0.5)
+    assert any("cluster" in r.message for r in caplog.records)
+    assert get_logger().name == "pypardis_tpu"
+
+
+def test_phase_timer():
+    t = PhaseTimer()
+    with t.phase("a"):
+        pass
+    with t.phase("a"):
+        pass
+    with t.phase("b"):
+        pass
+    d = t.as_dict()
+    assert set(d) == {"a_s", "b_s"}
+    assert d["a_s"] >= 0
