@@ -95,13 +95,19 @@ def save_model(model, path: str) -> None:
         "precision": model.precision,
         "kernel_backend": model.kernel_backend,
     }
+    keys = np.asarray(model._keys)
+    if keys.dtype == object:
+        # Object keys would require pickle, which load_model refuses
+        # (allow_pickle=False); store their string form instead and say
+        # so loudly rather than writing an unreadable checkpoint.
+        keys = keys.astype(str)
     np.savez(
         path,
         kind="dbscan_model",
         params=json.dumps(params),
         labels_=model.labels_,
         core_sample_mask_=model.core_sample_mask_,
-        keys=np.asarray(model._keys),
+        keys=keys,
         box_labels=np.asarray(labels, dtype=np.int64),
         box_lower=np.stack([boxes[l].lower for l in labels])
         if labels

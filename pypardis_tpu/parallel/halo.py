@@ -1,0 +1,99 @@
+"""Device-resident halo exchange over the mesh interconnect.
+
+``build_shards`` (sharded.py) materializes each partition's 2*eps halo on
+the **host** with a vectorized box query — fine when points start on the
+host anyway.  This module is the device-resident alternative for data
+that already lives sharded on the mesh: each device's owned slab rides a
+**ring** of ``ppermute`` steps (ICI neighbor exchanges, the same pattern
+ring attention uses for KV blocks), and every device filters the passing
+slabs against its own 2*eps-expanded bounding box, compacting matches
+into a fixed-capacity halo buffer.
+
+This replaces the reference's neighborhood duplication
+(``/root/reference/dbscan/dbscan.py:136-151`` — a Spark filter+union per
+partition over the whole dataset) with P-1 neighbor exchanges and no
+host round-trip.  Capacity is static (XLA shapes): callers size ``hcap``
+and the returned ``overflow`` count says whether any in-box point had to
+be dropped — the driver treats overflow as an error and re-runs with a
+bigger capacity.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _compact_merge(halo, hmask, hgid, pts, valid, gid):
+    """Merge flagged candidates into the fixed-size halo buffer.
+
+    Stable sort by validity (valid rows first) over the concatenation,
+    then keep the first hcap rows.  Stability keeps earlier halo entries
+    in place, so repeated merges never reorder accepted points.
+    """
+    hcap = halo.shape[0]
+    cat_pts = jnp.concatenate([halo, pts], axis=0)
+    cat_msk = jnp.concatenate([hmask, valid], axis=0)
+    cat_gid = jnp.concatenate([hgid, gid], axis=0)
+    order = jnp.argsort(~cat_msk, stable=True)
+    return (
+        cat_pts[order[:hcap]],
+        cat_msk[order[:hcap]],
+        cat_gid[order[:hcap]],
+        jnp.sum(cat_msk.astype(jnp.int32)) - jnp.sum(
+            cat_msk[order[:hcap]].astype(jnp.int32)
+        ),
+    )
+
+
+def ring_halo_exchange(
+    owned: jnp.ndarray,
+    mask: jnp.ndarray,
+    gid: jnp.ndarray,
+    box_lo: jnp.ndarray,
+    box_hi: jnp.ndarray,
+    hcap: int,
+    axis: str,
+):
+    """Collect every remote point inside this device's expanded box.
+
+    Must run inside ``shard_map``.  ``owned``: (cap, k) this device's
+    points; ``mask``: (cap,) validity; ``gid``: (cap,) global point ids.
+    ``box_lo``/``box_hi``: (k,) this device's bounding box already
+    expanded by 2*eps (the reference's duplication rule, README.md:20).
+    Returns ``(halo, halo_mask, halo_gid, overflow)`` with leading
+    dimension ``hcap``; ``overflow`` counts in-box points dropped because
+    the buffer filled — callers must treat nonzero as an error.
+    """
+    n_dev = jax.lax.axis_size(axis)
+    cap, k = owned.shape
+    halo = jnp.zeros((hcap, k), owned.dtype)
+    hmask = jnp.zeros((hcap,), bool)
+    hgid = jnp.full((hcap,), jnp.int32(2**31 - 1))
+
+    perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+
+    def step(_i, state):
+        buf_pts, buf_msk, buf_gid, halo, hmask, hgid, overflow = state
+        buf_pts = jax.lax.ppermute(buf_pts, axis, perm)
+        buf_msk = jax.lax.ppermute(buf_msk, axis, perm)
+        buf_gid = jax.lax.ppermute(buf_gid, axis, perm)
+        inbox = (
+            buf_msk
+            & jnp.all(buf_pts >= box_lo[None, :], axis=1)
+            & jnp.all(buf_pts <= box_hi[None, :], axis=1)
+        )
+        halo, hmask, hgid, dropped = _compact_merge(
+            halo, hmask, hgid, buf_pts, inbox, buf_gid
+        )
+        return (
+            buf_pts, buf_msk, buf_gid, halo, hmask, hgid,
+            overflow + dropped,
+        )
+
+    # fori_loop (not a Python unroll): the traced program stays O(1) in
+    # mesh size — 255-device rings compile the same graph as 8-device.
+    state = (owned, mask, gid, halo, hmask, hgid, jnp.int32(0))
+    state = jax.lax.fori_loop(0, n_dev - 1, step, state)
+    _, _, _, halo, hmask, hgid, overflow = state
+    return halo, hmask, hgid, overflow

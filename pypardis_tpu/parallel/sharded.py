@@ -47,6 +47,67 @@ from ..utils import clamp_block, round_up
 _INT_INF = jnp.iinfo(jnp.int32).max
 
 
+def _expanded_frame(points, partitioner, eps):
+    """The recentred float32 frame shared by every halo path.
+
+    Returns (pts32, exp_lo, exp_hi, labels): points recentred on the
+    dataset mean, and each sorted partition's 2*eps-expanded box in the
+    same frame.  All halo membership decisions — host box query and
+    device-side ring filter — must evaluate in exactly these numbers so
+    borderline points land identically everywhere.
+    """
+    points = np.asarray(points, dtype=np.float64)
+    center = points.mean(axis=0)
+    pts32 = (points - center).astype(np.float32)
+    labels = sorted(partitioner.partitions)
+    stack = BoxStack.from_boxes(
+        partitioner.bounding_boxes[l] for l in labels
+    )
+    exp = stack.expand(2 * eps)
+    exp_lo = (exp.lower - center).astype(np.float32)
+    exp_hi = (exp.upper - center).astype(np.float32)
+    return pts32, exp_lo, exp_hi, labels
+
+
+def _owned_layout(points, pts32, partitioner, labels, n_shards, block):
+    """(P, cap, ...) owned slabs, Morton-sorted per partition."""
+    n, k = pts32.shape
+    p_real = len(labels)
+    p_total = round_up(max(p_real, n_shards), n_shards)
+    owned_idx = [
+        idx[spatial_order(points[idx])] if len(idx) else idx
+        for idx in (partitioner.partitions[l] for l in labels)
+    ]
+    cap = round_up(max(len(i) for i in owned_idx), block)
+    owned = np.zeros((p_total, cap, k), np.float32)
+    owned_mask = np.zeros((p_total, cap), bool)
+    owned_gid = np.full((p_total, cap), n, np.int32)
+    for j, oi in enumerate(owned_idx):
+        owned[j, : len(oi)] = pts32[oi]
+        owned_mask[j, : len(oi)] = True
+        owned_gid[j, : len(oi)] = oi
+    return owned_idx, (owned, owned_mask, owned_gid), cap, p_total
+
+
+def build_owned_shards(points, partitioner, eps, n_shards, block):
+    """Ring-mode layout: owned slabs + expanded boxes, NO host halos.
+
+    The halo sets are never materialized on the host — sizing and
+    duplication happen device-side (halo.ring_halo_exchange).
+    """
+    points = np.asarray(points, dtype=np.float64)
+    pts32, exp_lo, exp_hi, labels = _expanded_frame(points, partitioner, eps)
+    _, arrays, cap, p_total = _owned_layout(
+        points, pts32, partitioner, labels, n_shards, block
+    )
+    stats = {
+        "owned_cap": cap,
+        "n_shard_partitions": p_total,
+        "pad_waste": float(p_total * cap) / max(len(points), 1) - 1.0,
+    }
+    return arrays, exp_lo, exp_hi, labels, stats
+
+
 def build_shards(points, partitioner, eps, n_shards, block):
     """Lay out points as (P, cap, k) owned slabs + (P, hcap, k) halo slabs.
 
@@ -60,45 +121,26 @@ def build_shards(points, partitioner, eps, n_shards, block):
     """
     points = np.asarray(points, dtype=np.float64)
     n, k = points.shape
-    center = points.mean(axis=0)
-    pts32 = (points - center).astype(np.float32)
-
-    labels = sorted(partitioner.partitions)
-    p_real = len(labels)
-    p_total = round_up(max(p_real, n_shards), n_shards)
-
-    stack = BoxStack.from_boxes(partitioner.bounding_boxes[l] for l in labels)
-    # membership of every point in every expanded box: (N, P_real)
-    member = stack.expand(2 * eps).membership(points)
-    owned_idx = [partitioner.partitions[l] for l in labels]
+    pts32, exp_lo, exp_hi, labels = _expanded_frame(points, partitioner, eps)
+    # Membership of every point in every expanded box: (N, P_real),
+    # evaluated in the shared recentred float32 frame (f32 values promote
+    # exactly into BoxStack's f64 comparison).
+    member = BoxStack(exp_lo, exp_hi).membership(pts32)
     halo_idx = []
-    for j, idx in enumerate(owned_idx):
+    for j, l in enumerate(labels):
         m = member[:, j].copy()
-        m[idx] = False
-        halo_idx.append(np.nonzero(m)[0])
+        m[partitioner.partitions[l]] = False
+        idx = np.nonzero(m)[0]
+        halo_idx.append(idx[spatial_order(points[idx])] if len(idx) else idx)
 
-    # Spatially sort each slab (Morton order) so the kernel's tile-level
-    # bbox pruning bites within every shard.
-    def _sorted_slab(idx):
-        return idx[spatial_order(points[idx])] if len(idx) else idx
-
-    owned_idx = [_sorted_slab(i) for i in owned_idx]
-    halo_idx = [_sorted_slab(i) for i in halo_idx]
-
-    cap = round_up(max(len(i) for i in owned_idx), block)
+    owned_idx, (owned, owned_mask, owned_gid), cap, p_total = _owned_layout(
+        points, pts32, partitioner, labels, n_shards, block
+    )
     hcap = round_up(max(max((len(h) for h in halo_idx), default=1), 1), block)
-
-    owned = np.zeros((p_total, cap, k), np.float32)
-    owned_mask = np.zeros((p_total, cap), bool)
-    owned_gid = np.full((p_total, cap), n, np.int32)
     halo = np.zeros((p_total, hcap, k), np.float32)
     halo_mask = np.zeros((p_total, hcap), bool)
     halo_gid = np.full((p_total, hcap), n, np.int32)
-    for j in range(p_real):
-        oi, hi = owned_idx[j], halo_idx[j]
-        owned[j, : len(oi)] = pts32[oi]
-        owned_mask[j, : len(oi)] = True
-        owned_gid[j, : len(oi)] = oi
+    for j, hi in enumerate(halo_idx):
         halo[j, : len(hi)] = pts32[hi]
         halo_mask[j, : len(hi)] = True
         halo_gid[j, : len(hi)] = hi
@@ -199,88 +241,14 @@ def sharded_step(
     outputs are replicated (N,) final labels and core flags.  This is
     the whole distributed hot path in one compiled program.
     """
-    n1 = n_points + 1
 
     def per_device(o, om, og, h, hm, hg):
-        # o: (L, cap, k) — this device's partitions.
-        pts = jnp.concatenate([o, h], axis=1)
-        msk = jnp.concatenate([om, hm], axis=1)
-        gid = jnp.concatenate([og, hg], axis=1)
-
-        def one_part(p, m, be):
-            return dbscan_fixed_size(
-                p, eps, min_samples, m, metric=metric, block=block,
-                precision=precision, backend=be,
-            )
-        if pts.shape[0] == 1:
-            # One partition per device (the common layout): call directly
-            # so Pallas kernels / lax.cond tile pruning stay usable —
-            # under vmap, cond lowers to select and pallas_call batching
-            # is unsupported for these hand-written DMA kernels.
-            l1, c1 = one_part(pts[0], msk[0], backend)
-            labels, core = l1[None], c1[None]
-        else:
-            if backend == "pallas":
-                raise ValueError(
-                    "backend='pallas' requires one partition per device "
-                    "(the vmapped multi-partition layout runs XLA kernels);"
-                    " use backend='auto' or max_partitions <= mesh size"
-                )
-            labels, core = jax.vmap(
-                functools.partial(one_part, be="xla")
-            )(pts, msk)
-        # local root index -> global cluster key (root point gid)
-        glabel = jnp.where(
-            labels >= 0,
-            jnp.take_along_axis(gid, jnp.clip(labels, 0, None), axis=1),
-            -1,
-        ).astype(jnp.int32)
-
-        l_cap = o.shape[1]
-        own_glab, halo_glab = glabel[:, :l_cap], glabel[:, l_cap:]
-        # Only home-run core status feeds the merge (aggregator.py:38-40
-        # semantics); halo-run core flags are intentionally unused.
-        own_core = core[:, :l_cap]
-
-        # Replicated (N+1,) per-point facts from owned slots (each gid is
-        # owned by exactly one shard; padded slots hit the dump row n1-1).
-        og_flat = og.reshape(-1)
-        home_label = (
-            jnp.full((n1,), -1, jnp.int32)
-            .at[og_flat]
-            .max(own_glab.reshape(-1))
+        return _device_cluster_merge(
+            o, om, og, h, hm, hg,
+            eps=eps, min_samples=min_samples, metric=metric, block=block,
+            precision=precision, backend=backend, axis=axis,
+            n_points=n_points,
         )
-        home_label = jax.lax.pmax(home_label, axis)
-        core_g = (
-            jnp.zeros((n1,), jnp.bool_)
-            .at[og_flat]
-            .max(own_core.reshape(-1))
-        )
-        core_g = jax.lax.pmax(core_g, axis)
-        home_label = home_label.at[n1 - 1].set(-1)
-        core_g = core_g.at[n1 - 1].set(False)
-
-        # Halo occurrence tables for the merge (this device's shards).
-        h_gid = hg.reshape(-1)
-        h_lab = halo_glab.reshape(-1)
-        h_core = core_g[jnp.clip(h_gid, 0, n1 - 1)] & (h_gid < n_points)
-
-        # lab_map over cluster keys starts as the identity; propagation
-        # only ever reads entries at live label values.
-        lab_map = jnp.arange(n1, dtype=jnp.int32)
-
-        lab_map = _merge_loop(
-            lab_map, home_label, core_g, h_gid, h_lab, h_core, axis,
-            max_rounds=32,
-        )
-
-        final = jnp.where(
-            home_label >= 0,
-            lab_map[jnp.clip(home_label, 0, n1 - 1)],
-            -1,
-        )
-        final = jnp.where(final == _INT_INF, -1, final)
-        return final[:n_points], core_g[:n_points]
 
     spec = P("p", None, None)
     spec2 = P("p", None)
@@ -291,6 +259,144 @@ def sharded_step(
         out_specs=(P(), P()),
         check_vma=False,
     )(owned, owned_mask, owned_gid, halo, halo_mask, halo_gid)
+
+
+def _device_cluster_merge(
+    o, om, og, h, hm, hg, *, eps, min_samples, metric, block, precision,
+    backend, axis, n_points,
+):
+    """Shared shard_map body: per-partition DBSCAN + in-graph merge.
+
+    ``o``: (L, cap, k) — this device's partitions; halo slabs ``h`` may
+    come from the host layout (build_shards) or a device-side ring
+    exchange (halo.ring_halo_exchange).
+    """
+    n1 = n_points + 1
+    pts = jnp.concatenate([o, h], axis=1)
+    msk = jnp.concatenate([om, hm], axis=1)
+    gid = jnp.concatenate([og, hg], axis=1)
+
+    def one_part(p, m, be):
+        return dbscan_fixed_size(
+            p, eps, min_samples, m, metric=metric, block=block,
+            precision=precision, backend=be,
+        )
+    if pts.shape[0] == 1:
+        # One partition per device (the common layout): call directly
+        # so Pallas kernels / lax.cond tile pruning stay usable —
+        # under vmap, cond lowers to select and pallas_call batching
+        # is unsupported for these hand-written DMA kernels.
+        l1, c1 = one_part(pts[0], msk[0], backend)
+        labels, core = l1[None], c1[None]
+    else:
+        if backend == "pallas":
+            raise ValueError(
+                "backend='pallas' requires one partition per device "
+                "(the vmapped multi-partition layout runs XLA kernels);"
+                " use backend='auto' or max_partitions <= mesh size"
+            )
+        labels, core = jax.vmap(
+            functools.partial(one_part, be="xla")
+        )(pts, msk)
+    # local root index -> global cluster key (root point gid)
+    glabel = jnp.where(
+        labels >= 0,
+        jnp.take_along_axis(gid, jnp.clip(labels, 0, None), axis=1),
+        -1,
+    ).astype(jnp.int32)
+
+    l_cap = o.shape[1]
+    own_glab, halo_glab = glabel[:, :l_cap], glabel[:, l_cap:]
+    # Only home-run core status feeds the merge (aggregator.py:38-40
+    # semantics); halo-run core flags are intentionally unused.
+    own_core = core[:, :l_cap]
+
+    # Replicated (N+1,) per-point facts from owned slots (each gid is
+    # owned by exactly one shard; padded slots hit the dump row n1-1).
+    og_flat = og.reshape(-1)
+    home_label = (
+        jnp.full((n1,), -1, jnp.int32)
+        .at[og_flat]
+        .max(own_glab.reshape(-1))
+    )
+    home_label = jax.lax.pmax(home_label, axis)
+    core_g = (
+        jnp.zeros((n1,), jnp.bool_)
+        .at[og_flat]
+        .max(own_core.reshape(-1))
+    )
+    core_g = jax.lax.pmax(core_g, axis)
+    home_label = home_label.at[n1 - 1].set(-1)
+    core_g = core_g.at[n1 - 1].set(False)
+
+    # Halo occurrence tables for the merge (this device's shards).
+    h_gid = hg.reshape(-1)
+    h_lab = halo_glab.reshape(-1)
+    h_core = core_g[jnp.clip(h_gid, 0, n1 - 1)] & (h_gid < n_points)
+
+    # lab_map over cluster keys starts as the identity; propagation
+    # only ever reads entries at live label values.
+    lab_map = jnp.arange(n1, dtype=jnp.int32)
+
+    lab_map = _merge_loop(
+        lab_map, home_label, core_g, h_gid, h_lab, h_core, axis,
+        max_rounds=32,
+    )
+
+    final = jnp.where(
+        home_label >= 0,
+        lab_map[jnp.clip(home_label, 0, n1 - 1)],
+        -1,
+    )
+    final = jnp.where(final == _INT_INF, -1, final)
+    return final[:n_points], core_g[:n_points]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "eps", "min_samples", "metric", "block", "mesh", "axis", "n_points",
+        "precision", "backend", "hcap",
+    ),
+)
+def sharded_step_ring(
+    owned, owned_mask, owned_gid, exp_lo, exp_hi,
+    *, eps, min_samples, metric, block, mesh, axis, n_points,
+    precision="high", backend="auto", hcap,
+):
+    """Sharded clustering with a device-resident ring halo exchange.
+
+    Like :func:`sharded_step`, but halos never touch the host: each
+    device's owned slab circulates the ring (``ppermute`` over ICI) and
+    every device keeps the points inside its 2*eps-expanded box
+    (:mod:`pypardis_tpu.parallel.halo`).  Requires one partition per
+    device.  Returns ``(labels, core, overflow)`` — ``overflow`` is the
+    per-device count of in-box points dropped for capacity; nonzero
+    means rerun with a larger ``hcap``.
+    """
+    from .halo import ring_halo_exchange
+
+    def per_device(o, om, og, lo, hi):
+        h, hm, hg, ovf = ring_halo_exchange(
+            o[0], om[0], og[0], lo[0], hi[0], hcap, axis
+        )
+        final, core_g = _device_cluster_merge(
+            o, om, og, h[None], hm[None], hg[None],
+            eps=eps, min_samples=min_samples, metric=metric, block=block,
+            precision=precision, backend=backend, axis=axis,
+            n_points=n_points,
+        )
+        return final, core_g, ovf[None]
+
+    spec = P("p", None, None)
+    spec2 = P("p", None)
+    return jax.shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(spec, spec2, spec2, spec2, spec2),
+        out_specs=(P(), P(), P("p")),
+        check_vma=False,
+    )(owned, owned_mask, owned_gid, exp_lo, exp_hi)
 
 
 # ---------------------------------------------------------------------------
@@ -308,11 +414,22 @@ def sharded_dbscan(
     mesh: Optional[Mesh] = None,
     precision: str = "high",
     backend: str = "auto",
+    halo: str = "host",
+    hcap: Optional[int] = None,
 ):
     """Cluster ``points`` over the device mesh.
 
     Returns ``(labels, core, stats)`` where labels are global root-gid
     labels (-1 noise) for the original point order.
+
+    ``halo``: ``"host"`` materializes halo slabs on the host from one
+    vectorized box query (build_shards); ``"ring"`` ships only owned
+    slabs and exchanges halos device-side via ``ppermute`` over the
+    mesh interconnect (requires exactly one partition per device; the
+    host never computes halo sets).  ``hcap`` caps the ring halo buffer
+    per device (rounded up to a block multiple) and overflow raises;
+    ``None`` starts at half the owned capacity and doubles on overflow
+    (each retry recompiles).
     """
     from ..ops.distances import _norm_metric
     from .mesh import default_mesh
@@ -328,8 +445,58 @@ def sharded_dbscan(
     approx = max(len(p) for p in partitioner.partitions.values())
     block = clamp_block(block, approx)
 
-    arrays, stats = build_shards(points, partitioner, eps, n_shards, block)
     sharding = NamedSharding(mesh, P(axis))
+    if halo == "ring":
+        arrays, exp_lo, exp_hi, labels_sorted, stats = build_owned_shards(
+            points, partitioner, eps, n_shards, block
+        )
+        owned = arrays[0]
+        if owned.shape[0] != n_shards or len(labels_sorted) != n_shards:
+            raise ValueError(
+                f"halo='ring' needs exactly one partition per device "
+                f"(got {len(labels_sorted)} partitions on {n_shards} "
+                f"devices)"
+            )
+        args = tuple(
+            jax.device_put(a, sharding)
+            for a in (*arrays, exp_lo, exp_hi)
+        )
+        cap = int(stats["owned_cap"])
+        explicit = hcap is not None
+        this_hcap = (
+            round_up(int(hcap), block) if explicit
+            else round_up(max(block, cap // 2), block)
+        )
+        max_attempts = 1 if explicit else 4
+        for _attempt in range(max_attempts):
+            labels, core, overflow = sharded_step_ring(
+                *args,
+                eps=float(eps),
+                min_samples=int(min_samples),
+                metric=metric,
+                block=block,
+                mesh=mesh,
+                axis=axis,
+                n_points=len(points),
+                precision=precision,
+                backend=backend,
+                hcap=this_hcap,
+            )
+            if int(np.asarray(overflow).sum()) == 0:
+                break
+            this_hcap *= 2
+        else:
+            raise RuntimeError(
+                f"ring halo buffer overflow at hcap={this_hcap // 2}; "
+                f"pass a larger hcap"
+                if explicit
+                else f"ring halo buffer overflow persisted up to "
+                f"hcap={this_hcap // 2}"
+            )
+        stats = dict(stats, halo_exchange="ring", halo_cap=this_hcap)
+        labels, core = np.asarray(labels), np.asarray(core)
+        return _canonicalize_roots(labels, core), core, stats
+    arrays, stats = build_shards(points, partitioner, eps, n_shards, block)
     arrays = tuple(jax.device_put(a, sharding) for a in arrays)
     labels, core = sharded_step(
         *arrays,
@@ -343,4 +510,25 @@ def sharded_dbscan(
         precision=precision,
         backend=backend,
     )
-    return np.asarray(labels), np.asarray(core), stats
+    labels, core = np.asarray(labels), np.asarray(core)
+    return _canonicalize_roots(labels, core), core, stats
+
+
+def _canonicalize_roots(labels: np.ndarray, core: np.ndarray) -> np.ndarray:
+    """Relabel each cluster to its minimum core-member gid.
+
+    Per-partition roots are minimum *local indices* mapped through gids,
+    so the merged cluster key depends on slab ordering (host Morton
+    layout vs ring arrival order).  Canonicalizing to the min core gid
+    makes sharded labels deterministic across halo paths and identical
+    to the single-device kernel's root convention (min core index of
+    the component).
+    """
+    n = len(labels)
+    valid = (labels >= 0) & core
+    mins = np.full(n + 1, np.iinfo(np.int64).max, np.int64)
+    np.minimum.at(mins, labels[valid], np.arange(n)[valid])
+    out = labels.copy()
+    sel = labels >= 0
+    out[sel] = mins[labels[sel]].astype(labels.dtype)
+    return out

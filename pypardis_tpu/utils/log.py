@@ -36,6 +36,10 @@ def enable(level: int = logging.INFO) -> None:
 
 def log_phase(phase: str, **fields) -> None:
     """One structured line per pipeline phase (no-op unless enabled)."""
+    if LOGGING and not _logger.handlers:
+        # The flag was set directly (without enable()) — honor it anyway;
+        # the reference's sin was a flag nothing ever read.
+        enable()
     if LOGGING or _logger.isEnabledFor(logging.INFO):
         kv = " ".join(f"{k}={v}" for k, v in fields.items())
         _logger.info("%s %s", phase, kv)

@@ -16,17 +16,29 @@ from typing import Dict
 
 
 class PhaseTimer:
-    """Accumulate named phase durations; device-synchronizing on exit.
+    """Accumulate named phase durations.
 
     >>> t = PhaseTimer()
-    >>> with t.phase("cluster"):
+    >>> with t.phase("cluster") as p:
     ...     labels = kernel(...)
+    ...     p.sync_on(labels)        # time includes device execution
     >>> t.as_dict()  # {"cluster_s": 0.123}
+
+    ``sync_on(arrays)`` blocks on the phase's actual outputs — the
+    reliable way to include async-dispatched device work.  ``sync=True``
+    instead issues a trivial transfer barrier per device on exit; TPU
+    devices execute in order so that bounds prior compute there, but on
+    out-of-order backends prefer ``sync_on``.
     """
 
     def __init__(self, sync: bool = False):
         self.phases: Dict[str, float] = {}
         self._sync = sync
+        self._pending = None
+
+    def sync_on(self, arrays) -> None:
+        """Register this phase's outputs to block on at phase exit."""
+        self._pending = arrays
 
     @contextlib.contextmanager
     def phase(self, name: str):
@@ -34,11 +46,12 @@ class PhaseTimer:
         try:
             yield self
         finally:
-            if self._sync:
-                import jax
+            import jax
 
-                # Barrier on every device — a trivial op on the default
-                # device alone would under-report sharded phases.
+            if self._pending is not None:
+                jax.block_until_ready(self._pending)
+                self._pending = None
+            elif self._sync:
                 for dev in jax.devices():
                     jax.device_put(0, dev).block_until_ready()
             self.phases[f"{name}_s"] = self.phases.get(

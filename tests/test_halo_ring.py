@@ -1,0 +1,60 @@
+"""Device-resident ring halo exchange vs the host-built halo layout.
+
+Runs on the 8-device CPU mesh from conftest.py — the ppermute ring and
+the host box query must produce identical final clusterings, because
+they implement the same 2*eps duplication rule (reference README.md:20).
+"""
+
+import numpy as np
+import pytest
+from sklearn.datasets import make_blobs
+
+from pypardis_tpu.ops.labels import densify_labels
+from pypardis_tpu.parallel import default_mesh
+from pypardis_tpu.parallel.sharded import sharded_dbscan
+from pypardis_tpu.partition import KDPartitioner
+
+
+@pytest.fixture(scope="module")
+def sharded_setup():
+    X, _ = make_blobs(
+        n_samples=2000, centers=6, n_features=3, cluster_std=0.3,
+        random_state=3,
+    )
+    mesh = default_mesh(8)
+    part = KDPartitioner(X, max_partitions=8)
+    return X, mesh, part
+
+
+def test_ring_matches_host_halo(sharded_setup):
+    X, mesh, part = sharded_setup
+    kw = dict(eps=0.4, min_samples=5, block=128, mesh=mesh)
+    l_host, c_host, s_host = sharded_dbscan(X, part, halo="host", **kw)
+    l_ring, c_ring, s_ring = sharded_dbscan(X, part, halo="ring", **kw)
+    assert np.array_equal(c_host, c_ring)
+    assert np.array_equal(
+        densify_labels(l_host), densify_labels(l_ring)
+    )
+    assert s_ring["halo_exchange"] == "ring"
+
+
+def test_ring_matches_single_node(sharded_setup):
+    from sklearn.cluster import DBSCAN as SKDBSCAN
+    from sklearn.metrics import adjusted_rand_score
+
+    X, mesh, part = sharded_setup
+    l_ring, _, _ = sharded_dbscan(
+        X, part, eps=0.4, min_samples=5, block=128, mesh=mesh, halo="ring"
+    )
+    sk = SKDBSCAN(eps=0.4, min_samples=5).fit(X)
+    assert adjusted_rand_score(sk.labels_, densify_labels(l_ring)) >= 0.99
+
+
+def test_ring_requires_one_partition_per_device(sharded_setup):
+    X, mesh, _ = sharded_setup
+    part4 = KDPartitioner(X, max_partitions=4)
+    with pytest.raises(ValueError, match="one partition per device"):
+        sharded_dbscan(
+            X, part4, eps=0.4, min_samples=5, block=128, mesh=mesh,
+            halo="ring",
+        )
