@@ -1,0 +1,131 @@
+"""Property / invariant tests (SURVEY §4) + wider oracle coverage.
+
+Invariants pinned here:
+
+* every point gets exactly one finite global label;
+* core-point labels are invariant to ``max_partitions`` (1, 4, 16) —
+  partitioning must not change what the clustering *is* (border points
+  are legitimately assignment-ambiguous, reference README.md:28-33);
+* ARI >= 0.99 vs single-node sklearn across dataset shapes (moons,
+  anisotropic blobs, high-dim, varied scale) and both metrics;
+* callable scipy metrics behave identically to their string spellings.
+"""
+
+import numpy as np
+import pytest
+from sklearn.cluster import DBSCAN as SKDBSCAN
+from sklearn.datasets import make_blobs, make_moons
+from sklearn.metrics import adjusted_rand_score
+from sklearn.preprocessing import StandardScaler
+
+from pypardis_tpu import DBSCAN
+
+
+def _datasets():
+    rng = np.random.default_rng(11)
+    out = {}
+    X, _ = make_moons(n_samples=600, noise=0.05, random_state=0)
+    out["moons"] = (StandardScaler().fit_transform(X), 0.2, 5)
+    X, _ = make_blobs(
+        n_samples=800, centers=4, n_features=2, cluster_std=0.5,
+        random_state=1,
+    )
+    out["aniso"] = (
+        X @ np.array([[0.6, -0.6], [-0.4, 0.8]]), 0.3, 10,
+    )
+    X, _ = make_blobs(
+        n_samples=600, centers=5, n_features=24, cluster_std=0.5,
+        random_state=2,
+    )
+    out["high_dim"] = (X, 3.0, 8)
+    # Large-magnitude coordinates: exercises the centering that protects
+    # the |x|^2+|y|^2-2xy expansion (GPS-like projected meters).
+    X, _ = make_blobs(
+        n_samples=500, centers=3, n_features=2, cluster_std=30.0,
+        center_box=(9.0e5, 1.1e6), random_state=3,
+    )
+    out["gps_scale"] = (X, 100.0, 5)
+    return out
+
+
+DATASETS = _datasets()
+
+
+@pytest.mark.parametrize("name", sorted(DATASETS))
+def test_oracle_ari_vs_sklearn(name):
+    X, eps, ms = DATASETS[name]
+    ours = DBSCAN(eps=eps, min_samples=ms, block=128).fit_predict(X)
+    sk = SKDBSCAN(eps=eps, min_samples=ms).fit(X)
+    assert adjusted_rand_score(sk.labels_, ours) >= 0.99, name
+
+
+@pytest.mark.parametrize("name", ["moons", "aniso"])
+def test_exactly_one_label_per_point(name):
+    X, eps, ms = DATASETS[name]
+    model = DBSCAN(eps=eps, min_samples=ms, block=128).fit(X)
+    assert model.labels_.shape == (len(X),)
+    assert np.all(np.isfinite(model.labels_))
+    assert model.labels_.min() >= -1
+    # assignments() carries the same single label per key, in key order
+    keys = [k for k, _ in model.assignments()]
+    assert len(keys) == len(set(keys)) == len(X)
+
+
+@pytest.mark.parametrize("max_partitions", [1, 4, 16])
+def test_core_labels_invariant_to_partition_count(blobs750, max_partitions):
+    base = DBSCAN(eps=0.3, min_samples=10, block=128).fit(blobs750)
+    part = DBSCAN(
+        eps=0.3, min_samples=10, block=128, max_partitions=max_partitions
+    ).fit(blobs750)
+    # Core mask identical regardless of partitioning.
+    assert np.array_equal(
+        base.core_sample_mask_, part.core_sample_mask_
+    ), max_partitions
+    # Core points agree on cluster structure exactly (ARI on core subset).
+    core = base.core_sample_mask_
+    assert (
+        adjusted_rand_score(base.labels_[core], part.labels_[core]) == 1.0
+    )
+    # Noise agreement: a point that is noise in one is noise in both.
+    assert np.array_equal(base.labels_ == -1, part.labels_ == -1)
+
+
+def test_cityblock_end_to_end(blobs750):
+    ours = DBSCAN(
+        eps=0.35, min_samples=10, metric="cityblock", block=128
+    ).fit_predict(blobs750)
+    sk = SKDBSCAN(eps=0.35, min_samples=10, metric="manhattan").fit(blobs750)
+    assert adjusted_rand_score(sk.labels_, ours) >= 0.99
+
+
+def test_callable_metric_matches_string(blobs750):
+    from scipy.spatial.distance import cityblock, euclidean
+
+    for cb, name, eps in (
+        (euclidean, "euclidean", 0.3),
+        (cityblock, "cityblock", 0.35),
+    ):
+        a = DBSCAN(eps=eps, min_samples=10, metric=cb, block=128).fit_predict(
+            blobs750
+        )
+        b = DBSCAN(
+            eps=eps, min_samples=10, metric=name, block=128
+        ).fit_predict(blobs750)
+        assert np.array_equal(a, b), name
+
+
+def test_duplicate_points():
+    # 60 copies of 3 distinct locations: all core, 3 clusters, no noise.
+    X = np.repeat(np.array([[0.0, 0.0], [5.0, 5.0], [-5.0, 3.0]]), 60, axis=0)
+    labels = DBSCAN(eps=0.1, min_samples=10, block=128).fit_predict(X)
+    assert len(np.unique(labels)) == 3
+    assert (labels != -1).all()
+
+
+def test_min_samples_one_everything_clusters():
+    rng = np.random.default_rng(5)
+    X = rng.uniform(size=(200, 3))
+    labels = DBSCAN(eps=1e-6, min_samples=1, block=128).fit_predict(X)
+    # Every isolated point is its own core point -> its own cluster.
+    assert (labels >= 0).all()
+    assert len(np.unique(labels)) == 200
