@@ -143,22 +143,42 @@ def dbscan_fixed_size(
     f0 = jnp.where(core, idx, _INT_INF)
 
     def cond(state):
-        f, changed, rounds = state
+        f, g, changed, rounds = state
         return changed & (rounds < max_rounds)
 
     def body(state):
-        f, _, rounds = state
+        f, _, _, rounds = state
         # Hook: min label among core eps-neighbors (self included).
-        g = minlab_fn(points, f, eps, core, row_mask=core)
+        # Rows cover the full valid mask (not just core) so the final
+        # round's g doubles as the border-attach pass: at convergence g
+        # is computed from the fixpoint labels, which is exactly "min
+        # root among my core eps-neighbors" for every valid row.
+        # Tradeoff: row bounds now include non-core valid points, which
+        # can unskip a few extra column tiles per round — bounded in the
+        # Morton-sorted layout (noise sits near its cluster, and column
+        # tiles are core-masked, so noise-only row tiles still prune
+        # everything) and repaid by dropping the whole post-loop pass.
+        g = minlab_fn(points, f, eps, core, row_mask=mask)
         f_new = jnp.where(core, jnp.minimum(f, g), f)
         # Shortcut: chase pointers to the current root.
         f_new = _pointer_jump(f_new, core)
-        return f_new, jnp.any(f_new != f), rounds + 1
+        return f_new, g, jnp.any(f_new != f), rounds + 1
 
-    f, _, _ = jax.lax.while_loop(cond, body, (f0, jnp.bool_(True), 0))
+    f, g, changed, _ = jax.lax.while_loop(
+        cond, body, (f0, f0, jnp.bool_(True), 0)
+    )
 
     # Border points: nearest-core-label attach; noise: no core neighbor.
-    border = minlab_fn(points, f, eps, core, row_mask=mask)
+    # The carried g is that pass already — recompute only in the rare
+    # exit-by-max_rounds case where g predates the final f.  (Under
+    # vmap — the multi-partition-per-device layout — cond lowers to
+    # select and both branches run, costing what the old unconditional
+    # pass did; no worse, and the common one-partition path wins.)
+    border = jax.lax.cond(
+        changed,
+        lambda: minlab_fn(points, f, eps, core, row_mask=mask),
+        lambda: g,
+    )
     labels = jnp.where(
         core, f, jnp.where(mask & (border != _INT_INF), border, -1)
     ).astype(jnp.int32)

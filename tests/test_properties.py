@@ -22,7 +22,6 @@ from pypardis_tpu import DBSCAN
 
 
 def _datasets():
-    rng = np.random.default_rng(11)
     out = {}
     X, _ = make_moons(n_samples=600, noise=0.05, random_state=0)
     out["moons"] = (StandardScaler().fit_transform(X), 0.2, 5)
@@ -64,8 +63,9 @@ def test_exactly_one_label_per_point(name):
     X, eps, ms = DATASETS[name]
     model = DBSCAN(eps=eps, min_samples=ms, block=128).fit(X)
     assert model.labels_.shape == (len(X),)
-    assert np.all(np.isfinite(model.labels_))
+    # No sentinel leaks: every label is -1 or a valid point index.
     assert model.labels_.min() >= -1
+    assert model.labels_.max() < len(X)
     # assignments() carries the same single label per key, in key order
     keys = [k for k, _ in model.assignments()]
     assert len(keys) == len(set(keys)) == len(X)
