@@ -1,0 +1,2 @@
+"""(staging file for the pipelined kernel rewrite — merged into
+pallas_kernels.py and deleted)"""
