@@ -1,0 +1,26 @@
+# Runnable-environment parity with the reference's container story
+# (/root/reference/Dockerfile:1-23 — ubuntu + python + requirements).
+# TPU equivalent: the official JAX CPU image runs the full test suite on
+# a virtual 8-device mesh; on TPU VMs, swap the base for a libtpu image
+# (e.g. the Cloud TPU JAX release) — the code paths are identical.
+FROM python:3.11-slim
+
+WORKDIR /opt/pypardis_tpu
+
+# Native toolchain for the C++ union-find resolver (built lazily at
+# import; the wheel works without it via the numpy fallback).
+RUN apt-get update && apt-get install -y --no-install-recommends \
+    g++ make \
+    && rm -rf /var/lib/apt/lists/*
+
+COPY setup.py makefile ./
+COPY pypardis_tpu ./pypardis_tpu
+COPY tests ./tests
+
+RUN pip install --no-cache-dir \
+    "jax[cpu]" numpy scipy scikit-learn pytest \
+    && pip install --no-cache-dir -e .
+
+# The test harness fakes an 8-device mesh on CPU (tests/conftest.py), so
+# the distributed path is exercised without TPU hardware.
+CMD ["python", "-m", "pytest", "tests/", "-q"]

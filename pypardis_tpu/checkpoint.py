@@ -25,6 +25,12 @@ from .geometry import BoundingBox
 from .partition import KDPartitioner, route_tree
 
 
+def _norm_npz(path: str) -> str:
+    """np.savez silently appends '.npz' when missing; np.load does not.
+    Normalize symmetrically so save('foo') / load('foo') round-trips."""
+    return path if str(path).endswith(".npz") else f"{path}.npz"
+
+
 def save_partitioner(part: KDPartitioner, path: str) -> None:
     """Persist the split tree + boxes (not the points)."""
     labels = sorted(part.bounding_boxes)
@@ -32,7 +38,7 @@ def save_partitioner(part: KDPartitioner, path: str) -> None:
     upper = np.stack([part.bounding_boxes[l].upper for l in labels])
     tree = np.asarray(part.tree, dtype=np.float64).reshape(-1, 5)
     np.savez(
-        path,
+        _norm_npz(path),
         kind="kd_partition_tree",
         k=part.k,
         split_method=part.split_method,
@@ -68,7 +74,7 @@ class PartitionTree:
 
 
 def load_partitioner(path: str) -> PartitionTree:
-    with np.load(path, allow_pickle=False) as z:
+    with np.load(_norm_npz(path), allow_pickle=False) as z:
         if str(z["kind"]) != "kd_partition_tree":
             raise ValueError(f"{path} is not a partition-tree checkpoint")
         return PartitionTree(
@@ -102,7 +108,7 @@ def save_model(model, path: str) -> None:
         # so loudly rather than writing an unreadable checkpoint.
         keys = keys.astype(str)
     np.savez(
-        path,
+        _norm_npz(path),
         kind="dbscan_model",
         params=json.dumps(params),
         labels_=model.labels_,
@@ -123,7 +129,7 @@ def load_model(path: str):
     """Rebuild a DBSCAN whose result surface works without retraining."""
     from .dbscan import DBSCAN
 
-    with np.load(path, allow_pickle=False) as z:
+    with np.load(_norm_npz(path), allow_pickle=False) as z:
         if str(z["kind"]) != "dbscan_model":
             raise ValueError(f"{path} is not a DBSCAN model checkpoint")
         params = json.loads(str(z["params"]))

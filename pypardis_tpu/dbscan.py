@@ -37,7 +37,7 @@ def _as_keys_points(data):
     """Accept (N,k) arrays, (keys, vectors) pairs, or [(key, vec), ...]
     — the reference's RDD records are (key, vector) pairs (dbscan.py:107)."""
     if isinstance(data, tuple) and len(data) == 2:
-        keys, pts = np.asarray(data[0]), np.asarray(data[1], dtype=np.float64)
+        keys, pts = np.asarray(data[0]), _as_float(data[1])
         if keys.ndim == 1 and pts.ndim == 2 and len(keys) == len(pts):
             return keys, pts
     if (
@@ -50,8 +50,20 @@ def _as_keys_points(data):
         keys = np.asarray([k for k, _ in data])
         pts = np.asarray([np.asarray(v, dtype=np.float64) for _, v in data])
         return keys, pts
-    pts = np.asarray(data, dtype=np.float64)
+    pts = _as_float(data)
     return np.arange(len(pts)), pts
+
+
+def _as_float(data) -> np.ndarray:
+    """Float view of the input, preserving float32/float64.
+
+    Round 1 forced float64 here, which silently doubled host memory for
+    float32 datasets — the common dtype at the target scale.
+    """
+    pts = np.asarray(data)
+    if pts.dtype not in (np.float32, np.float64):
+        pts = pts.astype(np.float64)
+    return pts
 
 
 def _pad_and_run(
@@ -74,7 +86,7 @@ def _pad_and_run(
     """
     import jax.numpy as jnp
 
-    points = np.asarray(points, dtype=np.float64)
+    points = _as_float(points)
     n, k = points.shape
     block = clamp_block(block, n)
     cap = round_up(n, block)
@@ -82,12 +94,23 @@ def _pad_and_run(
     if sort and n > 2 * block:
         order = spatial_order(points)
         points = points[order]
-    pts = np.zeros((cap, k), np.float32)
-    pts[:n] = points - points.mean(axis=0)
+    center = points.mean(axis=0, dtype=np.float64)
+    # Transposed (k, cap) device layout: XLA:TPU pads the minor axis of
+    # an (N, small-k) buffer to 128 lanes (8x HBM at k=16); keeping the
+    # point axis minor stores it dense.  Chunked recentring: no
+    # full-size float64 temp at any N.
+    pts_t = np.zeros((k, cap), np.float32)
+    chunk = 1 << 20
+    for s in range(0, n, chunk):
+        e = min(s + chunk, n)
+        np.subtract(
+            points[s:e].T, center[:, None], out=pts_t[:, s:e],
+            casting="unsafe",
+        )
     mask = np.zeros(cap, bool)
     mask[:n] = True
     roots, core = dbscan_fixed_size(
-        jnp.asarray(pts),
+        jnp.asarray(pts_t),
         eps,
         min_samples,
         jnp.asarray(mask),
@@ -95,6 +118,7 @@ def _pad_and_run(
         block=block,
         precision=precision,
         backend=backend,
+        layout="dn",
     )
     # np.array (not asarray): device buffers are read-only views.
     roots, core = np.array(roots[:n]), np.array(core[:n])
@@ -207,7 +231,7 @@ class DBSCAN:
             self.labels_ = np.empty(0, np.int32)
             self.core_sample_mask_ = np.empty(0, bool)
             self.bounding_boxes, self.expanded_boxes = {}, {}
-            self.cluster_dict = {}
+            self.neighbors, self.cluster_dict = {}, {}
             self.result = []
             self.metrics_ = {"total_s": 0.0, "points_per_sec": 0.0}
             return self
@@ -267,6 +291,7 @@ class DBSCAN:
         box = BoundingBox(lower=lo, upper=hi)
         self.bounding_boxes = {0: box}
         self.expanded_boxes = {0: box.expand(2 * self.eps)}
+        self.neighbors = {0: np.arange(len(points))}
         self.cluster_dict = {
             f"0:{l}": int(l) for l in np.unique(self.labels_) if l >= 0
         }
@@ -312,7 +337,25 @@ class DBSCAN:
         self.metrics_["cluster_s"] = time.perf_counter() - t1
         self.metrics_.update(stats)
         self.metrics_["n_partitions"] = part.n_partitions
-        self.cluster_dict = None  # built lazily by cluster_mapping()
+        # Parity surface (reference dbscan.py:93-102).  ``neighbors``:
+        # {partition label -> indices of the points in its 2*eps-expanded
+        # box} — the reference's per-label neighborhood RDDs, as index
+        # arrays (one cheap split-tree replay).  ``cluster_dict``:
+        # {"partition:cluster" -> global id}; the sharded path has no
+        # partition-local ids after the in-graph merge, so the global
+        # dense label doubles as the per-partition cluster id.
+        from .partition import expanded_members
+
+        members = expanded_members(part.tree, points, 2 * self.eps)
+        self.neighbors = {l: members[l][0] for l in sorted(members)}
+        sel = self.labels_ >= 0
+        codes = np.unique(
+            part.result[sel].astype(np.int64) << 32
+            | self.labels_[sel].astype(np.int64)
+        )
+        self.cluster_dict = {
+            f"{c >> 32}:{c & 0xFFFFFFFF}": int(c & 0xFFFFFFFF) for c in codes
+        }
 
     def save(self, path: str) -> None:
         """Checkpoint the trained model (labels, boxes, hyperparams)."""

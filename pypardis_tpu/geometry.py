@@ -150,11 +150,24 @@ class BoxStack:
     def expand(self, eps=0) -> "BoxStack":
         return BoxStack(self.lower - eps, self.upper + eps)
 
-    def membership(self, points: np.ndarray) -> np.ndarray:
-        """(N, k) points → (N, P) bool: point n inside box p (inclusive)."""
+    def membership(self, points: np.ndarray, chunk: int = 1 << 16) -> np.ndarray:
+        """(N, k) points → (N, P) bool: point n inside box p (inclusive).
+
+        Evaluated in chunks of ``chunk`` points so the broadcast temp is
+        O(chunk · P · k) regardless of N (the (N, P, k) one-shot
+        broadcast was the round-1 memory wall).  For halo routing prefer
+        :func:`pypardis_tpu.partition.expanded_members`, which is
+        O(N · depth) time as well as memory.
+        """
         points = np.asarray(points)
-        return np.all(
-            (points[:, None, :] >= self.lower[None, :, :])
-            & (points[:, None, :] <= self.upper[None, :, :]),
-            axis=-1,
-        )
+        n = len(points)
+        out = np.empty((n, len(self)), bool)
+        for s in range(0, max(n, 1), chunk):
+            e = min(s + chunk, n)
+            c = points[s:e, None, :]
+            np.all(
+                (c >= self.lower[None, :, :]) & (c <= self.upper[None, :, :]),
+                axis=-1,
+                out=out[s:e],
+            )
+        return out

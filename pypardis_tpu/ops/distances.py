@@ -8,6 +8,14 @@ into ``|x|^2 + |y|^2 - 2 x @ y.T`` so the dominant cost is a matmul on the
 MXU; the (rows x cols) tile is consumed immediately by a compare-and-reduce
 so the N x N interaction never hits HBM.
 
+Layout: XLA:TPU tiles the last two axes of every buffer to (8, 128), so a
+``(N, d)`` coordinate array with small d is padded 8x in HBM (d=16 ->
+128 lanes) — the round-1 memory wall at 10M+ points.  All internal tile
+representations here are therefore **transposed**: ``(nt, d, block)``
+with the big point axis minor, which is dense for any d.  Public entry
+points accept the conventional ``(N, d)`` (``layout="nd"``) or the
+memory-optimal ``(d, N)`` (``layout="dn"``) and normalize immediately.
+
 Everything here is shape-static and jit/shard_map-safe: callers pad point
 sets to a fixed capacity and pass a validity mask.
 """
@@ -78,6 +86,12 @@ def _norm_metric(metric) -> str:
     )
 
 
+def _norm_layout(layout: str) -> str:
+    if layout not in ("nd", "dn"):
+        raise ValueError(f"layout must be 'nd' or 'dn', got {layout!r}")
+    return layout
+
+
 def pairwise_sq_dists(
     x: jnp.ndarray, y: jnp.ndarray, precision="highest"
 ) -> jnp.ndarray:
@@ -92,34 +106,48 @@ def pairwise_sq_dists(
     return jnp.maximum(d2, 0.0)
 
 
-def _tile_adjacency(xi, yj, eps, metric, precision):
-    """(br, d) x (bc, d) → (br, bc) bool: within eps under ``metric``."""
+def _tile_adjacency_t(xi, yj, eps, metric, precision):
+    """(d, br) x (d, bc) transposed tiles → (br, bc) bool: within eps."""
     if metric == "euclidean":
-        return pairwise_sq_dists(xi, yj, precision) <= eps * eps
+        xx = jnp.sum(xi * xi, axis=0)[:, None]
+        yy = jnp.sum(yj * yj, axis=0)[None, :]
+        d2 = xx + yy - 2.0 * jax.lax.dot_general(
+            xi, yj, (((0,), (0,)), ((), ())),
+            precision=_norm_precision(precision),
+            preferred_element_type=jnp.float32,
+        )
+        return d2 <= eps * eps
     # cityblock: no matmul decomposition; broadcast |xi - yj| sum on VPU.
-    d1 = jnp.sum(jnp.abs(xi[:, None, :] - yj[None, :, :]), axis=-1)
+    d1 = jnp.sum(jnp.abs(xi[:, :, None] - yj[:, None, :]), axis=0)
     return d1 <= eps
 
 
-def _tiles(points, mask, block):
-    n = points.shape[0]
-    assert n % block == 0, (n, block)
-    nt = n // block
-    pts = points.reshape(nt, block, points.shape[1])
+def _tiles_t(points, mask, block, layout):
+    """Normalize to transposed tiles: (nt, d, block) + (nt, block) mask."""
+    if layout == "nd":
+        n, d = points.shape
+        assert n % block == 0, (n, block)
+        nt = n // block
+        pts = points.reshape(nt, block, d).transpose(0, 2, 1)
+    else:
+        d, n = points.shape
+        assert n % block == 0, (n, block)
+        nt = n // block
+        pts = points.reshape(d, nt, block).transpose(1, 0, 2)
     msk = mask.reshape(nt, block)
     return nt, pts, msk
 
 
 def tile_bounds(pts: jnp.ndarray, msk: jnp.ndarray):
-    """Per-tile bounding boxes: (nt, block, d) points + (nt, block) mask
-    → (nt, d) lower / upper bounds over valid points.
+    """Per-tile bounding boxes: (nt, d, block) transposed tiles + (nt,
+    block) mask → (nt, d) lower / upper bounds over valid points.
 
     Empty tiles get an inverted box (lo=+BIG, hi=-BIG) whose gap to any
     other box is huge, so they are pruned automatically.
     """
-    valid = msk[..., None]
-    lo = jnp.min(jnp.where(valid, pts, _BIG), axis=1)
-    hi = jnp.max(jnp.where(valid, pts, -_BIG), axis=1)
+    valid = msk[:, None, :]
+    lo = jnp.min(jnp.where(valid, pts, _BIG), axis=2)
+    hi = jnp.max(jnp.where(valid, pts, -_BIG), axis=2)
     return lo, hi
 
 
@@ -142,7 +170,7 @@ def tile_skip_mask(lo_i, hi_i, lo, hi, eps, metric):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("metric", "block", "precision")
+    jax.jit, static_argnames=("metric", "block", "precision", "layout")
 )
 def neighbor_counts(
     points: jnp.ndarray,
@@ -151,18 +179,21 @@ def neighbor_counts(
     metric: str = "euclidean",
     block: int = 1024,
     precision: str = "high",
+    layout: str = "nd",
 ) -> jnp.ndarray:
     """Per-point count of valid points within eps (self included).
 
-    ``points``: (N, d) with N a multiple of ``block``; ``mask``: (N,) bool.
-    Returns (N,) int32.  Row tiles map over the grid; column tiles are a
-    ``lax.scan`` accumulation, so peak memory is O(block^2).  Column
-    tiles whose bounding box lies farther than eps from the row tile's
-    are skipped (``lax.cond``), so spatially sorted inputs do O(N * local
-    density) work instead of O(N^2).
+    ``points``: (N, d) (``layout="nd"``) or (d, N) (``layout="dn"``)
+    with N a multiple of ``block``; ``mask``: (N,) bool.  Returns (N,)
+    int32.  Row tiles map over the grid; column tiles are a ``lax.scan``
+    accumulation, so peak memory is O(block^2).  Column tiles whose
+    bounding box lies farther than eps from the row tile's are skipped
+    (``lax.cond``), so spatially sorted inputs do O(N * local density)
+    work instead of O(N^2).
     """
     metric = _norm_metric(metric)
-    nt, pts, msk = _tiles(points, mask, block)
+    layout = _norm_layout(layout)
+    nt, pts, msk = _tiles_t(points, mask, block, layout)
     lo, hi = tile_bounds(pts, msk)
 
     def row_tile(xi, mi, lo_i, hi_i):
@@ -171,7 +202,7 @@ def neighbor_counts(
         def col_step(acc, jc):
             def compute(a):
                 yj, mj = pts[jc], msk[jc]
-                adj = _tile_adjacency(xi, yj, eps, metric, precision)
+                adj = _tile_adjacency_t(xi, yj, eps, metric, precision)
                 adj &= mj[None, :]
                 return a + jnp.sum(adj, axis=1, dtype=jnp.int32)
 
@@ -186,7 +217,7 @@ def neighbor_counts(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("metric", "block", "precision")
+    jax.jit, static_argnames=("metric", "block", "precision", "layout")
 )
 def min_neighbor_label(
     points: jnp.ndarray,
@@ -197,6 +228,7 @@ def min_neighbor_label(
     block: int = 1024,
     precision: str = "high",
     row_mask: jnp.ndarray | None = None,
+    layout: str = "nd",
 ) -> jnp.ndarray:
     """Per-point min label over eps-neighbors drawn from ``src_mask``.
 
@@ -204,16 +236,23 @@ def min_neighbor_label(
     ``src_mask[j]`` contribute.  Returns (N,) int32, INT32_MAX where no
     masked neighbor is within eps.  This single primitive powers both the
     core-graph min-propagation step and the border-point assignment pass.
-    ``row_mask`` (default: ``src_mask``) tightens the per-tile bounding
-    boxes used for tile-level pruning; rows outside it still get outputs
-    but may see extra INT32_MAX results — callers mask them anyway.
+    ``row_mask`` tightens the per-tile bounding boxes used for tile-level
+    pruning to the rows the caller will actually read; rows outside it
+    may be silently pruned to INT32_MAX.  The default (``None``) covers
+    ALL rows, so every row's output is correct — pass a mask only when
+    you will mask those rows out anyway.
     """
     metric = _norm_metric(metric)
-    nt, pts, smsk = _tiles(points, src_mask, block)
+    layout = _norm_layout(layout)
+    nt, pts, smsk = _tiles_t(points, src_mask, block, layout)
     lab = labels.reshape(nt, block)
-    rmsk = (row_mask if row_mask is not None else src_mask).reshape(nt, block)
     lo, hi = tile_bounds(pts, smsk)
-    row_lo, row_hi = tile_bounds(pts, rmsk)
+    if row_mask is None:
+        # Full coverage: row bounds over every row (padding included —
+        # only a pruning-tightness cost, never a correctness one).
+        row_lo, row_hi = tile_bounds(pts, jnp.ones_like(smsk))
+    else:
+        row_lo, row_hi = tile_bounds(pts, row_mask.reshape(nt, block))
 
     def row_tile(xi, lo_i, hi_i):
         skip = tile_skip_mask(lo_i, hi_i, lo, hi, eps, metric)
@@ -221,7 +260,7 @@ def min_neighbor_label(
         def col_step(acc, jc):
             def compute(a):
                 yj, mj, lj = pts[jc], smsk[jc], lab[jc]
-                adj = _tile_adjacency(xi, yj, eps, metric, precision)
+                adj = _tile_adjacency_t(xi, yj, eps, metric, precision)
                 adj &= mj[None, :]
                 cand = jnp.where(adj, lj[None, :], _INT_INF)
                 return jnp.minimum(a, jnp.min(cand, axis=1))

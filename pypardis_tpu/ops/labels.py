@@ -39,11 +39,9 @@ def resolve_backend(
     the pure-XLA tiled path with identical semantics.  Problems smaller
     than a few tiles also stay on XLA: a hand-scheduled kernel buys
     nothing there, and sub-millisecond XLA programs sidestep launch
-    overhead entirely.  Shards at or above 2^24 points stay on XLA too
-    (the Pallas label kernel carries labels as exact-below-2^24 float32).
+    overhead entirely.
     """
     from .distances import _norm_metric
-    from .pallas_kernels import MAX_LABEL_POINTS
 
     metric = _norm_metric(metric)
     if backend == "auto":
@@ -52,7 +50,6 @@ def resolve_backend(
             if metric == "euclidean"
             and jax.default_backend() == "tpu"
             and n >= 4 * block
-            and n < MAX_LABEL_POINTS
             else "xla"
         )
     if backend not in ("xla", "pallas"):
@@ -89,7 +86,9 @@ def _pointer_jump(f: jnp.ndarray, active: jnp.ndarray) -> jnp.ndarray:
 
 @functools.partial(
     jax.jit,
-    static_argnames=("metric", "block", "max_rounds", "precision", "backend"),
+    static_argnames=(
+        "metric", "block", "max_rounds", "precision", "backend", "layout"
+    ),
 )
 def dbscan_fixed_size(
     points: jnp.ndarray,
@@ -101,11 +100,14 @@ def dbscan_fixed_size(
     max_rounds: int = 64,
     precision: str = "high",
     backend: str = "auto",
+    layout: str = "nd",
 ):
     """DBSCAN over a fixed-capacity padded point set.
 
-    ``points``: (N, d), N a multiple of ``block``; ``mask``: (N,) bool
-    validity.  Returns ``(labels, core)``:
+    ``points``: (N, d) (``layout="nd"``) or transposed (d, N)
+    (``layout="dn"`` — the memory-optimal device layout: XLA:TPU pads
+    the minor axis of (N, small-d) buffers 8x), N a multiple of
+    ``block``; ``mask``: (N,) bool validity.  Returns ``(labels, core)``:
 
     * ``labels``: (N,) int32 — the *root point index* of the point's
       cluster (min index over the component's core points), or -1 for
@@ -116,7 +118,7 @@ def dbscan_fixed_size(
       sklearn's ``core_sample_indices_`` that the reference reads at
       dbscan.py:30.
     """
-    n = points.shape[0]
+    n = points.shape[0] if layout == "nd" else points.shape[1]
     if resolve_backend(backend, metric, n, block) == "pallas":
         from .pallas_kernels import (
             min_neighbor_label_pallas,
@@ -124,17 +126,21 @@ def dbscan_fixed_size(
         )
 
         count_fn = functools.partial(
-            neighbor_counts_pallas, block=block, precision=precision
+            neighbor_counts_pallas, block=block, precision=precision,
+            layout=layout,
         )
         minlab_fn = functools.partial(
-            min_neighbor_label_pallas, block=block, precision=precision
+            min_neighbor_label_pallas, block=block, precision=precision,
+            layout=layout,
         )
     else:
         count_fn = functools.partial(
-            neighbor_counts, metric=metric, block=block, precision=precision
+            neighbor_counts, metric=metric, block=block, precision=precision,
+            layout=layout,
         )
         minlab_fn = functools.partial(
-            min_neighbor_label, metric=metric, block=block, precision=precision
+            min_neighbor_label, metric=metric, block=block, precision=precision,
+            layout=layout,
         )
     counts = count_fn(points, eps, mask)
     core = (counts >= min_samples) & mask

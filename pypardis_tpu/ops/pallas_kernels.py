@@ -6,29 +6,53 @@ pairwise interaction as ``lax.map`` over row tiles with a ``lax.scan`` +
 primitives — eps-neighbor counting and min-label-over-neighbors — as
 hand-scheduled Mosaic programs:
 
-* one grid program per **row tile**; the row block and all tile bounding
-  boxes live in VMEM;
-* column tiles stay in **HBM** and are DMA'd into VMEM scratch buffers
-  only when their bounding box lies within eps of the row tile's — the
-  pruned tiles cost neither FLOPs nor HBM bandwidth;
-* the distance tile ``|x|^2 + |y|^2 - 2 x @ y.T`` is computed on the MXU
-  and consumed immediately by the compare-and-reduce in registers, so the
+* one grid program per **output tile**; its points and bounding box
+  arrive via grid-sliced BlockSpecs;
+* source tiles stay in **HBM** and are DMA'd into VMEM scratch only when
+  their bounding box lies within eps of the output tile's — pruned tiles
+  cost neither FLOPs nor HBM bandwidth.  Pruning is two-level: one gap
+  test per GROUP of tiles against coarse group boxes resident in VMEM,
+  then per-tile gap tests against the group's per-tile boxes, which are
+  themselves DMA'd from HBM only when the group survives — so VMEM
+  holds O(ng) bounds, independent of the point count;
+* the distance tile is one MXU contraction of **norm-augmented
+  operands** ``[-2(y-c); 1; |y-c|^2]^T [x-c; |x-c|^2; 1] = |x-y|^2``
+  consumed immediately by the compare-and-reduce in registers, so the
   N x N interaction never touches HBM.
 
-Layout notes (Mosaic DMA slices must be tile-aligned):
+Layout (the round-1 design stored coordinates ``(N, d)``-major, which
+XLA:TPU pads 8x in HBM for small d — the 10M-point memory wall):
 
-* coordinates are zero-padded to a multiple of 128 lanes so a column
-  block DMA ``(1, block, d_pad)`` is lane-aligned;
-* per-point scalars (squared norms, labels) travel as ``(nt, 1, block)``
-  float32 rows — a ``(1, 1, block)`` slice is aligned, and arrives in
-  exactly the ``(1, bj)`` broadcast layout the kernel consumes.  Labels
-  therefore ride as float32, which is exact for indices < 2^24; the
-  no-label sentinel is ``+inf``.
+* coordinates travel **transposed** as ``(nt, d, block)`` — the big
+  point axis is minor, so the HBM image is dense for any d, and no lane
+  padding of coordinates is needed at all;
+* per-point scalars (labels) and outputs travel as ``(nt, 1, block)``
+  rows — dense, and already in the ``(1, block)`` broadcast layout the
+  kernel consumes.  Labels ride as int32 (sentinel INT32_MAX), so any
+  shard size up to HBM capacity is supported (the round-1 float32
+  label encoding capped shards at 2^24 points);
+* one masked coordinate array serves as both row and column operand of
+  both kernels; the min-label kernel restricts *sources* via the label
+  sentinel (a non-source's INT32_MAX label never wins a min), so no
+  second N-sized coordinate copy exists.
 
-Masking convention: callers pre-mask the *column* operand — invalid /
-non-source points get coordinates ``BIG`` (squared distance overflows
-past any eps) and labels ``+inf``.  No boolean mask ever enters the
-kernel.
+Numerics:
+
+* every tile pair is computed **recentred on the output tile's box
+  center**, so operand magnitudes are tile-local and the classic
+  ``|x|^2+|y|^2-2xy`` cancellation does not amplify absolute coordinate
+  scale (the dataset-level recentring in the drivers bounds it further);
+* ``precision="high"`` (default) runs a manual **3-pass bf16 split
+  matmul** (hi/lo decomposition: ``x = hi(x) + lo(x)``, dropping only
+  the lo*lo term, ~2^-18-relative error — fp32-class accuracy at half
+  the MXU passes of HIGHEST).  Mosaic has no native bf16_3x, which in
+  round 1 silently upgraded "high" to HIGHEST and cost 2x.
+* ``precision="highest"`` uses native HIGHEST; ``"default"`` a single
+  bf16 pass (fast, ~2^-8-relative — opt-in only).
+
+Masking convention: invalid points get coordinates ``BIG`` (squared
+distance overflows past any eps) before entering the kernel; no boolean
+mask ever does.
 
 Only the Euclidean metric goes through Pallas (cityblock has no matmul
 decomposition and stays on the XLA path).
@@ -44,90 +68,132 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _INT_INF = jnp.iinfo(jnp.int32).max
-_F_INF = float("inf")  # python float: jnp scalars become captured consts in kernels
-# Masked-out column points get these coordinates: BIG^2 overflows fp32 to
-# inf, so d2 is inf (or NaN for BIG-vs-BIG pairs) and the <= eps^2
-# adjacency test is always False.
-BIG = jnp.float32(1e19)
-# float32 labels are exact up to 2^24.
-MAX_LABEL_POINTS = 1 << 24
+# Masked-out points get these coordinates: BIG^2 = 4e38 overflows fp32
+# (max ~3.4e38) to inf, so a valid-vs-masked pair has d2 = inf and a
+# masked-vs-masked pair d2 = inf - inf = NaN — either way the <= eps^2
+# adjacency test is False.
+BIG = jnp.float32(2e19)
+
+GROUP = 16  # source tiles covered by one group-level gap test
+
+_PRECISION_MODES = ("default", "high", "highest")
 
 
-def _pallas_precision(precision):
-    """Mosaic's dot lowering supports only DEFAULT (single-pass bf16) and
-    HIGHEST (fp32) — map the XLA-path's bf16_3x default up to HIGHEST."""
-    from .distances import _norm_precision
+def _norm_precision_mode(precision) -> str:
+    """Normalize to one of the kernel's static precision modes."""
+    if isinstance(precision, jax.lax.Precision):
+        return {
+            jax.lax.Precision.DEFAULT: "default",
+            jax.lax.Precision.HIGH: "high",
+            jax.lax.Precision.HIGHEST: "highest",
+        }[precision]
+    p = str(precision).lower()
+    if p not in _PRECISION_MODES:
+        raise ValueError(
+            f"precision must be one of {_PRECISION_MODES}, got {precision!r}"
+        )
+    return p
 
-    p = _norm_precision(precision)
-    return (
-        jax.lax.Precision.DEFAULT
-        if p == jax.lax.Precision.DEFAULT
-        else jax.lax.Precision.HIGHEST
-    )
+
+def _dot_t(a, b, mode):
+    """(K, m) x (K, n) → (m, n): contraction over the leading axis.
+
+    ``mode="high"`` is the manual bf16_3x: split each operand into a
+    bf16 head plus a bf16-rounded residual and accumulate the three
+    significant cross terms with single-pass (DEFAULT) MXU dots.  The
+    dropped lo*lo term is O(2^-18) relative — fp32-class accuracy.
+    """
+    dims = (((0,), (0,)), ((), ()))
+
+    def dot(x, y, prec):
+        return jax.lax.dot_general(
+            x, y, dims, precision=prec, preferred_element_type=jnp.float32
+        )
+
+    if mode == "highest":
+        return dot(a, b, jax.lax.Precision.HIGHEST)
+    if mode == "default":
+        return dot(a, b, jax.lax.Precision.DEFAULT)
+    ah = a.astype(jnp.bfloat16).astype(jnp.float32)
+    al = a - ah
+    bh = b.astype(jnp.bfloat16).astype(jnp.float32)
+    bl = b - bh
+    d = jax.lax.Precision.DEFAULT
+    return dot(ah, bh, d) + (dot(ah, bl, d) + dot(al, bh, d))
 
 
-def _tile_gap2(lo_ref, hi_ref, i, rlo_ref, rhi_ref, j):
-    """Squared box-to-box gap between row tile i and column tile j."""
-    lo_i = rlo_ref[pl.ds(i, 1), :]
-    hi_i = rhi_ref[pl.ds(i, 1), :]
-    lo_j = lo_ref[pl.ds(j, 1), :]
-    hi_j = hi_ref[pl.ds(j, 1), :]
-    gap = jnp.maximum(jnp.maximum(lo_j - hi_i, lo_i - hi_j), 0.0)
+def _aug_out(x, c):
+    """Output-side augmented operand: [x-c; |x-c|^2; 1] → (d+2, bo)."""
+    xc = x - c
+    xsq = jnp.sum(xc * xc, axis=0, keepdims=True)
+    return jnp.concatenate([xc, xsq, jnp.ones_like(xsq)], axis=0)
+
+
+def _aug_src(y, c):
+    """Source-side augmented operand: [-2(y-c); 1; |y-c|^2] → (d+2, bs)."""
+    yc = y - c
+    ysq = jnp.sum(yc * yc, axis=0, keepdims=True)
+    return jnp.concatenate([-2.0 * yc, jnp.ones_like(ysq), ysq], axis=0)
+
+
+def _gap2(lo_a, hi_a, lo_b, hi_b):
+    """Squared gap between two boxes given as (1, d) bound rows."""
+    gap = jnp.maximum(jnp.maximum(lo_b - hi_a, lo_a - hi_b), 0.0)
     return jnp.sum(gap * gap)
 
 
-def _sq_dists(x, xx, ybuf, ysq, precision):
-    """(bi, d) rows vs (bj, d) cols -> (bi, bj) squared distances.
-
-    ``xx``: (bi, 1) row squared norms; ``ysq``: (1, bj) column squared
-    norms (inf for masked columns).
-    """
-    t = jax.lax.dot_general(
-        x,
-        ybuf,
-        (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=precision,
-    )
-    return xx + ysq - 2.0 * t
-
-
 def _count_kernel(
-    eps2_ref, lo_ref, hi_ref, glo_ref, ghi_ref, x_ref, yhbm_ref, ysq_ref,
-    out_ref, ybuf, sbuf, ysem, ssem,
-    *, precision, group,
+    eps2_ref, glo_ref, ghi_ref, rlo_ref, rhi_ref, tblo_ref, tbhi_ref,
+    x_ref, yhbm_ref, out_ref,
+    ybuf, blo, bhi, ysem, lsem, hsem,
+    *, mode, group,
 ):
-    i = pl.program_id(0)
-    ng = glo_ref.shape[0]
     eps2 = eps2_ref[0]
-    x = x_ref[:]
-    xx = jnp.sum(x * x, axis=1, keepdims=True)
+    ng = glo_ref.shape[0]
+    rlo = rlo_ref[...]
+    rhi = rhi_ref[...]
+    # Recentre every tile pair on the output tile's box center: operand
+    # magnitudes become tile-local, keeping the matmul expansion's
+    # cancellation error at eps scale.  Empty tiles carry inverted
+    # (+BIG, -BIG) bounds whose midpoint is 0 — recentring is a no-op.
+    c = jnp.transpose(0.5 * (rlo + rhi), (1, 0))
+    out_aug = _aug_out(x_ref[0], c)
     out_ref[0] = jnp.zeros_like(out_ref[0])
 
-    def tile_body(j, _):
-        gap2 = _tile_gap2(lo_ref, hi_ref, i, lo_ref, hi_ref, j)
-
-        @pl.when(gap2 <= eps2)
-        def _():
-            ydma = pltpu.make_async_copy(yhbm_ref.at[j], ybuf, ysem)
-            sdma = pltpu.make_async_copy(ysq_ref.at[j], sbuf, ssem)
-            ydma.start()
-            sdma.start()
-            ydma.wait()
-            sdma.wait()
-            d2 = _sq_dists(x, xx, ybuf[:], sbuf[0], precision)
-            adj = (d2 <= eps2).astype(jnp.int32)
-            out_ref[0] += jnp.sum(adj, axis=1, keepdims=True)
-
-        return 0
-
     def group_body(g, _):
-        # Group-level skip: one gap test covers `group` column tiles.
-        ggap2 = _tile_gap2(glo_ref, ghi_ref, i, lo_ref, hi_ref, g)
+        ggap2 = _gap2(
+            glo_ref[pl.ds(g, 1), :], ghi_ref[pl.ds(g, 1), :], rlo, rhi
+        )
 
         @pl.when(ggap2 <= eps2)
         def _():
-            jax.lax.fori_loop(g * group, (g + 1) * group, tile_body, 0)
+            # The group survived: fetch its per-tile boxes from HBM.
+            ldma = pltpu.make_async_copy(tblo_ref.at[g], blo, lsem)
+            hdma = pltpu.make_async_copy(tbhi_ref.at[g], bhi, hsem)
+            ldma.start()
+            hdma.start()
+            ldma.wait()
+            hdma.wait()
+
+            def tile_body(jj, _):
+                gap2 = _gap2(
+                    blo[pl.ds(jj, 1), :], bhi[pl.ds(jj, 1), :], rlo, rhi
+                )
+
+                @pl.when(gap2 <= eps2)
+                def _():
+                    ydma = pltpu.make_async_copy(
+                        yhbm_ref.at[g * group + jj], ybuf, ysem
+                    )
+                    ydma.start()
+                    ydma.wait()
+                    d2 = _dot_t(_aug_src(ybuf[:], c), out_aug, mode)
+                    adj = (d2 <= eps2).astype(jnp.int32)
+                    out_ref[0] += jnp.sum(adj, axis=0, keepdims=True)
+
+                return 0
+
+            jax.lax.fori_loop(0, group, tile_body, 0)
 
         return 0
 
@@ -135,112 +201,128 @@ def _count_kernel(
 
 
 def _minlab_kernel(
-    eps2_ref, lo_ref, hi_ref, rlo_ref, rhi_ref, glo_ref, ghi_ref, x_ref,
-    yhbm_ref, ysq_ref, ylab_ref, out_ref,
-    ybuf, sbuf, lbuf, ysem, ssem, lsem,
-    *, precision, group,
+    eps2_ref, glo_ref, ghi_ref, rlo_ref, rhi_ref, tblo_ref, tbhi_ref,
+    x_ref, yhbm_ref, ylab_ref, out_ref,
+    ybuf, lbuf, blo, bhi, ysem, labsem, lsem, hsem,
+    *, mode, group,
 ):
-    i = pl.program_id(0)
-    ng = glo_ref.shape[0]
     eps2 = eps2_ref[0]
-    x = x_ref[:]
-    xx = jnp.sum(x * x, axis=1, keepdims=True)
-    out_ref[0] = jnp.full_like(out_ref[0], _F_INF)
-
-    def tile_body(j, _):
-        gap2 = _tile_gap2(lo_ref, hi_ref, i, rlo_ref, rhi_ref, j)
-
-        @pl.when(gap2 <= eps2)
-        def _():
-            ydma = pltpu.make_async_copy(yhbm_ref.at[j], ybuf, ysem)
-            sdma = pltpu.make_async_copy(ysq_ref.at[j], sbuf, ssem)
-            ldma = pltpu.make_async_copy(ylab_ref.at[j], lbuf, lsem)
-            ydma.start()
-            sdma.start()
-            ldma.start()
-            ydma.wait()
-            sdma.wait()
-            ldma.wait()
-            d2 = _sq_dists(x, xx, ybuf[:], sbuf[0], precision)
-            cand = jnp.where(d2 <= eps2, lbuf[0], _F_INF)
-            out_ref[0] = jnp.minimum(
-                out_ref[0], jnp.min(cand, axis=1, keepdims=True)
-            )
-
-        return 0
+    ng = glo_ref.shape[0]
+    rlo = rlo_ref[...]
+    rhi = rhi_ref[...]
+    c = jnp.transpose(0.5 * (rlo + rhi), (1, 0))
+    out_aug = _aug_out(x_ref[0], c)
+    out_ref[0] = jnp.full_like(out_ref[0], _INT_INF)
 
     def group_body(g, _):
-        ggap2 = _tile_gap2(glo_ref, ghi_ref, i, rlo_ref, rhi_ref, g)
+        ggap2 = _gap2(
+            glo_ref[pl.ds(g, 1), :], ghi_ref[pl.ds(g, 1), :], rlo, rhi
+        )
 
         @pl.when(ggap2 <= eps2)
         def _():
-            jax.lax.fori_loop(g * group, (g + 1) * group, tile_body, 0)
+            ldma = pltpu.make_async_copy(tblo_ref.at[g], blo, lsem)
+            hdma = pltpu.make_async_copy(tbhi_ref.at[g], bhi, hsem)
+            ldma.start()
+            hdma.start()
+            ldma.wait()
+            hdma.wait()
+
+            def tile_body(jj, _):
+                gap2 = _gap2(
+                    blo[pl.ds(jj, 1), :], bhi[pl.ds(jj, 1), :], rlo, rhi
+                )
+
+                @pl.when(gap2 <= eps2)
+                def _():
+                    j = g * group + jj
+                    ydma = pltpu.make_async_copy(
+                        yhbm_ref.at[j], ybuf, ysem
+                    )
+                    labdma = pltpu.make_async_copy(
+                        ylab_ref.at[j], lbuf, labsem
+                    )
+                    ydma.start()
+                    labdma.start()
+                    ydma.wait()
+                    labdma.wait()
+                    d2 = _dot_t(_aug_src(ybuf[:], c), out_aug, mode)
+                    lab_col = jnp.transpose(lbuf[:], (1, 0))
+                    cand = jnp.where(d2 <= eps2, lab_col, _INT_INF)
+                    out_ref[0] = jnp.minimum(
+                        out_ref[0], jnp.min(cand, axis=0, keepdims=True)
+                    )
+
+                return 0
+
+            jax.lax.fori_loop(0, group, tile_body, 0)
 
         return 0
 
     jax.lax.fori_loop(0, ng, group_body, 0)
 
 
-def _pad_lanes(x: jnp.ndarray, d_pad: int) -> jnp.ndarray:
-    n, d = x.shape
-    if d == d_pad:
-        return x
-    return jnp.concatenate([x, jnp.zeros((n, d_pad - d), x.dtype)], axis=1)
-
-
-def _prep(points, mask, block, d_pad):
-    """Mask columns to BIG; compute tile bounds, squared norms, padded
-    column blocks."""
-    n, d = points.shape
+def _tiles_t(points, block, layout):
+    """Transposed tiles (nt, d, block) from (N, d) or (d, N) input."""
+    if layout == "nd":
+        n, d = points.shape
+        nt = n // block
+        return points.astype(jnp.float32).reshape(nt, block, d).transpose(
+            0, 2, 1
+        )
+    d, n = points.shape
     nt = n // block
-    pts_m = jnp.where(mask[:, None], points.astype(jnp.float32), BIG)
-    tiles = pts_m.reshape(nt, block, d)
-    # Bounds over masked coords: invalid points sit at +BIG, which would
-    # inflate the upper bound — mask them back out with the inverted-box
-    # convention (lo=+BIG, hi=-BIG for empty tiles).
-    m = mask.reshape(nt, block)[..., None]
-    lo = jnp.min(jnp.where(m, tiles, BIG), axis=1)
-    hi = jnp.max(jnp.where(m, tiles, -BIG), axis=1)
-    # Squared norms of masked coords overflow to +inf, which keeps masked
-    # columns out of every adjacency no matter what the matmul returns.
-    ysq = jnp.sum(pts_m * pts_m, axis=1).reshape(nt, 1, block)
-    ycols = _pad_lanes(pts_m, d_pad).reshape(nt, block, d_pad)
-    return ycols, ysq, lo, hi
+    return points.astype(jnp.float32).reshape(d, nt, block).transpose(1, 0, 2)
 
 
-GROUP = 16  # column tiles covered by one group-level gap test
+def _masked_bounds(tiles, mask_t):
+    """(nt, d) lower/upper bounds over masked points; empty tiles get
+    inverted (+BIG, -BIG) boxes so they always prune."""
+    lo = jnp.min(jnp.where(mask_t, tiles, BIG), axis=2)
+    hi = jnp.max(jnp.where(mask_t, tiles, -BIG), axis=2)
+    return lo, hi
 
 
-def _group_bounds(lo, hi):
-    """Coarse bounds over GROUP-sized runs of column tiles, padded with
-    inverted boxes so padded tiles always prune."""
+def _grouped_bounds(lo, hi):
+    """Pack (nt, d) per-tile bounds for the two-level pruning scheme.
+
+    Returns (tblo, tbhi, glo, ghi): per-tile boxes regrouped as
+    (ng, GROUP, d) HBM-resident arrays (DMA'd per surviving group) and
+    coarse per-group boxes (ng, d) kept in VMEM.  Padded tiles carry
+    inverted boxes and always prune.
+    """
     nt, d = lo.shape
     ng = -(-nt // GROUP)
     pad = ng * GROUP - nt
     lo_p = jnp.concatenate([lo, jnp.full((pad, d), BIG)], axis=0)
     hi_p = jnp.concatenate([hi, jnp.full((pad, d), -BIG)], axis=0)
-    glo = jnp.min(lo_p.reshape(ng, GROUP, d), axis=1)
-    ghi = jnp.max(hi_p.reshape(ng, GROUP, d), axis=1)
-    return lo_p, hi_p, glo, ghi
+    tblo = lo_p.reshape(ng, GROUP, d)
+    tbhi = hi_p.reshape(ng, GROUP, d)
+    glo = jnp.min(tblo, axis=1)
+    ghi = jnp.max(tbhi, axis=1)
+    return tblo, tbhi, glo, ghi
 
 
-def _pallas_block(block: int, n: int, d_pad: int) -> int:
-    """Largest row/column tile that keeps the fp32 distance tile plus
-    operand blocks comfortably inside VMEM and divides n."""
+def _pallas_block(block: int, n: int, d: int) -> int:
+    """Largest tile that keeps the fp32 distance tile plus operand
+    blocks comfortably inside VMEM and divides n."""
     b = min(block, n)
     while b > 128 and (
-        2 * b * b * 4 + 3 * b * d_pad * 4 > 10 * 1024 * 1024 or n % b != 0
+        2 * b * b * 4 + 4 * b * d * 4 > 10 * 1024 * 1024 or n % b != 0
     ):
         b //= 2
     return b
 
 
-def _round_up_128(d: int) -> int:
-    return -(-d // 128) * 128
+def _shape_nd(points, layout):
+    if layout == "nd":
+        return points.shape
+    d, n = points.shape
+    return n, d
 
 
 @functools.partial(
-    jax.jit, static_argnames=("block", "precision", "interpret")
+    jax.jit, static_argnames=("block", "precision", "interpret", "layout")
 )
 def neighbor_counts_pallas(
     points: jnp.ndarray,
@@ -249,56 +331,58 @@ def neighbor_counts_pallas(
     block: int = 1024,
     precision: str = "high",
     interpret: bool = False,
+    layout: str = "nd",
 ) -> jnp.ndarray:
     """Pallas analogue of :func:`pypardis_tpu.ops.distances.neighbor_counts`
     (Euclidean only)."""
-    n, d = points.shape
-    d_pad = _round_up_128(d)
-    block = _pallas_block(block, n, d_pad)
+    n, d = _shape_nd(points, layout)
+    mode = _norm_precision_mode(precision)
+    block = _pallas_block(block, n, d)
     assert n % block == 0, (n, block)
     nt = n // block
-    ycols, ysq, lo, hi = _prep(points, mask, block, d_pad)
-    xrows = ycols.reshape(n, d_pad)
-    lo_p, hi_p, glo, ghi = _group_bounds(lo, hi)
-    ntp, ng = lo_p.shape[0], glo.shape[0]
+    tiles = _tiles_t(points, block, layout)
+    mask_t = mask.reshape(nt, 1, block)
+    ycols = jnp.where(mask_t, tiles, BIG)
+    lo, hi = _masked_bounds(tiles, mask_t)
+    tblo, tbhi, glo, ghi = _grouped_bounds(lo, hi)
+    ng = glo.shape[0]
     eps2 = jnp.asarray(eps, jnp.float32).reshape(1) ** 2
 
     counts = pl.pallas_call(
-        functools.partial(
-            _count_kernel,
-            precision=_pallas_precision(precision),
-            group=GROUP,
-        ),
+        functools.partial(_count_kernel, mode=mode, group=GROUP),
         grid=(nt,),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((ntp, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((ntp, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((ng, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((ng, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec(
-                (block, d_pad), lambda i: (i, 0), memory_space=pltpu.VMEM
-            ),
+            pl.BlockSpec((1, d), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, d), lambda i: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.HBM),
+            pl.BlockSpec(memory_space=pltpu.HBM),
+            pl.BlockSpec(
+                (1, d, block), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
+            ),
             pl.BlockSpec(memory_space=pltpu.HBM),
         ],
         out_specs=pl.BlockSpec(
-            (1, block, 1), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
+            (1, 1, block), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
         ),
-        out_shape=jax.ShapeDtypeStruct((nt, block, 1), jnp.int32),
+        out_shape=jax.ShapeDtypeStruct((nt, 1, block), jnp.int32),
         scratch_shapes=[
-            pltpu.VMEM((block, d_pad), jnp.float32),
-            pltpu.VMEM((1, block), jnp.float32),
+            pltpu.VMEM((d, block), jnp.float32),
+            pltpu.VMEM((GROUP, d), jnp.float32),
+            pltpu.VMEM((GROUP, d), jnp.float32),
+            pltpu.SemaphoreType.DMA(()),
             pltpu.SemaphoreType.DMA(()),
             pltpu.SemaphoreType.DMA(()),
         ],
         interpret=interpret,
-    )(eps2, lo_p, hi_p, glo, ghi, xrows, ycols, ysq)
+    )(eps2, glo, ghi, lo, hi, tblo, tbhi, ycols, ycols)
     return jnp.where(mask, counts.reshape(-1), 0)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("block", "precision", "interpret")
+    jax.jit, static_argnames=("block", "precision", "interpret", "layout")
 )
 def min_neighbor_label_pallas(
     points: jnp.ndarray,
@@ -309,72 +393,71 @@ def min_neighbor_label_pallas(
     precision: str = "high",
     interpret: bool = False,
     row_mask: jnp.ndarray | None = None,
+    layout: str = "nd",
 ) -> jnp.ndarray:
     """Pallas analogue of
     :func:`pypardis_tpu.ops.distances.min_neighbor_label` (Euclidean).
 
-    Labels travel as float32 (exact below 2^24); INT32_MAX maps to +inf
-    and back.
+    Labels travel as int32 with sentinel INT32_MAX.  The coordinate
+    operand is masked by ``row_mask`` (validity); source restriction to
+    ``src_mask`` rides on the label sentinel — a non-source's INT32_MAX
+    never wins a min — so rows and columns share one array.  Rows
+    outside ``row_mask`` may return INT32_MAX; callers mask them.  The
+    default (``None``) covers ALL rows.
     """
-    n, d = points.shape
-    if n >= MAX_LABEL_POINTS:
-        raise ValueError(
-            f"pallas label kernel supports < 2^24 points per shard, got {n}"
-        )
-    d_pad = _round_up_128(d)
-    block = _pallas_block(block, n, d_pad)
+    n, d = _shape_nd(points, layout)
+    mode = _norm_precision_mode(precision)
+    block = _pallas_block(block, n, d)
     assert n % block == 0, (n, block)
     nt = n // block
-    ycols, ysq, lo, hi = _prep(points, src_mask, block, d_pad)
+    tiles = _tiles_t(points, block, layout)
     if row_mask is None:
-        rlo, rhi = lo, hi
+        ycols = tiles
+        rlo = jnp.min(tiles, axis=2)
+        rhi = jnp.max(tiles, axis=2)
     else:
-        _, _, rlo, rhi = _prep(points, row_mask, block, d_pad)
-    lo_p, hi_p, glo, ghi = _group_bounds(lo, hi)
-    ntp, ng = lo_p.shape[0], glo.shape[0]
-    # Row operand: raw coordinates — rows outside row_mask still get
-    # outputs; callers mask them.
-    xrows = _pad_lanes(points.astype(jnp.float32), d_pad)
-    labf = jnp.where(
-        src_mask & (labels != _INT_INF), labels.astype(jnp.float32), _F_INF
-    ).reshape(nt, 1, block)
+        rm = row_mask.reshape(nt, 1, block)
+        ycols = jnp.where(rm, tiles, BIG)
+        rlo, rhi = _masked_bounds(tiles, rm)
+    # Source-side pruning boxes cover src points only (tighter than the
+    # row-validity boxes; correctness only needs them to *cover* srcs).
+    slo, shi = _masked_bounds(tiles, src_mask.reshape(nt, 1, block))
+    tblo, tbhi, glo, ghi = _grouped_bounds(slo, shi)
+    ng = glo.shape[0]
+    labi = jnp.where(src_mask, labels, _INT_INF).reshape(nt, 1, block)
     eps2 = jnp.asarray(eps, jnp.float32).reshape(1) ** 2
 
     best = pl.pallas_call(
-        functools.partial(
-            _minlab_kernel,
-            precision=_pallas_precision(precision),
-            group=GROUP,
-        ),
+        functools.partial(_minlab_kernel, mode=mode, group=GROUP),
         grid=(nt,),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((ntp, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((ntp, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((nt, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((nt, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((ng, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((ng, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec(
-                (block, d_pad), lambda i: (i, 0), memory_space=pltpu.VMEM
-            ),
+            pl.BlockSpec((1, d), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, d), lambda i: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.HBM),
+            pl.BlockSpec(memory_space=pltpu.HBM),
+            pl.BlockSpec(
+                (1, d, block), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
+            ),
             pl.BlockSpec(memory_space=pltpu.HBM),
             pl.BlockSpec(memory_space=pltpu.HBM),
         ],
         out_specs=pl.BlockSpec(
-            (1, block, 1), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
+            (1, 1, block), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
         ),
-        out_shape=jax.ShapeDtypeStruct((nt, block, 1), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((nt, 1, block), jnp.int32),
         scratch_shapes=[
-            pltpu.VMEM((block, d_pad), jnp.float32),
-            pltpu.VMEM((1, block), jnp.float32),
-            pltpu.VMEM((1, block), jnp.float32),
+            pltpu.VMEM((d, block), jnp.float32),
+            pltpu.VMEM((1, block), jnp.int32),
+            pltpu.VMEM((GROUP, d), jnp.float32),
+            pltpu.VMEM((GROUP, d), jnp.float32),
+            pltpu.SemaphoreType.DMA(()),
             pltpu.SemaphoreType.DMA(()),
             pltpu.SemaphoreType.DMA(()),
             pltpu.SemaphoreType.DMA(()),
         ],
         interpret=interpret,
-    )(eps2, lo_p, hi_p, rlo, rhi, glo, ghi, xrows, ycols, ysq, labf)
-    best = best.reshape(-1)
-    return jnp.where(jnp.isfinite(best), best.astype(jnp.int32), _INT_INF)
+    )(eps2, glo, ghi, rlo, rhi, tblo, tbhi, ycols, ycols, labi)
+    return best.reshape(-1)

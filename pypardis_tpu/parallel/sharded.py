@@ -55,10 +55,17 @@ def _expanded_frame(points, partitioner, eps):
     same frame.  All halo membership decisions — host box query and
     device-side ring filter — must evaluate in exactly these numbers so
     borderline points land identically everywhere.
+
+    Boundary tolerance: membership is evaluated in float32, so a point
+    the reference's float64 filter would include could sit one f32 ULP
+    outside the expanded box after recentring/rounding.  The expanded
+    bounds are therefore widened by 4 ULPs of their own magnitude —
+    covering the recentring rounding error while staying ~1e-6-relative,
+    far below any meaningful eps.
     """
-    points = np.asarray(points, dtype=np.float64)
-    center = points.mean(axis=0)
-    pts32 = (points - center).astype(np.float32)
+    points = np.asarray(points)
+    center = points.mean(axis=0, dtype=np.float64)
+    pts32 = _recentre_f32(points, center)
     labels = sorted(partitioner.partitions)
     stack = BoxStack.from_boxes(
         partitioner.bounding_boxes[l] for l in labels
@@ -66,16 +73,35 @@ def _expanded_frame(points, partitioner, eps):
     exp = stack.expand(2 * eps)
     exp_lo = (exp.lower - center).astype(np.float32)
     exp_hi = (exp.upper - center).astype(np.float32)
+    ulp_lo = np.spacing(np.abs(exp_lo), dtype=np.float32)
+    ulp_hi = np.spacing(np.abs(exp_hi), dtype=np.float32)
+    exp_lo = exp_lo - 4 * ulp_lo
+    exp_hi = exp_hi + 4 * ulp_hi
     return pts32, exp_lo, exp_hi, labels
 
 
-def _owned_layout(points, pts32, partitioner, labels, n_shards, block):
+def _recentre_f32(points, center, chunk: int = 1 << 20):
+    """(points - center) as float32 without a full-size float64 temp.
+
+    A whole-array ``points - center`` would materialize an (N, k) float64
+    intermediate (the round-1 memory wall); chunking keeps the peak extra
+    memory at O(chunk * k) regardless of N.
+    """
+    points = np.asarray(points)
+    out = np.empty(points.shape, np.float32)
+    for s in range(0, len(points), chunk):
+        e = min(s + chunk, len(points))
+        np.subtract(points[s:e], center, out=out[s:e], casting="unsafe")
+    return out
+
+
+def _owned_layout(pts32, partitioner, labels, n_shards, block):
     """(P, cap, ...) owned slabs, Morton-sorted per partition."""
     n, k = pts32.shape
     p_real = len(labels)
     p_total = round_up(max(p_real, n_shards), n_shards)
     owned_idx = [
-        idx[spatial_order(points[idx])] if len(idx) else idx
+        idx[spatial_order(pts32[idx])] if len(idx) else idx
         for idx in (partitioner.partitions[l] for l in labels)
     ]
     cap = round_up(max(len(i) for i in owned_idx), block)
@@ -95,10 +121,9 @@ def build_owned_shards(points, partitioner, eps, n_shards, block):
     The halo sets are never materialized on the host — sizing and
     duplication happen device-side (halo.ring_halo_exchange).
     """
-    points = np.asarray(points, dtype=np.float64)
     pts32, exp_lo, exp_hi, labels = _expanded_frame(points, partitioner, eps)
     _, arrays, cap, p_total = _owned_layout(
-        points, pts32, partitioner, labels, n_shards, block
+        pts32, partitioner, labels, n_shards, block
     )
     stats = {
         "owned_cap": cap,
@@ -119,22 +144,27 @@ def build_shards(points, partitioner, eps, n_shards, block):
     across shards; padded slots carry gid == N (a dump row in the
     scatter arrays).
     """
-    points = np.asarray(points, dtype=np.float64)
+    points = np.asarray(points)
     n, k = points.shape
     pts32, exp_lo, exp_hi, labels = _expanded_frame(points, partitioner, eps)
-    # Membership of every point in every expanded box: (N, P_real),
-    # evaluated in the shared recentred float32 frame (f32 values promote
-    # exactly into BoxStack's f64 comparison).
-    member = BoxStack(exp_lo, exp_hi).membership(pts32)
+    # Halo sets from an O(N·depth) split-tree replay with 2*eps-widened
+    # comparisons — never a broadcasted (N, P, k) membership temp (the
+    # round-1 memory wall).  Replay runs on the raw points in float64
+    # boundary arithmetic: exact, and over-inclusion relative to the f32
+    # ring-filter frame is harmless (extra halo context never changes an
+    # owned point's result).
+    from ..partition import expanded_members
+
+    members = expanded_members(partitioner.tree, points, 2 * eps)
     halo_idx = []
-    for j, l in enumerate(labels):
-        m = member[:, j].copy()
-        m[partitioner.partitions[l]] = False
-        idx = np.nonzero(m)[0]
-        halo_idx.append(idx[spatial_order(points[idx])] if len(idx) else idx)
+    for l in labels:
+        arr, own = members[l]
+        idx = arr[~own]
+        halo_idx.append(idx[spatial_order(pts32[idx])] if len(idx) else idx)
+    del members
 
     owned_idx, (owned, owned_mask, owned_gid), cap, p_total = _owned_layout(
-        points, pts32, partitioner, labels, n_shards, block
+        pts32, partitioner, labels, n_shards, block
     )
     hcap = round_up(max(max((len(h) for h in halo_idx), default=1), 1), block)
     halo = np.zeros((p_total, hcap, k), np.float32)

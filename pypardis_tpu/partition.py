@@ -91,7 +91,9 @@ def morton_codes(points: np.ndarray, bits: int = 10, max_axes: int = 6):
     codes fit in 64 bits; quantization is ``bits`` per axis over the
     data's range.
     """
-    points = np.asarray(points, dtype=np.float64)
+    points = np.asarray(points)
+    if points.dtype not in (np.float32, np.float64):
+        points = points.astype(np.float64)
     if points.ndim != 2:
         raise ValueError(f"points must be (N, k), got {points.shape}")
     max_axes = min(max_axes, 64 // bits)  # interleaved code must fit uint64
@@ -100,7 +102,9 @@ def morton_codes(points: np.ndarray, bits: int = 10, max_axes: int = 6):
         points = points[:, np.sort(axes)]
     k = points.shape[1]
     lo = points.min(axis=0)
-    span = np.maximum(points.max(axis=0) - lo, 1e-300)
+    # Floor must not underflow the input dtype (1e-300 is 0 in float32,
+    # which made all-equal axes divide by zero).
+    span = np.maximum(points.max(axis=0) - lo, np.finfo(points.dtype).tiny)
     q = np.minimum(
         ((points - lo) / span * (1 << bits)).astype(np.uint64), (1 << bits) - 1
     )
@@ -109,6 +113,40 @@ def morton_codes(points: np.ndarray, bits: int = 10, max_axes: int = 6):
         for a in range(k):
             codes = (codes << np.uint64(1)) | ((q[:, a] >> np.uint64(b)) & np.uint64(1))
     return codes
+
+
+def expanded_members(tree, points: np.ndarray, margin: float):
+    """Membership of every point in every margin-expanded partition box,
+    by replaying the split tree with widened comparisons.
+
+    This replaces the broadcasted (N, P, k) box query (the round-1 memory
+    wall) with an O(N·depth) descent: at each recorded split, a point
+    follows the left branch when ``x < boundary + margin`` and the right
+    branch when ``x >= boundary - margin`` — both when inside the band.
+    Because a leaf's expanded box is exactly the conjunction of its path's
+    margin-widened half-space constraints (the root box contains all data
+    points by construction), the descent reproduces the reference's
+    expanded-box duplication semantics (dbscan.py:141-151, README.md:20-22)
+    while the peak extra memory is the duplicated index lists themselves —
+    O(N · halo_factor), independent of P and k.
+
+    Returns ``{label: (member_idx, owned_mask)}`` where ``member_idx`` is
+    an int array of point indices inside the label's expanded box and
+    ``owned_mask`` marks the ones strictly owned by the partition (the
+    same ``<`` semantics as :class:`KDPartitioner`), so the halo set is
+    ``member_idx[~owned_mask]``.
+    """
+    points = np.asarray(points)
+    n = len(points)
+    state = {0: (np.arange(n), np.ones(n, dtype=bool))}
+    for parent, axis, boundary, _left, right in tree:
+        arr, own = state.pop(int(parent))
+        c = points[arr, int(axis)].astype(np.float64, copy=False)
+        lsel = c < boundary + margin
+        rsel = c >= boundary - margin
+        state[int(parent)] = (arr[lsel], own[lsel] & (c[lsel] < boundary))
+        state[int(right)] = (arr[rsel], own[rsel] & (c[rsel] >= boundary))
+    return state
 
 
 def route_tree(tree, points: np.ndarray) -> np.ndarray:
@@ -182,7 +220,12 @@ class KDPartitioner:
         sample_size: Optional[int] = 1_000_000,
         seed: int = 0,
     ):
-        points = np.asarray(data, dtype=np.float64)
+        # Keep the caller's dtype: forcing float64 here doubled host
+        # memory for float32 datasets (round-1 finding).  Split math
+        # runs in float64 on (sub)samples regardless.
+        points = np.asarray(data)
+        if points.dtype not in (np.float32, np.float64):
+            points = points.astype(np.float64)
         if points.ndim != 2:
             raise ValueError(f"data must be (N, k), got shape {points.shape}")
         self.points = points

@@ -118,8 +118,6 @@ def test_resolve_backend_rules():
     assert resolve_backend("auto", "l2", 1024, 1024) == resolve_backend(
         "auto", "euclidean", 1024, 1024
     )
-    # >= 2^24-point shards stay on XLA under auto
-    assert resolve_backend("auto", "euclidean", 1 << 24, 1024) == "xla"
     assert resolve_backend("xla", "euclidean") == "xla"
     assert resolve_backend("pallas", "euclidean") == "pallas"
     assert resolve_backend("pallas", "l2") == "pallas"

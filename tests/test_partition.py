@@ -78,3 +78,38 @@ def test_result_labels_consistent():
     part = KDPartitioner(pts, max_partitions=4)
     for label, idx in part.partitions.items():
         assert (part.result[idx] == label).all()
+
+
+def test_expanded_members_matches_box_membership():
+    """Tree-replay halo routing == brute-force expanded-box query."""
+    from pypardis_tpu.geometry import BoxStack
+    from pypardis_tpu.partition import expanded_members
+
+    rng = np.random.default_rng(7)
+    pts = rng.normal(size=(4000, 3))
+    part = KDPartitioner(pts, max_partitions=16)
+    eps = 0.15
+    labels = sorted(part.bounding_boxes)
+    stack = BoxStack.from_boxes(
+        part.bounding_boxes[l] for l in labels
+    ).expand(2 * eps)
+    member = stack.membership(pts)  # (N, P) oracle
+
+    state = expanded_members(part.tree, pts, 2 * eps)
+    assert set(state) == set(labels)
+    for j, l in enumerate(labels):
+        arr, own = state[l]
+        np.testing.assert_array_equal(
+            np.sort(arr), np.nonzero(member[:, j])[0]
+        )
+        # Strict-ownership flags reproduce the partitioner's assignment.
+        np.testing.assert_array_equal(
+            np.sort(arr[own]), np.sort(part.partitions[l])
+        )
+
+
+def test_partitioner_preserves_float32():
+    pts = np.random.default_rng(8).normal(size=(1000, 2)).astype(np.float32)
+    part = KDPartitioner(pts, max_partitions=4)
+    assert part.points.dtype == np.float32  # no silent f64 doubling
+    assert part.n_partitions == 4
