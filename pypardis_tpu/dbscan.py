@@ -27,8 +27,8 @@ import numpy as np
 
 from .aggregator import ClusterAggregator
 from .geometry import BoundingBox
-from .ops import dbscan_fixed_size, densify_labels
-from .partition import KDPartitioner, spatial_order
+from .ops import densify_labels
+from .partition import KDPartitioner
 from .utils import clamp_block, round_up
 from .utils.log import log_phase
 
@@ -86,19 +86,25 @@ def _pad_and_run(
     """
     import jax.numpy as jnp
 
+    from .ops.pipeline import dbscan_device_pipeline
+
     points = _as_float(points)
     n, k = points.shape
     block = clamp_block(block, n)
     cap = round_up(n, block)
-    order = None
-    if sort and n > 2 * block:
-        order = spatial_order(points)
-        points = points[order]
+    # Host keeps only the float64 mean (float32 accumulation would lose
+    # the centering accuracy that protects the |x|^2+|y|^2-2xy expansion
+    # at GPS-scale magnitudes) and the zero-pad to cap — so device
+    # programs are keyed on the coarse cap, and nearby partition sizes
+    # share one compilation.  Everything else — Morton coding, sort, the
+    # kernel, un-permutation — runs in one device program
+    # (:mod:`pypardis_tpu.ops.pipeline`), and the result comes back as a
+    # single packed transfer: device->host latency is a fixed cost per
+    # transfer, not per byte, on tunneled deployments.  Transposed
+    # (k, cap) layout: XLA:TPU pads the minor axis of an (N, small-k)
+    # buffer to 128 lanes (8x HBM at k=16); point-axis-minor is dense.
+    # Chunked recentring: no full-size float64 temp at any N.
     center = points.mean(axis=0, dtype=np.float64)
-    # Transposed (k, cap) device layout: XLA:TPU pads the minor axis of
-    # an (N, small-k) buffer to 128 lanes (8x HBM at k=16); keeping the
-    # point axis minor stores it dense.  Chunked recentring: no
-    # full-size float64 temp at any N.
     pts_t = np.zeros((k, cap), np.float32)
     chunk = 1 << 20
     for s in range(0, n, chunk):
@@ -107,32 +113,20 @@ def _pad_and_run(
             points[s:e].T, center[:, None], out=pts_t[:, s:e],
             casting="unsafe",
         )
-    mask = np.zeros(cap, bool)
-    mask[:n] = True
-    roots, core = dbscan_fixed_size(
-        jnp.asarray(pts_t),
-        eps,
-        min_samples,
-        jnp.asarray(mask),
-        metric=metric,
-        block=block,
-        precision=precision,
-        backend=backend,
-        layout="dn",
+    packed = np.array(
+        dbscan_device_pipeline(
+            jnp.asarray(pts_t),
+            eps,
+            n,
+            min_samples=min_samples,
+            metric=metric,
+            block=block,
+            precision=precision,
+            backend=backend,
+            sort=bool(sort and n > 2 * block),
+        )
     )
-    # np.array (not asarray): device buffers are read-only views.
-    roots, core = np.array(roots[:n]), np.array(core[:n])
-    if order is not None:
-        # Map sorted-space root indices back to original point ids, then
-        # scatter rows back to the original order.
-        valid = roots >= 0
-        roots[valid] = order[roots[valid]]
-        inv_roots = np.empty(n, roots.dtype)
-        inv_core = np.empty(n, core.dtype)
-        inv_roots[order] = roots
-        inv_core[order] = core
-        roots, core = inv_roots, inv_core
-    return roots, core
+    return packed[0, :n], packed[1, :n].astype(bool)
 
 
 def dbscan_partition(iterable, params):

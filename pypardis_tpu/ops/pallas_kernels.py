@@ -143,20 +143,28 @@ def _gap2(lo_a, hi_a, lo_b, hi_b):
 
 
 def _count_kernel(
-    eps2_ref, glo_ref, ghi_ref, rlo_ref, rhi_ref, tblo_ref, tbhi_ref,
+    eps2_ref, glo_ref, ghi_ref, rlo_ref, rhi_ref, c_ref, tblo_ref, tbhi_ref,
     x_ref, yhbm_ref, out_ref,
     ybuf, blo, bhi, ysem, lsem, hsem,
     *, mode, group,
 ):
     eps2 = eps2_ref[0]
     ng = glo_ref.shape[0]
-    rlo = rlo_ref[...]
-    rhi = rhi_ref[...]
+    # Row-tile bounds arrive as a (1, 1, dp) grid-sliced block (the
+    # leading singleton keeps the last two block dims equal to the array
+    # dims, and dp is the lane-padded d — both Mosaic layout
+    # requirements); drop it to the (1, dp) row shape.  Padded lanes are
+    # zero in every box, contributing zero gap.
+    rlo = rlo_ref[0]
+    rhi = rhi_ref[0]
     # Recentre every tile pair on the output tile's box center: operand
     # magnitudes become tile-local, keeping the matmul expansion's
     # cancellation error at eps scale.  Empty tiles carry inverted
     # (+BIG, -BIG) bounds whose midpoint is 0 — recentring is a no-op.
-    c = jnp.transpose(0.5 * (rlo + rhi), (1, 0))
+    # The (d, 1) center rides as its own unpadded input: the bounds are
+    # lane-padded for DMA tiling, so deriving it in-kernel would need a
+    # lane slice.
+    c = c_ref[0]
     out_aug = _aug_out(x_ref[0], c)
     out_ref[0] = jnp.zeros_like(out_ref[0])
 
@@ -201,16 +209,16 @@ def _count_kernel(
 
 
 def _minlab_kernel(
-    eps2_ref, glo_ref, ghi_ref, rlo_ref, rhi_ref, tblo_ref, tbhi_ref,
+    eps2_ref, glo_ref, ghi_ref, rlo_ref, rhi_ref, c_ref, tblo_ref, tbhi_ref,
     x_ref, yhbm_ref, ylab_ref, out_ref,
     ybuf, lbuf, blo, bhi, ysem, labsem, lsem, hsem,
     *, mode, group,
 ):
     eps2 = eps2_ref[0]
     ng = glo_ref.shape[0]
-    rlo = rlo_ref[...]
-    rhi = rhi_ref[...]
-    c = jnp.transpose(0.5 * (rlo + rhi), (1, 0))
+    rlo = rlo_ref[0]
+    rhi = rhi_ref[0]
+    c = c_ref[0]
     out_aug = _aug_out(x_ref[0], c)
     out_ref[0] = jnp.full_like(out_ref[0], _INT_INF)
 
@@ -283,12 +291,26 @@ def _masked_bounds(tiles, mask_t):
     return lo, hi
 
 
+def _lane_pad(a, dp):
+    """Zero-pad the last (lane) dim of (nt, d) bounds to dp.
+
+    HBM DMA slices must be 128-aligned on the lane dim (Mosaic memref
+    tiling); a zero lower *and* upper bound in the padded lanes makes
+    every box-gap contribution there exactly zero, so padding never
+    changes a pruning decision.
+    """
+    nt, d = a.shape
+    if dp == d:
+        return a
+    return jnp.concatenate([a, jnp.zeros((nt, dp - d), a.dtype)], axis=1)
+
+
 def _grouped_bounds(lo, hi):
-    """Pack (nt, d) per-tile bounds for the two-level pruning scheme.
+    """Pack (nt, dp) per-tile bounds for the two-level pruning scheme.
 
     Returns (tblo, tbhi, glo, ghi): per-tile boxes regrouped as
-    (ng, GROUP, d) HBM-resident arrays (DMA'd per surviving group) and
-    coarse per-group boxes (ng, d) kept in VMEM.  Padded tiles carry
+    (ng, GROUP, dp) HBM-resident arrays (DMA'd per surviving group) and
+    coarse per-group boxes (ng, dp) kept in VMEM.  Padded tiles carry
     inverted boxes and always prune.
     """
     nt, d = lo.shape
@@ -340,11 +362,15 @@ def neighbor_counts_pallas(
     block = _pallas_block(block, n, d)
     assert n % block == 0, (n, block)
     nt = n // block
+    dp = -(-d // 128) * 128
     tiles = _tiles_t(points, block, layout)
     mask_t = mask.reshape(nt, 1, block)
     ycols = jnp.where(mask_t, tiles, BIG)
     lo, hi = _masked_bounds(tiles, mask_t)
-    tblo, tbhi, glo, ghi = _grouped_bounds(lo, hi)
+    centers = (0.5 * (lo + hi))[:, :, None]
+    lo_p = _lane_pad(lo, dp)
+    hi_p = _lane_pad(hi, dp)
+    tblo, tbhi, glo, ghi = _grouped_bounds(lo_p, hi_p)
     ng = glo.shape[0]
     eps2 = jnp.asarray(eps, jnp.float32).reshape(1) ** 2
 
@@ -353,10 +379,17 @@ def neighbor_counts_pallas(
         grid=(nt,),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((ng, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((ng, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, d), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, d), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((ng, dp), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((ng, dp), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (1, 1, dp), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (1, 1, dp), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (1, d, 1), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
+            ),
             pl.BlockSpec(memory_space=pltpu.HBM),
             pl.BlockSpec(memory_space=pltpu.HBM),
             pl.BlockSpec(
@@ -370,14 +403,18 @@ def neighbor_counts_pallas(
         out_shape=jax.ShapeDtypeStruct((nt, 1, block), jnp.int32),
         scratch_shapes=[
             pltpu.VMEM((d, block), jnp.float32),
-            pltpu.VMEM((GROUP, d), jnp.float32),
-            pltpu.VMEM((GROUP, d), jnp.float32),
+            pltpu.VMEM((GROUP, dp), jnp.float32),
+            pltpu.VMEM((GROUP, dp), jnp.float32),
             pltpu.SemaphoreType.DMA(()),
             pltpu.SemaphoreType.DMA(()),
             pltpu.SemaphoreType.DMA(()),
         ],
         interpret=interpret,
-    )(eps2, glo, ghi, lo, hi, tblo, tbhi, ycols, ycols)
+    )(
+        eps2, glo, ghi,
+        lo_p.reshape(nt, 1, dp), hi_p.reshape(nt, 1, dp),
+        centers, tblo, tbhi, ycols, ycols,
+    )
     return jnp.where(mask, counts.reshape(-1), 0)
 
 
@@ -410,6 +447,7 @@ def min_neighbor_label_pallas(
     block = _pallas_block(block, n, d)
     assert n % block == 0, (n, block)
     nt = n // block
+    dp = -(-d // 128) * 128
     tiles = _tiles_t(points, block, layout)
     if row_mask is None:
         ycols = tiles
@@ -419,10 +457,15 @@ def min_neighbor_label_pallas(
         rm = row_mask.reshape(nt, 1, block)
         ycols = jnp.where(rm, tiles, BIG)
         rlo, rhi = _masked_bounds(tiles, rm)
+    centers = (0.5 * (rlo + rhi))[:, :, None]
+    rlo_p = _lane_pad(rlo, dp)
+    rhi_p = _lane_pad(rhi, dp)
     # Source-side pruning boxes cover src points only (tighter than the
     # row-validity boxes; correctness only needs them to *cover* srcs).
     slo, shi = _masked_bounds(tiles, src_mask.reshape(nt, 1, block))
-    tblo, tbhi, glo, ghi = _grouped_bounds(slo, shi)
+    tblo, tbhi, glo, ghi = _grouped_bounds(
+        _lane_pad(slo, dp), _lane_pad(shi, dp)
+    )
     ng = glo.shape[0]
     labi = jnp.where(src_mask, labels, _INT_INF).reshape(nt, 1, block)
     eps2 = jnp.asarray(eps, jnp.float32).reshape(1) ** 2
@@ -432,10 +475,17 @@ def min_neighbor_label_pallas(
         grid=(nt,),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((ng, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((ng, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, d), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, d), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((ng, dp), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((ng, dp), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (1, 1, dp), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (1, 1, dp), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (1, d, 1), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
+            ),
             pl.BlockSpec(memory_space=pltpu.HBM),
             pl.BlockSpec(memory_space=pltpu.HBM),
             pl.BlockSpec(
@@ -451,13 +501,17 @@ def min_neighbor_label_pallas(
         scratch_shapes=[
             pltpu.VMEM((d, block), jnp.float32),
             pltpu.VMEM((1, block), jnp.int32),
-            pltpu.VMEM((GROUP, d), jnp.float32),
-            pltpu.VMEM((GROUP, d), jnp.float32),
+            pltpu.VMEM((GROUP, dp), jnp.float32),
+            pltpu.VMEM((GROUP, dp), jnp.float32),
             pltpu.SemaphoreType.DMA(()),
             pltpu.SemaphoreType.DMA(()),
             pltpu.SemaphoreType.DMA(()),
             pltpu.SemaphoreType.DMA(()),
         ],
         interpret=interpret,
-    )(eps2, glo, ghi, rlo, rhi, tblo, tbhi, ycols, ycols, labi)
+    )(
+        eps2, glo, ghi,
+        rlo_p.reshape(nt, 1, dp), rhi_p.reshape(nt, 1, dp),
+        centers, tblo, tbhi, ycols, ycols, labi,
+    )
     return best.reshape(-1)
