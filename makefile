@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: all wheel native test bench demo clean
+.PHONY: all wheel native test tpu-smoke bench demo clean
 
 all: native test
 
@@ -20,6 +20,12 @@ native:
 
 test:
 	$(PY) -m pytest tests/ -q
+
+# Hardware validation: compiles + runs the Pallas kernels through Mosaic
+# on the real chip (tests skip themselves off-TPU). Run before shipping
+# any kernel change — CPU CI cannot catch lowering breaks.
+tpu-smoke:
+	PYPARDIS_TEST_PLATFORM=native $(PY) -m pytest tests/test_tpu_smoke.py -q
 
 bench:
 	$(PY) bench.py

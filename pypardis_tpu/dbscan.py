@@ -30,7 +30,13 @@ from .geometry import BoundingBox
 from .ops import densify_labels
 from .partition import KDPartitioner
 from .utils import clamp_block, round_up
-from .utils.log import log_phase
+from .utils.log import get_logger, log_phase
+
+
+def jax_backend_name() -> str:
+    import jax
+
+    return jax.default_backend()
 
 
 def _as_keys_points(data):
@@ -113,19 +119,38 @@ def _pad_and_run(
             points[s:e].T, center[:, None], out=pts_t[:, s:e],
             casting="unsafe",
         )
-    packed = np.array(
-        dbscan_device_pipeline(
-            jnp.asarray(pts_t),
-            eps,
-            n,
-            min_samples=min_samples,
-            metric=metric,
-            block=block,
-            precision=precision,
-            backend=backend,
-            sort=bool(sort and n > 2 * block),
+    def run(be):
+        return np.array(
+            dbscan_device_pipeline(
+                jnp.asarray(pts_t),
+                eps,
+                n,
+                min_samples=min_samples,
+                metric=metric,
+                block=block,
+                precision=precision,
+                backend=be,
+                sort=bool(sort and n > 2 * block),
+            )
         )
-    )
+
+    try:
+        packed = run(backend)
+    except Exception as e:  # noqa: BLE001 — rethrown unless a kernel fails
+        from .ops.labels import is_kernel_lowering_error
+
+        # 'auto' promises a working default: a Pallas build that cannot
+        # lower on this chip degrades to the XLA path with a warning
+        # instead of a Mosaic internals dump.  An explicit
+        # backend='pallas' stays strict (hardware smoke tests rely on
+        # it actually exercising Mosaic).
+        if backend != "auto" or not is_kernel_lowering_error(e):
+            raise
+        get_logger().warning(
+            "Pallas kernel failed to lower on %s; falling back to the "
+            "XLA kernel path (%s)", jax_backend_name(), e,
+        )
+        packed = run("xla")
     return packed[0, :n], packed[1, :n].astype(bool)
 
 

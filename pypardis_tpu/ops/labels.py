@@ -62,6 +62,27 @@ def resolve_backend(
     return backend
 
 
+def is_kernel_lowering_error(exc: BaseException) -> bool:
+    """True when ``exc`` plausibly comes from a Pallas kernel failing to
+    lower or compile (Mosaic rejection, VMEM overflow, unsupported op).
+
+    Used by the drivers to degrade ``backend='auto'`` to the XLA path
+    with a warning instead of surfacing Mosaic internals to the user
+    (round-2 regression: a lowering-illegal kernel made the *default*
+    TPU path crash).  Walks the cause/context chain because JAX wraps
+    compile errors at several layers.
+    """
+    seen = set()
+    e: BaseException | None = exc
+    while e is not None and id(e) not in seen:
+        seen.add(id(e))
+        txt = f"{type(e).__name__}: {e}"
+        if "Mosaic" in txt or "mosaic" in txt or "pallas" in txt.lower():
+            return True
+        e = e.__cause__ or e.__context__
+    return False
+
+
 def _pointer_jump(f: jnp.ndarray, active: jnp.ndarray) -> jnp.ndarray:
     """Chase f -> f[f] to a fixpoint (path shortcutting).
 

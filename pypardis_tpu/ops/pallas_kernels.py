@@ -44,9 +44,13 @@ Numerics:
   scale (the dataset-level recentring in the drivers bounds it further);
 * ``precision="high"`` (default) runs a manual **3-pass bf16 split
   matmul** (hi/lo decomposition: ``x = hi(x) + lo(x)``, dropping only
-  the lo*lo term, ~2^-18-relative error — fp32-class accuracy at half
-  the MXU passes of HIGHEST).  Mosaic has no native bf16_3x, which in
-  round 1 silently upgraded "high" to HIGHEST and cost 2x.
+  the lo*lo term).  The dropped term is ~2^-18 relative to *operand
+  magnitude* — i.e. fp32-class only when tiles are spatially tight
+  (the Morton-sorted driver layout); on loose tiles the absolute d2
+  error can reach coordinate scale x 2^-18 and flip shell-adjacent
+  pairs (bounded in tests/test_tpu_smoke.py; cluster-level output is
+  ARI-stable).  Mosaic has no native bf16_3x, which in round 1
+  silently upgraded "high" to HIGHEST and cost 2x.
 * ``precision="highest"`` uses native HIGHEST; ``"default"`` a single
   bf16 pass (fast, ~2^-8-relative — opt-in only).
 

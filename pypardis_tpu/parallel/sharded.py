@@ -434,6 +434,26 @@ def sharded_step_ring(
 # ---------------------------------------------------------------------------
 
 
+def _with_kernel_fallback(fn, backend):
+    """Run ``fn(backend)``; if 'auto' selected a Pallas kernel that fails
+    to lower on this chip, degrade to the XLA path with a warning (see
+    ops.labels.is_kernel_lowering_error).  Explicit 'pallas' stays
+    strict."""
+    try:
+        return fn(backend)
+    except Exception as e:  # noqa: BLE001 — rethrown unless a kernel fails
+        from ..ops.labels import is_kernel_lowering_error
+        from ..utils.log import get_logger
+
+        if backend != "auto" or not is_kernel_lowering_error(e):
+            raise
+        get_logger().warning(
+            "Pallas kernel failed to lower on %s; falling back to the "
+            "XLA kernel path (%s)", jax.default_backend(), e,
+        )
+        return fn("xla")
+
+
 def sharded_dbscan(
     points,
     partitioner,
@@ -499,18 +519,21 @@ def sharded_dbscan(
         )
         max_attempts = 1 if explicit else 4
         for _attempt in range(max_attempts):
-            labels, core, overflow = sharded_step_ring(
-                *args,
-                eps=float(eps),
-                min_samples=int(min_samples),
-                metric=metric,
-                block=block,
-                mesh=mesh,
-                axis=axis,
-                n_points=len(points),
-                precision=precision,
-                backend=backend,
-                hcap=this_hcap,
+            labels, core, overflow = _with_kernel_fallback(
+                lambda be, hc=this_hcap: sharded_step_ring(
+                    *args,
+                    eps=float(eps),
+                    min_samples=int(min_samples),
+                    metric=metric,
+                    block=block,
+                    mesh=mesh,
+                    axis=axis,
+                    n_points=len(points),
+                    precision=precision,
+                    backend=be,
+                    hcap=hc,
+                ),
+                backend,
             )
             if int(np.asarray(overflow).sum()) == 0:
                 break
@@ -528,17 +551,20 @@ def sharded_dbscan(
         return _canonicalize_roots(labels, core), core, stats
     arrays, stats = build_shards(points, partitioner, eps, n_shards, block)
     arrays = tuple(jax.device_put(a, sharding) for a in arrays)
-    labels, core = sharded_step(
-        *arrays,
-        eps=float(eps),
-        min_samples=int(min_samples),
-        metric=metric,
-        block=block,
-        mesh=mesh,
-        axis=axis,
-        n_points=len(points),
-        precision=precision,
-        backend=backend,
+    labels, core = _with_kernel_fallback(
+        lambda be: sharded_step(
+            *arrays,
+            eps=float(eps),
+            min_samples=int(min_samples),
+            metric=metric,
+            block=block,
+            mesh=mesh,
+            axis=axis,
+            n_points=len(points),
+            precision=precision,
+            backend=be,
+        ),
+        backend,
     )
     labels, core = np.asarray(labels), np.asarray(core)
     return _canonicalize_roots(labels, core), core, stats

@@ -12,17 +12,25 @@ Note: this image's sitecustomize pre-imports jax and pins
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# PYPARDIS_TEST_PLATFORM=native leaves the ambient JAX platform alone —
+# that's how `make tpu-smoke` runs tests/test_tpu_smoke.py against the
+# real chip (the smoke tests skip themselves off-TPU; everything else
+# here asserts the 8-device mesh and skips under native).
+_NATIVE = os.environ.get("PYPARDIS_TEST_PLATFORM") == "native"
+
+if not _NATIVE:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+if not _NATIVE:
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
 
 import numpy as np
 import pytest
@@ -30,7 +38,8 @@ import pytest
 
 @pytest.fixture(scope="session", autouse=True)
 def _assert_eight_devices():
-    assert jax.device_count() == 8, jax.devices()
+    if not _NATIVE:
+        assert jax.device_count() == 8, jax.devices()
 
 
 @pytest.fixture

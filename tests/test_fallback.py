@@ -1,0 +1,101 @@
+"""Graceful degradation when a Pallas kernel cannot lower.
+
+Round 2's regression mode: 'auto' routed TPU to a Pallas kernel that
+Mosaic rejected, so the *default* path crashed with a compiler internals
+dump.  The drivers now catch lowering failures and retry on the XLA
+path with a warning — but only for 'auto'; an explicit
+``backend='pallas'`` must stay strict so hardware smoke tests actually
+exercise Mosaic.
+"""
+
+import numpy as np
+import pytest
+
+
+def _mosaic_error():
+    return ValueError(
+        "Mosaic failed to compile TPU kernel: Slice shape along dimension "
+        "2 must be aligned to tiling (128), but is 16."
+    )
+
+
+def test_is_kernel_lowering_error_classification():
+    from pypardis_tpu.ops.labels import is_kernel_lowering_error
+
+    assert is_kernel_lowering_error(_mosaic_error())
+    assert is_kernel_lowering_error(
+        ValueError("The Pallas TPU lowering currently requires ...")
+    )
+    assert not is_kernel_lowering_error(ValueError("points must be (N, k)"))
+    assert not is_kernel_lowering_error(RuntimeError("out of memory"))
+
+
+def test_is_kernel_lowering_error_walks_cause_chain():
+    from pypardis_tpu.ops.labels import is_kernel_lowering_error
+
+    try:
+        try:
+            raise _mosaic_error()
+        except ValueError as inner:
+            raise RuntimeError("compile failed") from inner
+    except RuntimeError as outer:
+        assert is_kernel_lowering_error(outer)
+
+
+def test_with_kernel_fallback_degrades_auto():
+    from pypardis_tpu.parallel.sharded import _with_kernel_fallback
+
+    calls = []
+
+    def fn(be):
+        calls.append(be)
+        if be != "xla":
+            raise _mosaic_error()
+        return "ok"
+
+    assert _with_kernel_fallback(fn, "auto") == "ok"
+    assert calls == ["auto", "xla"]
+
+
+def test_with_kernel_fallback_explicit_pallas_stays_strict():
+    from pypardis_tpu.parallel.sharded import _with_kernel_fallback
+
+    def fn(be):
+        raise _mosaic_error()
+
+    with pytest.raises(ValueError, match="Mosaic"):
+        _with_kernel_fallback(fn, "pallas")
+
+
+def test_with_kernel_fallback_unrelated_errors_propagate():
+    from pypardis_tpu.parallel.sharded import _with_kernel_fallback
+
+    def fn(be):
+        raise RuntimeError("unrelated")
+
+    with pytest.raises(RuntimeError, match="unrelated"):
+        _with_kernel_fallback(fn, "auto")
+
+
+def test_pad_and_run_falls_back_end_to_end(monkeypatch):
+    """A broken-Pallas build degrades inside the public driver."""
+    from pypardis_tpu import dbscan as dbscan_mod
+    from pypardis_tpu.ops import pipeline as pipeline_mod
+
+    real = pipeline_mod.dbscan_device_pipeline
+    calls = []
+
+    def flaky(points_t, eps, n, **kw):
+        calls.append(kw["backend"])
+        if kw["backend"] != "xla":
+            raise _mosaic_error()
+        return real(points_t, eps, n, **kw)
+
+    monkeypatch.setattr(pipeline_mod, "dbscan_device_pipeline", flaky)
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(500, 4)).astype(np.float32)
+    # _pad_and_run is the single-shard driver entry (the CI mesh routes
+    # DBSCAN.fit to the sharded path, which has its own fallback test).
+    roots, core = dbscan_mod._pad_and_run(X, 0.5, 5, "euclidean", 256)
+    assert len(roots) == 500 and len(core) == 500
+    assert calls == ["auto", "xla"]

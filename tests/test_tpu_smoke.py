@@ -1,0 +1,148 @@
+"""Hardware smoke tests: the Pallas kernels must lower through Mosaic.
+
+Round 2 shipped kernels that passed 91 CPU tests (interpret mode) and
+crashed on the first real-TPU call — nothing in CI ever exercised the
+Mosaic lowering.  These tests compile and RUN both Pallas kernels and the
+end-to-end Pallas-backed pipeline on the actual accelerator; they skip
+anywhere else (the CPU CI mesh), so `python -m pytest tests/` stays green
+off-hardware while `make tpu-smoke` fails loudly if a kernel rewrite
+breaks lowering again.
+
+Run via: ``make tpu-smoke`` (sets PYPARDIS_TEST_PLATFORM=native so
+conftest.py leaves the ambient TPU platform in place).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    jax.default_backend() != "tpu",
+    reason="TPU hardware smoke test (run via `make tpu-smoke`)",
+)
+
+
+def _blob_points(n, d, seed=0):
+    """Morton-sorted blobs — the layout the driver always feeds the
+    kernels (ops/pipeline.py).  Sorting matters for numerics, not just
+    speed: tiles become spatially tight, so the per-tile recentring
+    keeps bf16_3x matmul error at eps scale instead of dataset scale."""
+    from pypardis_tpu.partition import spatial_order
+
+    rng = np.random.default_rng(seed)
+    centers = rng.uniform(-10, 10, size=(8, d))
+    assign = rng.integers(0, 8, size=n)
+    pts = (
+        centers[assign] + rng.normal(scale=0.4, size=(n, d))
+    ).astype(np.float32)
+    return pts[spatial_order(pts)]
+
+
+def _banded_counts(pts, mask, eps, rel=1e-3):
+    """fp64 host oracle: (tight, loose) neighbor counts excluding /
+    including an eps*(1±rel) boundary band.  The Pallas and XLA paths
+    schedule the matmul expansion differently, so pairs within float32
+    rounding of the eps shell may legitimately flip between them; any
+    pair clearly inside or outside must agree with fp64."""
+    x = pts.astype(np.float64)[mask]
+    d2 = ((x[:, None, :] - x[None, :, :]) ** 2).sum(-1)
+    tight = (d2 <= (eps * (1 - rel)) ** 2).sum(1)
+    loose = (d2 <= (eps * (1 + rel)) ** 2).sum(1)
+    return tight, loose
+
+
+def test_neighbor_counts_pallas_lowers_and_brackets_fp64():
+    from pypardis_tpu.ops.pallas_kernels import neighbor_counts_pallas
+
+    n, d, block = 4096, 16, 1024  # nt = 4 > 1: exercises grid slicing
+    pts = _blob_points(n, d)
+    mask = np.ones(n, bool)
+    mask[-50:] = False
+    tight, loose = _banded_counts(pts, mask, 1.5)
+    # precision='highest' (exact fp32 matmuls) validates the kernel
+    # logic — grid slicing, DMA, two-level pruning — against fp64:
+    # every pair clearly off the eps shell must agree.
+    got = np.asarray(
+        neighbor_counts_pallas(
+            jnp.asarray(pts), 1.5, jnp.asarray(mask), block=block,
+            precision="highest",
+        )
+    )[mask]
+    assert (got >= tight).all() and (got <= loose).all(), (
+        (tight - got).max(), (got - loose).max()
+    )
+    # The default bf16_3x mode trades boundary-pair exactness for half
+    # the MXU passes; its dropped al*bl term scales with coordinate
+    # magnitude, so loose tiles can flip shell-adjacent pairs.  Bound
+    # the damage rather than demand exactness (cluster structure is
+    # covered by the ARI test below).
+    got_hi = np.asarray(
+        neighbor_counts_pallas(
+            jnp.asarray(pts), 1.5, jnp.asarray(mask), block=block,
+            precision="high",
+        )
+    )[mask]
+    exact = np.asarray(
+        ((pts.astype(np.float64)[mask][:, None, :]
+          - pts.astype(np.float64)[mask][None, :, :]) ** 2).sum(-1)
+        <= 1.5 * 1.5
+    ).sum(1)
+    assert np.abs(got_hi - exact).max() <= 5, np.abs(got_hi - exact).max()
+
+
+def test_min_neighbor_label_pallas_lowers_and_matches_xla():
+    from pypardis_tpu.ops.distances import min_neighbor_label, neighbor_counts
+    from pypardis_tpu.ops.pallas_kernels import min_neighbor_label_pallas
+
+    n, d, block = 4096, 16, 1024
+    pts = _blob_points(n, d, seed=1)
+    mask = np.ones(n, bool)
+    mask[-50:] = False
+    labels = jnp.arange(n, dtype=jnp.int32)
+    src = neighbor_counts(jnp.asarray(pts), 1.5, jnp.asarray(mask)) >= 4
+    # precision='highest' on both paths: disagreements can then come
+    # only from fp32-ULP shell-adjacent pairs, not bf16 splits.
+    got = min_neighbor_label_pallas(
+        jnp.asarray(pts), labels, 1.5, src, block=block,
+        row_mask=jnp.asarray(mask), precision="highest",
+    )
+    want = min_neighbor_label(
+        jnp.asarray(pts), labels, 1.5, src, row_mask=jnp.asarray(mask),
+        precision="highest",
+    )
+    m = np.asarray(mask)
+    mismatch = (np.asarray(got)[m] != np.asarray(want)[m]).mean()
+    assert mismatch < 1e-2, mismatch
+
+
+def test_dbscan_fixed_size_pallas_end_to_end():
+    from sklearn.cluster import DBSCAN as SKDBSCAN
+    from sklearn.metrics import adjusted_rand_score
+
+    from pypardis_tpu.ops import dbscan_fixed_size, densify_labels
+
+    n, d = 8192, 16
+    pts = _blob_points(n, d, seed=2)
+    mask = np.ones(n, bool)
+    roots, core = dbscan_fixed_size(
+        jnp.asarray(pts), 1.5, 5, jnp.asarray(mask), backend="pallas"
+    )
+    got = densify_labels(np.asarray(roots))
+    want = SKDBSCAN(eps=1.5, min_samples=5).fit_predict(pts)
+    assert adjusted_rand_score(got, want) >= 0.99
+
+
+def test_default_backend_driver_matches_sklearn():
+    """The product default (backend='auto' -> Pallas on TPU) end to end."""
+    from sklearn.cluster import DBSCAN as SKDBSCAN
+    from sklearn.metrics import adjusted_rand_score
+
+    from pypardis_tpu import DBSCAN
+    from pypardis_tpu.ops.labels import resolve_backend
+
+    assert resolve_backend("auto", "euclidean", 1 << 20, 1024) == "pallas"
+    X = _blob_points(30_000, 16, seed=3)
+    got = DBSCAN(eps=1.5, min_samples=10, block=2048).fit_predict(X)
+    want = SKDBSCAN(eps=1.5, min_samples=10).fit_predict(X)
+    assert adjusted_rand_score(got, want) >= 0.99
