@@ -166,7 +166,7 @@ def dbscan_partition(iterable, params):
     if not data:
         return
     (_, part), _ = data[0]
-    x = np.array([v for (_k, _p), v in data], dtype=np.float64)
+    x = _as_float(np.stack([np.asarray(v) for (_k, _p), v in data]))
     y = [k for (k, _p), _v in data]
     roots, core = _pad_and_run(
         x,

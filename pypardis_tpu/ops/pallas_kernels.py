@@ -329,12 +329,24 @@ def _grouped_bounds(lo, hi):
     return tblo, tbhi, glo, ghi
 
 
-def _pallas_block(block: int, n: int, d: int) -> int:
+def _pallas_block(block: int, n: int, d: int, mode: str = "high") -> int:
     """Largest tile that keeps the fp32 distance tile plus operand
-    blocks comfortably inside VMEM and divides n."""
+    blocks comfortably inside VMEM and divides n.
+
+    The default bf16_3x mode materializes more than the plain path: the
+    hi/lo operand splits (four extra (d+2, b) blocks) and up to three
+    (b, b) dot results before the adds fuse — budget for them so a
+    Mosaic VMEM overflow can't appear only on hardware at block=1024.
+    """
     b = min(block, n)
+    if mode == "high":
+        tile_words, opnd_words = 4, 8
+    else:
+        tile_words, opnd_words = 2, 4
     while b > 128 and (
-        2 * b * b * 4 + 4 * b * d * 4 > 10 * 1024 * 1024 or n % b != 0
+        tile_words * b * b * 4 + opnd_words * b * (d + 2) * 4
+        > 10 * 1024 * 1024
+        or n % b != 0
     ):
         b //= 2
     return b
@@ -363,7 +375,7 @@ def neighbor_counts_pallas(
     (Euclidean only)."""
     n, d = _shape_nd(points, layout)
     mode = _norm_precision_mode(precision)
-    block = _pallas_block(block, n, d)
+    block = _pallas_block(block, n, d, mode)
     assert n % block == 0, (n, block)
     nt = n // block
     dp = -(-d // 128) * 128
@@ -448,7 +460,7 @@ def min_neighbor_label_pallas(
     """
     n, d = _shape_nd(points, layout)
     mode = _norm_precision_mode(precision)
-    block = _pallas_block(block, n, d)
+    block = _pallas_block(block, n, d, mode)
     assert n % block == 0, (n, block)
     nt = n // block
     dp = -(-d // 128) * 128
@@ -458,8 +470,12 @@ def min_neighbor_label_pallas(
         rlo = jnp.min(tiles, axis=2)
         rhi = jnp.max(tiles, axis=2)
     else:
+        # The same array is row and source operand; keep coordinates
+        # real wherever EITHER mask holds so a source outside row_mask
+        # is never silently lost (its label sentinel alone governs
+        # source participation).
         rm = row_mask.reshape(nt, 1, block)
-        ycols = jnp.where(rm, tiles, BIG)
+        ycols = jnp.where(rm | src_mask.reshape(nt, 1, block), tiles, BIG)
         rlo, rhi = _masked_bounds(tiles, rm)
     centers = (0.5 * (rlo + rhi))[:, :, None]
     rlo_p = _lane_pad(rlo, dp)

@@ -142,7 +142,9 @@ def expanded_members(tree, points: np.ndarray, margin: float):
     for parent, axis, boundary, _left, right in tree:
         arr, own = state.pop(int(parent))
         c = points[arr, int(axis)].astype(np.float64, copy=False)
-        lsel = c < boundary + margin
+        # Inclusive on the widened upper bound, matching BoxStack
+        # membership and the reference's expanded_box.contains (<=).
+        lsel = c <= boundary + margin
         rsel = c >= boundary - margin
         state[int(parent)] = (arr[lsel], own[lsel] & (c[lsel] < boundary))
         state[int(right)] = (arr[rsel], own[rsel] & (c[rsel] >= boundary))

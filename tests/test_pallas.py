@@ -76,6 +76,56 @@ def test_minlab_match_xla(blob_data):
     assert np.array_equal(m_x[valid], m_p[valid])
 
 
+def test_counts_high_precision_band(blob_data):
+    """The default bf16_3x mode ('high') through the interpreter: counts
+    must match the HIGHEST oracle within a small band (data keeps a guard
+    band around eps, but bf16_3x error scales with tile-box magnitude, so
+    allow isolated single-neighbor flips rather than exact equality)."""
+    pts, mask = blob_data
+    c_ref = np.asarray(
+        neighbor_counts(pts, 2.0, mask, block=256, precision="highest")
+    )
+    c_hi = np.asarray(
+        neighbor_counts_pallas(
+            pts, 2.0, mask, block=256, precision="high", interpret=True
+        )
+    )
+    diff = np.abs(c_hi - c_ref)
+    assert diff.max() <= 2
+    assert (diff == 0).mean() > 0.99
+
+
+def test_minlab_source_outside_row_mask(blob_data):
+    """A source point excluded from row_mask must still donate its label
+    (the shared coordinate array keeps real coordinates wherever either
+    mask holds — regression for the src_mask-subset precondition)."""
+    pts, _ = blob_data
+    n = pts.shape[0]
+    # Row mask excludes the first point; source mask includes ONLY it.
+    row_mask = jnp.ones(n, bool).at[0].set(False)
+    src_mask = jnp.zeros(n, bool).at[0].set(True)
+    lab = jnp.full(n, INT_INF, jnp.int32).at[0].set(7)
+    got = np.asarray(
+        min_neighbor_label_pallas(
+            pts, lab, 2.0, src_mask, block=256, precision="highest",
+            interpret=True, row_mask=row_mask,
+        )
+    )
+    want = np.asarray(
+        min_neighbor_label(
+            pts, lab, 2.0, src_mask, block=256, precision="highest",
+            row_mask=row_mask,
+        )
+    )
+    valid = np.asarray(row_mask)
+    assert np.array_equal(got[valid], want[valid])
+    # The excluded-row source must actually reach someone within eps.
+    d2 = np.sum((np.asarray(pts) - np.asarray(pts)[0]) ** 2, axis=1)
+    reachable = (d2 <= 4.0) & valid
+    if reachable.any():
+        assert (got[reachable] == 7).all()
+
+
 def test_e2e_backend_pallas_interpret(blob_data, monkeypatch):
     """dbscan_fixed_size with backend='pallas' (kernels forced through the
     interpreter) must agree with backend='xla' labels end to end."""
