@@ -13,8 +13,9 @@ keeps it on Spark executors (``/root/reference/dbscan/dbscan.py:12-34``):
 
 * quantize + interleave Morton codes on-device (vector shifts, fused by
   XLA into a handful of passes);
-* ``lexsort`` the two 32-bit code halves on-device (TPU sort HLO) —
-  no uint64 needed, so it runs in JAX's default 32-bit mode;
+* ``lexsort`` the code's uint32 words (1-4 of them, per
+  :func:`pypardis_tpu.partition.morton_plan`) on-device (TPU sort HLO)
+  — word-sliced so it runs in JAX's default 32-bit mode;
 * gather points into sorted order, staying in the ``(d, cap)``
   transposed layout end to end (XLA:TPU pads the minor axis of
   ``(N, small-d)`` buffers 8x in HBM; point-axis-minor stays dense);
@@ -40,19 +41,25 @@ import jax.numpy as jnp
 
 from .labels import dbscan_fixed_size
 
-MORTON_BITS = 10  # quantization bits per axis
-MORTON_AXES = 6  # highest-variance axes kept in the code
-
-
-def _device_morton_halves(x, mask, bits: int, max_axes: int):
-    """Per-point Morton code as (hi, lo) uint32 halves, masked-last.
+def _device_morton_words(x, mask):
+    """Per-point Morton code as a list of uint32 words (most significant
+    first), masked-last.
 
     ``x``: (d, cap) float32, centered; ``mask``: (cap,) validity.  Invalid
     points get all-ones codes so a stable sort keeps them at the end (the
     ``arange(cap) < n`` mask stays true after permutation).
+
+    The code budget is <=128 bits over up to 32 axes
+    (:func:`pypardis_tpu.partition.morton_plan` — the round-2 single-
+    uint64 budget left most dims unsorted at d=16 and broke tile pruning
+    at scale); words are uint32 because TPU JAX runs in 32-bit mode.
     """
+    from ..partition import interleave_bit_words, morton_plan
+
     d, cap = x.shape
-    k = min(d, max_axes, 64 // bits)
+    k, bits = morton_plan(d)
+    if k == 0:
+        return [jnp.where(mask, jnp.uint32(0), jnp.uint32(0xFFFFFFFF))]
     if d > k:
         # Keep the k highest-variance axes (matches the host
         # morton_codes axis choice); row gather by traced indices.
@@ -71,27 +78,15 @@ def _device_morton_halves(x, mask, bits: int, max_axes: int):
     q = jnp.clip(
         ((x - lo) / span * (1 << bits)).astype(jnp.int32), 0, (1 << bits) - 1
     ).astype(jnp.uint32)
-    total = bits * k
-    code_hi = jnp.zeros(cap, jnp.uint32)
-    code_lo = jnp.zeros(cap, jnp.uint32)
-    # Interleave axis bits MSB-first over (bit, axis) pairs; with
-    # total > 32 the leading total-32 bits land in code_hi, the rest in
-    # code_lo — two uint32 halves instead of a uint64 code, because TPU
-    # JAX runs in 32-bit mode by default.
-    n_hi = max(total - 32, 0)
-    emitted = 0
-    for b in range(bits - 1, -1, -1):
-        for a in range(k):
-            bit = (q[a] >> jnp.uint32(b)) & jnp.uint32(1)
-            if emitted < n_hi:
-                code_hi = (code_hi << jnp.uint32(1)) | bit
-            else:
-                code_lo = (code_lo << jnp.uint32(1)) | bit
-            emitted += 1
+    words = interleave_bit_words(
+        [q[a] for a in range(k)],
+        bits,
+        32,
+        lambda: jnp.zeros(cap, jnp.uint32),
+        jnp.uint32,
+    )
     inval = jnp.uint32(0xFFFFFFFF)
-    code_hi = jnp.where(mask, code_hi, inval)
-    code_lo = jnp.where(mask, code_lo, inval)
-    return code_hi, code_lo
+    return [jnp.where(mask, w, inval) for w in words]
 
 
 @functools.partial(
@@ -117,10 +112,9 @@ def dbscan_device_pipeline(
     d, cap = points_t.shape
     mask = jnp.arange(cap) < n
     if sort:
-        code_hi, code_lo = _device_morton_halves(
-            points_t, mask, MORTON_BITS, MORTON_AXES
-        )
-        perm = jnp.lexsort((code_lo, code_hi)).astype(jnp.int32)
+        words = _device_morton_words(points_t, mask)
+        # jnp.lexsort: the LAST key is primary -> most significant first.
+        perm = jnp.lexsort(tuple(words[::-1])).astype(jnp.int32)
         xs = jnp.take(points_t, perm, axis=1)
     else:
         perm = None

@@ -1,0 +1,71 @@
+"""Timing breakdown: counts pass vs one minlab pass vs full pipeline."""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from scale_probe import make_data
+
+
+def t(fn, *args, reps=3, **kw):
+    r = fn(*args, **kw)
+    jax.block_until_ready(r)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        r = fn(*args, **kw)
+        jax.block_until_ready(r)
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    n = int(sys.argv[1])
+    d = int(sys.argv[2]) if len(sys.argv) > 2 else 16
+    eps = 2.4
+    block = int(sys.argv[3]) if len(sys.argv) > 3 else 2048
+    X = make_data(n, d)
+    from pypardis_tpu.ops.pallas_kernels import (
+        _pallas_block,
+        min_neighbor_label_pallas,
+        neighbor_counts_pallas,
+    )
+    from pypardis_tpu.partition import spatial_order
+    from pypardis_tpu.utils import round_up
+
+    t0 = time.perf_counter()
+    X = X - X.mean(axis=0)
+    order = spatial_order(X)
+    X = X[order]
+    print(f"host sort: {time.perf_counter() - t0:.2f}s")
+    cap = round_up(n, block)
+    pts = np.zeros((cap, d), np.float32)
+    pts[:n] = X
+    pts = jnp.asarray(pts)
+    mask = jnp.arange(cap) < n
+    print(f"pallas block: {_pallas_block(block, cap, d, 'high')}")
+
+    dt_c = t(neighbor_counts_pallas, pts, eps, mask, block=block)
+    print(f"counts pass: {dt_c:.2f}s")
+    counts = neighbor_counts_pallas(pts, eps, mask, block=block)
+    core = (counts >= 10) & mask
+    labels = jnp.where(core, jnp.arange(cap, dtype=jnp.int32), 2**31 - 1)
+    dt_m = t(
+        min_neighbor_label_pallas, pts, labels, eps, core,
+        block=block, row_mask=mask,
+    )
+    print(f"minlab pass: {dt_m:.2f}s")
+
+    from pypardis_tpu.ops.labels import dbscan_fixed_size
+
+    dt_f = t(
+        dbscan_fixed_size, pts, eps, 10, mask, block=block,
+        backend="pallas", reps=1,
+    )
+    print(f"full dbscan_fixed_size: {dt_f:.2f}s")
+    est_rounds = (dt_f - dt_c) / dt_m
+    print(f"=> est minlab passes: {est_rounds:.1f}")
+
+
+if __name__ == "__main__":
+    main()

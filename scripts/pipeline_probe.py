@@ -1,0 +1,69 @@
+"""Where does end-to-end time go at scale? Stage-by-stage timing."""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from scale_probe import make_data
+
+
+def main():
+    n = int(sys.argv[1])
+    d = int(sys.argv[2]) if len(sys.argv) > 2 else 16
+    eps = 2.4
+    block = 2048
+    X = make_data(n, d)
+
+    from pypardis_tpu.ops.pipeline import dbscan_device_pipeline
+    from pypardis_tpu.utils import round_up
+
+    t0 = time.perf_counter()
+    center = X.mean(axis=0, dtype=np.float64)
+    cap = round_up(n, block)
+    pts_t = np.zeros((d, cap), np.float32)
+    chunk = 1 << 20
+    for s in range(0, n, chunk):
+        e = min(s + chunk, n)
+        np.subtract(X[s:e].T, center[:, None], out=pts_t[:, s:e],
+                    casting="unsafe")
+    t_host = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    dev = jnp.asarray(pts_t)
+    jax.block_until_ready(dev)
+    t_upload = time.perf_counter() - t0
+
+    def run():
+        return dbscan_device_pipeline(
+            dev, eps, n, min_samples=10, metric="euclidean", block=block,
+            precision="high", backend="auto", sort=True,
+        )
+
+    r = run()
+    jax.block_until_ready(r)
+    t0 = time.perf_counter()
+    r = run()
+    jax.block_until_ready(r)
+    t_dev = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    packed = np.asarray(r)
+    t_down = time.perf_counter() - t0
+
+    from pypardis_tpu.ops import densify_labels
+
+    t0 = time.perf_counter()
+    labels = densify_labels(packed[0, :n])
+    t_dense = time.perf_counter() - t0
+
+    print(
+        f"n={n}: host_prep={t_host:.2f}s upload={t_upload:.2f}s "
+        f"device_pipeline={t_dev:.2f}s download={t_down:.2f}s "
+        f"densify={t_dense:.2f}s clusters={labels.max() + 1}"
+    )
+
+
+if __name__ == "__main__":
+    main()

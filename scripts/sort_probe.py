@@ -1,0 +1,51 @@
+"""Isolate device-side sort/morton/gather costs at scale."""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def sync(x):
+    # On the axon tunnel, block_until_ready can return early; a tiny
+    # slice transfer is a reliable barrier.
+    return np.asarray(jax.tree_util.tree_leaves(x)[0].ravel()[:1])
+
+
+def t(fn, *args, reps=2):
+    sync(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        sync(fn(*args))
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    n = int(sys.argv[1])
+    d = 16
+    rng = np.random.default_rng(0)
+    keys = [jnp.asarray(rng.integers(0, 2**32, n, dtype=np.uint32))
+            for _ in range(4)]
+    pts = jnp.asarray(rng.normal(size=(d, n)).astype(np.float32))
+    mask = jnp.arange(n) < n - 7
+
+    lex4 = jax.jit(lambda ks: jnp.lexsort(tuple(ks)))
+    lex2 = jax.jit(lambda ks: jnp.lexsort(tuple(ks[:2])))
+    lex1 = jax.jit(lambda ks: jnp.argsort(ks[0]))
+    print(f"lexsort 1 key: {t(lex1, keys):.2f}s")
+    print(f"lexsort 2 keys: {t(lex2, keys):.2f}s")
+    print(f"lexsort 4 keys: {t(lex4, keys):.2f}s")
+
+    from pypardis_tpu.ops.pipeline import _device_morton_words
+
+    mw = jax.jit(lambda x, m: _device_morton_words(x, m))
+    print(f"morton words: {t(mw, pts, mask):.2f}s")
+
+    perm = lex1(keys)
+    gather = jax.jit(lambda p, i: jnp.take(p, i, axis=1))
+    print(f"gather (d,n): {t(gather, pts, perm):.2f}s")
+
+
+if __name__ == "__main__":
+    main()
