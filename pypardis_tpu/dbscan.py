@@ -119,10 +119,12 @@ def _pad_and_run(
             points[s:e].T, center[:, None], out=pts_t[:, s:e],
             casting="unsafe",
         )
-    def run(be):
+    dev = jnp.asarray(pts_t)
+
+    def run(be, pair_budget=None):
         return np.array(
             dbscan_device_pipeline(
-                jnp.asarray(pts_t),
+                dev,
                 eps,
                 n,
                 min_samples=min_samples,
@@ -131,11 +133,22 @@ def _pad_and_run(
                 precision=precision,
                 backend=be,
                 sort=bool(sort and n > 2 * block),
+                pair_budget=pair_budget,
             )
         )
 
     try:
         packed = run(backend)
+        total, budget = int(packed[0, cap]), int(packed[1, cap])
+        if total > budget:
+            # The live tile-pair list overflowed its static budget
+            # (pairs were dropped -> labels invalid).  The returned
+            # total is exact, so one retry with that capacity wins.
+            get_logger().warning(
+                "live tile-pair budget overflow (%d > %d); rerunning "
+                "with an exact budget", total, budget,
+            )
+            packed = run(backend, pair_budget=round_up(total, 4096))
     except Exception as e:  # noqa: BLE001 — rethrown unless a kernel fails
         from .ops.labels import is_kernel_lowering_error
 

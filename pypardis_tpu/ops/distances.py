@@ -169,6 +169,98 @@ def tile_skip_mask(lo_i, hi_i, lo, hi, eps, metric):
     return jnp.sum(gap, axis=1) > eps
 
 
+def default_pair_budget(nt: int) -> int:
+    """Default live-pair capacity: 48 pairs per row tile.
+
+    Morton-sorted, segment-broken layouts measure ~9-29 live column
+    tiles per row (2M x 16-D constant-density probe); 48 gives slack
+    without inflating the scatter arrays (budget * 8 bytes).  Callers
+    detect overflow via the returned true total and retry with an exact
+    budget.
+    """
+    return max(4096, 48 * nt)
+
+
+def live_tile_pairs(
+    lo: jnp.ndarray,
+    hi: jnp.ndarray,
+    eps,
+    lo_col: jnp.ndarray | None = None,
+    hi_col: jnp.ndarray | None = None,
+    budget: int | None = None,
+):
+    """Row-major list of tile pairs whose bounding boxes lie within eps.
+
+    ``lo``/``hi``: (nt, d) row-tile bounds; ``lo_col``/``hi_col``
+    default to the same boxes.  Returns ``(rows, cols, total)`` with
+    ``rows``/``cols`` of static length ``budget`` (padding entries:
+    row == nt, col == 0 — callers give the kernel an (nt+1)-row dump
+    output) and ``total`` the TRUE live-pair count.  When ``total >
+    budget`` the excess pairs were dropped — results built from the
+    list are invalid and the caller must retry with ``budget >=
+    total`` (the count is exact, so one retry always suffices for the
+    same inputs).
+
+    This is the tile-pruning stage of the Pallas path, hoisted out of
+    the kernel: one vectorized box-gap pass (chunked over row tiles so
+    the (C, nt) live mask never exceeds ~MBs) replaces the O(nt^2)
+    sequential scalar scan the round-3 kernels carried — which was
+    measured at 4.2s/pass of pure overhead at 10M points.
+
+    Empty tiles carry inverted (+BIG, -BIG) boxes: their gap to
+    anything is astronomically positive, so they never pair.
+    """
+    nt, d = lo.shape
+    if lo_col is None:
+        lo_col, hi_col = lo, hi
+    if budget is None:
+        budget = default_pair_budget(nt)
+    # nt^2 is the exhaustive list — a budget past it is pure waste, and
+    # clamping makes small-nt extractions overflow-proof by construction.
+    budget = min(budget, nt * nt)
+    eps2 = jnp.asarray(eps, jnp.float32) ** 2
+    chunk = max(1, min(nt, -(-(1 << 22) // nt)))  # ~4M live-mask entries
+    nc = -(-nt // chunk)
+    pad = nc * chunk - nt
+    lo_r = jnp.concatenate([lo, jnp.full((pad, d), _BIG)], axis=0)
+    hi_r = jnp.concatenate([hi, jnp.full((pad, d), -_BIG)], axis=0)
+
+    def body(carry, c):
+        rows_out, cols_out, total = carry
+        s = c * chunk
+        rlo = jax.lax.dynamic_slice_in_dim(lo_r, s, chunk)
+        rhi = jax.lax.dynamic_slice_in_dim(hi_r, s, chunk)
+        gap = jnp.maximum(
+            0.0,
+            jnp.maximum(
+                lo_col[None] - rhi[:, None], rlo[:, None] - hi_col[None]
+            ),
+        )
+        live = (jnp.sum(gap * gap, axis=2) <= eps2).reshape(-1)
+        inc = jnp.cumsum(live.astype(jnp.int32))
+        pos = total + inc - live  # exclusive running position
+        tgt = jnp.where(live, jnp.minimum(pos, budget), budget)
+        rid = jnp.broadcast_to(
+            s + jnp.arange(chunk, dtype=jnp.int32)[:, None], (chunk, nt)
+        ).reshape(-1)
+        cid = jnp.broadcast_to(
+            jnp.arange(nt, dtype=jnp.int32)[None], (chunk, nt)
+        ).reshape(-1)
+        rows_out = rows_out.at[tgt].set(rid)
+        cols_out = cols_out.at[tgt].set(cid)
+        return (rows_out, cols_out, total + inc[-1]), None
+
+    init = (
+        jnp.full(budget + 1, nt, jnp.int32),
+        jnp.zeros(budget + 1, jnp.int32),
+        jnp.int32(0),
+    )
+    (rows_out, cols_out, total), _ = jax.lax.scan(
+        body, init, jnp.arange(nc)
+    )
+    return rows_out[:budget], cols_out[:budget], total
+
+
 @functools.partial(
     jax.jit, static_argnames=("metric", "block", "precision", "layout")
 )

@@ -108,7 +108,8 @@ def _pointer_jump(f: jnp.ndarray, active: jnp.ndarray) -> jnp.ndarray:
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "metric", "block", "max_rounds", "precision", "backend", "layout"
+        "metric", "block", "max_rounds", "precision", "backend", "layout",
+        "pair_budget",
     ),
 )
 def dbscan_fixed_size(
@@ -122,13 +123,22 @@ def dbscan_fixed_size(
     precision: str = "high",
     backend: str = "auto",
     layout: str = "nd",
+    pair_budget: int | None = None,
 ):
     """DBSCAN over a fixed-capacity padded point set.
 
     ``points``: (N, d) (``layout="nd"``) or transposed (d, N)
     (``layout="dn"`` — the memory-optimal device layout: XLA:TPU pads
     the minor axis of (N, small-d) buffers 8x), N a multiple of
-    ``block``; ``mask``: (N,) bool validity.  Returns ``(labels, core)``:
+    ``block``; ``mask``: (N,) bool validity.  Returns ``(labels, core,
+    pair_stats)``:
+
+    * ``pair_stats``: (2,) int32 ``[live_pairs_total, budget]`` from
+      the Pallas tile-pair extraction (zeros on the XLA path).  When
+      ``total > budget`` the labels are INVALID — pairs were dropped —
+      and the caller must rerun with ``pair_budget >= total``
+      (``pair_budget`` is static; the returned total is exact, so one
+      retry always suffices).
 
     * ``labels``: (N,) int32 — the *root point index* of the point's
       cluster (min index over the component's core points), or -1 for
@@ -140,19 +150,28 @@ def dbscan_fixed_size(
       dbscan.py:30.
     """
     n = points.shape[0] if layout == "nd" else points.shape[1]
+    pair_stats = jnp.zeros(2, jnp.int32)
     if resolve_backend(backend, metric, n, block) == "pallas":
         from .pallas_kernels import (
+            kernel_pair_list,
             min_neighbor_label_pallas,
             neighbor_counts_pallas,
         )
 
+        # Extract the live tile-pair list ONCE; every pass shares it.
+        # It covers validity boxes — a superset of any per-pass source
+        # subset (core masks), so sharing is sound.
+        pairs, pair_stats = kernel_pair_list(
+            points, eps, mask, block, precision, layout,
+            budget=pair_budget,
+        )
         count_fn = functools.partial(
             neighbor_counts_pallas, block=block, precision=precision,
-            layout=layout,
+            layout=layout, pairs=pairs,
         )
         minlab_fn = functools.partial(
             min_neighbor_label_pallas, block=block, precision=precision,
-            layout=layout,
+            layout=layout, pairs=pairs,
         )
     else:
         count_fn = functools.partial(
@@ -209,7 +228,7 @@ def dbscan_fixed_size(
     labels = jnp.where(
         core, f, jnp.where(mask & (border != _INT_INF), border, -1)
     ).astype(jnp.int32)
-    return labels, core
+    return labels, core, pair_stats
 
 
 def densify_labels(root_labels: np.ndarray) -> np.ndarray:

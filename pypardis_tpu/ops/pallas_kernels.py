@@ -4,37 +4,35 @@ The XLA path in :mod:`pypardis_tpu.ops.distances` expresses the tiled
 pairwise interaction as ``lax.map`` over row tiles with a ``lax.scan`` +
 ``lax.cond`` over column tiles.  These kernels implement the same two
 primitives — eps-neighbor counting and min-label-over-neighbors — as
-hand-scheduled Mosaic programs:
+**pair-list** Mosaic programs:
 
-* one grid program per **output tile**; its points and bounding box
-  arrive via grid-sliced BlockSpecs;
-* source tiles stay in **HBM** and are DMA'd into VMEM scratch only when
-  their bounding box lies within eps of the output tile's — pruned tiles
-  cost neither FLOPs nor HBM bandwidth.  Pruning is two-level: one gap
-  test per GROUP of tiles against coarse group boxes resident in VMEM,
-  then per-tile gap tests against the group's per-tile boxes, which are
-  themselves DMA'd from HBM only when the group survives — so VMEM
-  holds O(ng) bounds, independent of the point count;
+* tile-level pruning happens OUTSIDE the kernel: one vectorized XLA pass
+  over per-tile bounding boxes (:func:`live_tile_pairs` in
+  :mod:`pypardis_tpu.ops.distances`) emits the row-major list of (row
+  tile, col tile) pairs whose boxes lie within eps.  The round-2/3
+  design scanned all nt^2/GROUP group boxes *inside* the kernel, which
+  put an O(nt^2) sequential scalar loop on the critical path — measured
+  4.2s of pure scan overhead per pass at 10M points with every pair
+  pruned;
+* the grid is the pair list itself (scalar-prefetched row/col index
+  arrays — the Mosaic block-sparse idiom).  Each program loads its two
+  coordinate tiles via BlockSpec index maps that read the prefetched
+  indices, so Mosaic's own pipeline machinery double-buffers the HBM
+  traffic — no hand-written DMA, no semaphores;
+* pairs arrive sorted by row tile, so each output block's visits are
+  consecutive: the kernel initializes the accumulator on the first
+  visit of a row (prefetched-row change) and accumulates in VMEM across
+  the run — the standard Pallas reduction pattern;
 * the distance tile is one MXU contraction of **norm-augmented
   operands** ``[-2(y-c); 1; |y-c|^2]^T [x-c; |x-c|^2; 1] = |x-y|^2``
   consumed immediately by the compare-and-reduce in registers, so the
   N x N interaction never touches HBM.
 
-Layout (the round-1 design stored coordinates ``(N, d)``-major, which
-XLA:TPU pads 8x in HBM for small d — the 10M-point memory wall):
-
-* coordinates travel **transposed** as ``(nt, d, block)`` — the big
-  point axis is minor, so the HBM image is dense for any d, and no lane
-  padding of coordinates is needed at all;
-* per-point scalars (labels) and outputs travel as ``(nt, 1, block)``
-  rows — dense, and already in the ``(1, block)`` broadcast layout the
-  kernel consumes.  Labels ride as int32 (sentinel INT32_MAX), so any
-  shard size up to HBM capacity is supported (the round-1 float32
-  label encoding capped shards at 2^24 points);
-* one masked coordinate array serves as both row and column operand of
-  both kernels; the min-label kernel restricts *sources* via the label
-  sentinel (a non-source's INT32_MAX label never wins a min), so no
-  second N-sized coordinate copy exists.
+Layout: coordinates travel **transposed** as ``(nt, d, block)`` — the
+big point axis minor, dense in HBM for any d; per-point scalars
+(labels) and outputs travel as ``(nt, 1, block)`` rows.  Labels ride as
+int32 (sentinel INT32_MAX), so any shard size up to HBM capacity is
+supported.
 
 Numerics:
 
@@ -46,17 +44,18 @@ Numerics:
   matmul** (hi/lo decomposition: ``x = hi(x) + lo(x)``, dropping only
   the lo*lo term).  The dropped term is ~2^-18 relative to *operand
   magnitude* — i.e. fp32-class only when tiles are spatially tight
-  (the Morton-sorted driver layout); on loose tiles the absolute d2
-  error can reach coordinate scale x 2^-18 and flip shell-adjacent
-  pairs (bounded in tests/test_tpu_smoke.py; cluster-level output is
-  ARI-stable).  Mosaic has no native bf16_3x, which in round 1
-  silently upgraded "high" to HIGHEST and cost 2x.
+  (the Morton-sorted, segment-broken driver layout); on loose tiles the
+  absolute d2 error can reach coordinate scale x 2^-18 and flip
+  shell-adjacent pairs (bounded in tests/test_tpu_smoke.py;
+  cluster-level output is ARI-stable).  Mosaic has no native bf16_3x,
+  which in round 1 silently upgraded "high" to HIGHEST and cost 2x.
 * ``precision="highest"`` uses native HIGHEST; ``"default"`` a single
   bf16 pass (fast, ~2^-8-relative — opt-in only).
 
 Masking convention: invalid points get coordinates ``BIG`` (squared
 distance overflows past any eps) before entering the kernel; no boolean
-mask ever does.
+mask ever does.  Padding entries of the pair list carry row ``nt`` —
+a dump output row sliced off by the caller.
 
 Only the Euclidean metric goes through Pallas (cityblock has no matmul
 decomposition and stays on the XLA path).
@@ -77,8 +76,6 @@ _INT_INF = jnp.iinfo(jnp.int32).max
 # masked-vs-masked pair d2 = inf - inf = NaN — either way the <= eps^2
 # adjacency test is False.
 BIG = jnp.float32(2e19)
-
-GROUP = 16  # source tiles covered by one group-level gap test
 
 _PRECISION_MODES = ("default", "high", "highest")
 
@@ -140,138 +137,61 @@ def _aug_src(y, c):
     return jnp.concatenate([-2.0 * yc, jnp.ones_like(ysq), ysq], axis=0)
 
 
-def _gap2(lo_a, hi_a, lo_b, hi_b):
-    """Squared gap between two boxes given as (1, d) bound rows."""
-    gap = jnp.maximum(jnp.maximum(lo_b - hi_a, lo_a - hi_b), 0.0)
-    return jnp.sum(gap * gap)
+def _first_visit(rows_ref):
+    """True on the first grid step of a run of equal row-tile indices."""
+    p = pl.program_id(0)
+    prev = rows_ref[jnp.maximum(p, 1) - 1]
+    return (p == 0) | (rows_ref[p] != prev)
 
 
-def _count_kernel(
-    eps2_ref, glo_ref, ghi_ref, rlo_ref, rhi_ref, c_ref, tblo_ref, tbhi_ref,
-    x_ref, yhbm_ref, out_ref,
-    ybuf, blo, bhi, ysem, lsem, hsem,
-    *, mode, group,
-):
+def _count_pairs_kernel(rows_ref, cols_ref, eps2_ref, c_ref, x_ref, y_ref,
+                        acc_ref, out_ref, *, mode, nt):
     eps2 = eps2_ref[0]
-    ng = glo_ref.shape[0]
-    # Row-tile bounds arrive as a (1, 1, dp) grid-sliced block (the
-    # leading singleton keeps the last two block dims equal to the array
-    # dims, and dp is the lane-padded d — both Mosaic layout
-    # requirements); drop it to the (1, dp) row shape.  Padded lanes are
-    # zero in every box, contributing zero gap.
-    rlo = rlo_ref[0]
-    rhi = rhi_ref[0]
-    # Recentre every tile pair on the output tile's box center: operand
+    # Recentre the pair on the output tile's box center: operand
     # magnitudes become tile-local, keeping the matmul expansion's
-    # cancellation error at eps scale.  Empty tiles carry inverted
-    # (+BIG, -BIG) bounds whose midpoint is 0 — recentring is a no-op.
-    # The (d, 1) center rides as its own unpadded input: the bounds are
-    # lane-padded for DMA tiling, so deriving it in-kernel would need a
-    # lane slice.
+    # cancellation error at eps scale.
     c = c_ref[0]
-    out_aug = _aug_out(x_ref[0], c)
-    out_ref[0] = jnp.zeros_like(out_ref[0])
+    # Scalar reads stay at kernel top level: program_id inside a nested
+    # pl.when branch is invisible to the Pallas interpreter's grid env.
+    real = rows_ref[pl.program_id(0)] < nt
+    first = _first_visit(rows_ref)
 
-    def group_body(g, _):
-        ggap2 = _gap2(
-            glo_ref[pl.ds(g, 1), :], ghi_ref[pl.ds(g, 1), :], rlo, rhi
-        )
+    # First visit of a row within this call: resume from the aliased
+    # accumulator (identity on the first chunk; the partial of earlier
+    # chunks on seam rows).
+    @pl.when(real & first)
+    def _():
+        out_ref[0] = acc_ref[0]
 
-        @pl.when(ggap2 <= eps2)
-        def _():
-            # The group survived: fetch its per-tile boxes from HBM.
-            ldma = pltpu.make_async_copy(tblo_ref.at[g], blo, lsem)
-            hdma = pltpu.make_async_copy(tbhi_ref.at[g], bhi, hsem)
-            ldma.start()
-            hdma.start()
-            ldma.wait()
-            hdma.wait()
-
-            def tile_body(jj, _):
-                gap2 = _gap2(
-                    blo[pl.ds(jj, 1), :], bhi[pl.ds(jj, 1), :], rlo, rhi
-                )
-
-                @pl.when(gap2 <= eps2)
-                def _():
-                    ydma = pltpu.make_async_copy(
-                        yhbm_ref.at[g * group + jj], ybuf, ysem
-                    )
-                    ydma.start()
-                    ydma.wait()
-                    d2 = _dot_t(_aug_src(ybuf[:], c), out_aug, mode)
-                    adj = (d2 <= eps2).astype(jnp.int32)
-                    out_ref[0] += jnp.sum(adj, axis=0, keepdims=True)
-
-                return 0
-
-            jax.lax.fori_loop(0, group, tile_body, 0)
-
-        return 0
-
-    jax.lax.fori_loop(0, ng, group_body, 0)
+    # Padding pairs carry row == nt: skip their (block x block) matmul
+    # entirely (their index maps dump, but the FLOPs would be real —
+    # at small N padding dominates the budget).
+    @pl.when(real)
+    def _():
+        d2 = _dot_t(_aug_src(y_ref[0], c), _aug_out(x_ref[0], c), mode)
+        adj = (d2 <= eps2).astype(jnp.int32)
+        out_ref[0] += jnp.sum(adj, axis=0, keepdims=True)
 
 
-def _minlab_kernel(
-    eps2_ref, glo_ref, ghi_ref, rlo_ref, rhi_ref, c_ref, tblo_ref, tbhi_ref,
-    x_ref, yhbm_ref, ylab_ref, out_ref,
-    ybuf, lbuf, blo, bhi, ysem, labsem, lsem, hsem,
-    *, mode, group,
-):
+def _minlab_pairs_kernel(rows_ref, cols_ref, eps2_ref, c_ref, x_ref, y_ref,
+                         lab_ref, acc_ref, out_ref, *, mode, nt):
     eps2 = eps2_ref[0]
-    ng = glo_ref.shape[0]
-    rlo = rlo_ref[0]
-    rhi = rhi_ref[0]
     c = c_ref[0]
-    out_aug = _aug_out(x_ref[0], c)
-    out_ref[0] = jnp.full_like(out_ref[0], _INT_INF)
+    real = rows_ref[pl.program_id(0)] < nt
+    first = _first_visit(rows_ref)
 
-    def group_body(g, _):
-        ggap2 = _gap2(
-            glo_ref[pl.ds(g, 1), :], ghi_ref[pl.ds(g, 1), :], rlo, rhi
+    @pl.when(real & first)
+    def _():
+        out_ref[0] = acc_ref[0]
+
+    @pl.when(real)
+    def _():
+        d2 = _dot_t(_aug_src(y_ref[0], c), _aug_out(x_ref[0], c), mode)
+        lab_col = jnp.transpose(lab_ref[0], (1, 0))
+        cand = jnp.where(d2 <= eps2, lab_col, _INT_INF)
+        out_ref[0] = jnp.minimum(
+            out_ref[0], jnp.min(cand, axis=0, keepdims=True)
         )
-
-        @pl.when(ggap2 <= eps2)
-        def _():
-            ldma = pltpu.make_async_copy(tblo_ref.at[g], blo, lsem)
-            hdma = pltpu.make_async_copy(tbhi_ref.at[g], bhi, hsem)
-            ldma.start()
-            hdma.start()
-            ldma.wait()
-            hdma.wait()
-
-            def tile_body(jj, _):
-                gap2 = _gap2(
-                    blo[pl.ds(jj, 1), :], bhi[pl.ds(jj, 1), :], rlo, rhi
-                )
-
-                @pl.when(gap2 <= eps2)
-                def _():
-                    j = g * group + jj
-                    ydma = pltpu.make_async_copy(
-                        yhbm_ref.at[j], ybuf, ysem
-                    )
-                    labdma = pltpu.make_async_copy(
-                        ylab_ref.at[j], lbuf, labsem
-                    )
-                    ydma.start()
-                    labdma.start()
-                    ydma.wait()
-                    labdma.wait()
-                    d2 = _dot_t(_aug_src(ybuf[:], c), out_aug, mode)
-                    lab_col = jnp.transpose(lbuf[:], (1, 0))
-                    cand = jnp.where(d2 <= eps2, lab_col, _INT_INF)
-                    out_ref[0] = jnp.minimum(
-                        out_ref[0], jnp.min(cand, axis=0, keepdims=True)
-                    )
-
-                return 0
-
-            jax.lax.fori_loop(0, group, tile_body, 0)
-
-        return 0
-
-    jax.lax.fori_loop(0, ng, group_body, 0)
 
 
 def _tiles_t(points, block, layout):
@@ -295,40 +215,6 @@ def _masked_bounds(tiles, mask_t):
     return lo, hi
 
 
-def _lane_pad(a, dp):
-    """Zero-pad the last (lane) dim of (nt, d) bounds to dp.
-
-    HBM DMA slices must be 128-aligned on the lane dim (Mosaic memref
-    tiling); a zero lower *and* upper bound in the padded lanes makes
-    every box-gap contribution there exactly zero, so padding never
-    changes a pruning decision.
-    """
-    nt, d = a.shape
-    if dp == d:
-        return a
-    return jnp.concatenate([a, jnp.zeros((nt, dp - d), a.dtype)], axis=1)
-
-
-def _grouped_bounds(lo, hi):
-    """Pack (nt, dp) per-tile bounds for the two-level pruning scheme.
-
-    Returns (tblo, tbhi, glo, ghi): per-tile boxes regrouped as
-    (ng, GROUP, dp) HBM-resident arrays (DMA'd per surviving group) and
-    coarse per-group boxes (ng, dp) kept in VMEM.  Padded tiles carry
-    inverted boxes and always prune.
-    """
-    nt, d = lo.shape
-    ng = -(-nt // GROUP)
-    pad = ng * GROUP - nt
-    lo_p = jnp.concatenate([lo, jnp.full((pad, d), BIG)], axis=0)
-    hi_p = jnp.concatenate([hi, jnp.full((pad, d), -BIG)], axis=0)
-    tblo = lo_p.reshape(ng, GROUP, d)
-    tbhi = hi_p.reshape(ng, GROUP, d)
-    glo = jnp.min(tblo, axis=1)
-    ghi = jnp.max(tbhi, axis=1)
-    return tblo, tbhi, glo, ghi
-
-
 def _pallas_block(block: int, n: int, d: int, mode: str = "high") -> int:
     """Largest tile that keeps the fp32 distance tile plus operand
     blocks comfortably inside VMEM and divides n.
@@ -339,9 +225,9 @@ def _pallas_block(block: int, n: int, d: int, mode: str = "high") -> int:
     Mosaic VMEM overflow can't appear only on hardware.  The 32MB cap
     (v5e/v4 VMEM is 128MB) admits b=1024 in every mode — measured ~2x
     over b=512 at 5M points: half the per-tile DMA waits and a better
-    MXU aspect — while leaving headroom for Mosaic's own double
-    buffering of the grid blocks.  b=2048 would put the bf16_3x
-    worst case past 80MB; not worth the risk for <10% fewer DMAs.
+    MXU aspect — while leaving headroom for Mosaic's double buffering
+    of the grid blocks.  b=2048 would put the bf16_3x worst case past
+    80MB; not worth the risk for <10% fewer DMAs.
     """
     b = min(block, n)
     if mode == "high":
@@ -364,8 +250,163 @@ def _shape_nd(points, layout):
     return n, d
 
 
+# Pairs per pallas_call: the row/col index arrays ride in SMEM (scalar
+# prefetch), and SMEM is ~1MB/core — 48k pairs is 384KB of int32 x2,
+# comfortable alongside Mosaic's own scalars.  Longer lists run as a
+# lax.scan of chunked calls threading the accumulator through
+# input_output_aliases (seam rows resume from it via the first-visit
+# read; unvisited blocks pass through untouched).
+CHUNK_PAIRS = 48 * 1024
+
+
+def _pair_call(kernel, nt, d, block, n_extra_in, interpret):
+    """Common pallas_call plumbing for the two pair-list kernels.
+
+    Grid = one program per pair-list entry; the row/col tile index
+    arrays and eps^2 ride as scalar prefetch, so BlockSpec index maps
+    can address HBM blocks by them.  Padding entries carry row nt — the
+    dump row of the (nt+1)-row output, sliced off by callers.
+
+    ``call(rows, cols, eps2, acc, *arrays)``: ``acc`` is the (nt+1, 1,
+    block) int32 accumulator holding each row's identity (0 / INT_INF);
+    it is aliased into the output, so rows without a single live pair
+    keep their identity value instead of exposing uninitialized memory.
+    """
+
+    def specs(n_pairs):
+        row_keyed = pl.BlockSpec(
+            (1, 1, block), lambda p, r, c, e: (r[p], 0, 0),
+            memory_space=pltpu.VMEM,
+        )
+        in_specs = [
+            # per-row-tile recentring center, (nt, d, 1)
+            pl.BlockSpec(
+                (1, d, 1), lambda p, r, c, e: (r[p], 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            # output-side coordinate tile (rows)
+            pl.BlockSpec(
+                (1, d, block), lambda p, r, c, e: (r[p], 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            # source-side coordinate tile (cols)
+            pl.BlockSpec(
+                (1, d, block), lambda p, r, c, e: (c[p], 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ] + [
+            # per-point int32 rows keyed by the col tile (labels)
+            pl.BlockSpec(
+                (1, 1, block), lambda p, r, c, e: (c[p], 0, 0),
+                memory_space=pltpu.VMEM,
+            )
+        ] * n_extra_in + [
+            row_keyed  # the aliased accumulator, same map as the output
+        ]
+        return pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=(n_pairs,),
+            in_specs=in_specs,
+            out_specs=row_keyed,
+        )
+
+    # Flat input index of ``acc`` (scalar-prefetch args included).
+    acc_idx = 3 + 3 + n_extra_in
+
+    def one_call(rows, cols, eps2, acc, arrays):
+        return pl.pallas_call(
+            kernel,
+            grid_spec=specs(rows.shape[0]),
+            out_shape=jax.ShapeDtypeStruct((nt + 1, 1, block), jnp.int32),
+            input_output_aliases={acc_idx: 0},
+            interpret=interpret,
+        )(rows, cols, eps2, *arrays, acc)
+
+    def call(rows, cols, eps2, acc, *arrays):
+        n_pairs = rows.shape[0]
+        if n_pairs <= CHUNK_PAIRS:
+            return one_call(rows, cols, eps2, acc, arrays)
+        nch = -(-n_pairs // CHUNK_PAIRS)
+        pad = nch * CHUNK_PAIRS - n_pairs
+        rows = jnp.concatenate([rows, jnp.full(pad, nt, jnp.int32)])
+        cols = jnp.concatenate([cols, jnp.zeros(pad, jnp.int32)])
+
+        def body(carry, rc):
+            r, c = rc
+            return one_call(r, c, eps2, carry, arrays), None
+
+        acc, _ = jax.lax.scan(
+            body,
+            acc,
+            (
+                rows.reshape(nch, CHUNK_PAIRS),
+                cols.reshape(nch, CHUNK_PAIRS),
+            ),
+        )
+        return acc
+
+    return call
+
+
+def _with_dump_block(a):
+    """Append one zero block along the tile axis: the dump target for
+    padding pairs (row == nt).  Index maps must stay in bounds — an OOB
+    block index is an HBM fault, not a clamp."""
+    return jnp.concatenate(
+        [a, jnp.zeros((1,) + a.shape[1:], a.dtype)], axis=0
+    )
+
+
+def _centers(tiles, mask_t):
+    """Per-tile recentring points: box centers of valid coords, (nt, d, 1).
+
+    Empty tiles carry inverted (+BIG, -BIG) bounds whose midpoint is 0 —
+    recentring is a no-op there.
+    """
+    lo, hi = _masked_bounds(tiles, mask_t)
+    return (0.5 * (lo + hi))[:, :, None]
+
+
+def kernel_pair_list(
+    points, eps, mask, block: int, precision, layout: str,
+    budget: int | None = None, src_mask=None,
+):
+    """Live tile-pair list sized to the kernels' OWN tile grid.
+
+    The single place that knows how the Pallas kernels tile their input
+    (``_pallas_block`` + ``_tiles_t`` + ``_masked_bounds``): callers
+    running several passes over one point set extract here once and
+    hand ``pairs`` to every kernel call, guaranteed consistent with the
+    grid the kernels build from the same arguments.  ``src_mask``
+    optionally tightens the column boxes (row boxes always cover
+    ``mask``).  Returns ``(rows, cols), (2,) int32 [total, budget]``;
+    ``total > budget`` means the list was truncated and results built
+    from it are invalid (retry with ``budget >= total``).
+    """
+    from .distances import default_pair_budget, live_tile_pairs
+
+    n, d = _shape_nd(points, layout)
+    pb = _pallas_block(block, n, d, _norm_precision_mode(precision))
+    nt = n // pb
+    tiles = _tiles_t(points, pb, layout)
+    mask_t = mask.reshape(nt, 1, pb)
+    lo, hi = _masked_bounds(tiles, mask_t)
+    if src_mask is None:
+        lo_col, hi_col = None, None
+    else:
+        lo_col, hi_col = _masked_bounds(tiles, src_mask.reshape(nt, 1, pb))
+    if budget is None:
+        budget = default_pair_budget(nt)
+    budget = min(budget, nt * nt)
+    rows, cols, total = live_tile_pairs(
+        lo, hi, eps, lo_col, hi_col, budget=budget
+    )
+    return (rows, cols), jnp.stack([total, jnp.int32(budget)])
+
+
 @functools.partial(
-    jax.jit, static_argnames=("block", "precision", "interpret", "layout")
+    jax.jit,
+    static_argnames=("block", "precision", "interpret", "layout"),
 )
 def neighbor_counts_pallas(
     points: jnp.ndarray,
@@ -375,72 +416,53 @@ def neighbor_counts_pallas(
     precision: str = "high",
     interpret: bool = False,
     layout: str = "nd",
+    pairs=None,
 ) -> jnp.ndarray:
     """Pallas analogue of :func:`pypardis_tpu.ops.distances.neighbor_counts`
-    (Euclidean only)."""
+    (Euclidean only).
+
+    ``pairs``: optional precomputed ``(rows, cols)`` live tile-pair
+    list (row-major sorted; padding rows == nt) from
+    :func:`kernel_pair_list` — callers running several passes over one
+    point set (:func:`pypardis_tpu.ops.labels.dbscan_fixed_size`) share
+    one list across all of them, and own overflow handling.  ``None``
+    extracts here; if the default budget truncates the list, every
+    count comes back -1 (loudly invalid, never silently low).
+    """
     n, d = _shape_nd(points, layout)
     mode = _norm_precision_mode(precision)
     block = _pallas_block(block, n, d, mode)
     assert n % block == 0, (n, block)
     nt = n // block
-    dp = -(-d // 128) * 128
     tiles = _tiles_t(points, block, layout)
     mask_t = mask.reshape(nt, 1, block)
     ycols = jnp.where(mask_t, tiles, BIG)
-    lo, hi = _masked_bounds(tiles, mask_t)
-    centers = (0.5 * (lo + hi))[:, :, None]
-    lo_p = _lane_pad(lo, dp)
-    hi_p = _lane_pad(hi, dp)
-    tblo, tbhi, glo, ghi = _grouped_bounds(lo_p, hi_p)
-    ng = glo.shape[0]
+    centers = _centers(tiles, mask_t)
+    poison = None
+    if pairs is None:
+        pairs, stats = kernel_pair_list(
+            points, eps, mask, block, precision, layout
+        )
+        poison = stats[0] > stats[1]
+    rows, cols = pairs
     eps2 = jnp.asarray(eps, jnp.float32).reshape(1) ** 2
-
-    counts = pl.pallas_call(
-        functools.partial(_count_kernel, mode=mode, group=GROUP),
-        grid=(nt,),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((ng, dp), lambda i: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((ng, dp), lambda i: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec(
-                (1, 1, dp), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
-            ),
-            pl.BlockSpec(
-                (1, 1, dp), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
-            ),
-            pl.BlockSpec(
-                (1, d, 1), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
-            ),
-            pl.BlockSpec(memory_space=pltpu.HBM),
-            pl.BlockSpec(memory_space=pltpu.HBM),
-            pl.BlockSpec(
-                (1, d, block), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
-            ),
-            pl.BlockSpec(memory_space=pltpu.HBM),
-        ],
-        out_specs=pl.BlockSpec(
-            (1, 1, block), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
-        ),
-        out_shape=jax.ShapeDtypeStruct((nt, 1, block), jnp.int32),
-        scratch_shapes=[
-            pltpu.VMEM((d, block), jnp.float32),
-            pltpu.VMEM((GROUP, dp), jnp.float32),
-            pltpu.VMEM((GROUP, dp), jnp.float32),
-            pltpu.SemaphoreType.DMA(()),
-            pltpu.SemaphoreType.DMA(()),
-            pltpu.SemaphoreType.DMA(()),
-        ],
-        interpret=interpret,
-    )(
-        eps2, glo, ghi,
-        lo_p.reshape(nt, 1, dp), hi_p.reshape(nt, 1, dp),
-        centers, tblo, tbhi, ycols, ycols,
-    )
-    return jnp.where(mask, counts.reshape(-1), 0)
+    acc0 = jnp.zeros((nt + 1, 1, block), jnp.int32)
+    # Padding pairs carry row == nt: every row-keyed input needs a real
+    # block there (an OOB index map is an HBM fault, not a clamp).
+    ycols_x = _with_dump_block(ycols)
+    counts = _pair_call(
+        functools.partial(_count_pairs_kernel, mode=mode, nt=nt),
+        nt, d, block, 0, interpret,
+    )(rows, cols, eps2, acc0, _with_dump_block(centers), ycols_x, ycols_x)
+    counts = jnp.where(mask, counts[:nt].reshape(-1), 0)
+    if poison is not None:
+        counts = jnp.where(poison, -1, counts)
+    return counts
 
 
 @functools.partial(
-    jax.jit, static_argnames=("block", "precision", "interpret", "layout")
+    jax.jit,
+    static_argnames=("block", "precision", "interpret", "layout"),
 )
 def min_neighbor_label_pallas(
     points: jnp.ndarray,
@@ -452,6 +474,7 @@ def min_neighbor_label_pallas(
     interpret: bool = False,
     row_mask: jnp.ndarray | None = None,
     layout: str = "nd",
+    pairs=None,
 ) -> jnp.ndarray:
     """Pallas analogue of
     :func:`pypardis_tpu.ops.distances.min_neighbor_label` (Euclidean).
@@ -461,82 +484,48 @@ def min_neighbor_label_pallas(
     ``src_mask`` rides on the label sentinel — a non-source's INT32_MAX
     never wins a min — so rows and columns share one array.  Rows
     outside ``row_mask`` may return INT32_MAX; callers mask them.  The
-    default (``None``) covers ALL rows.
+    default (``None``) covers ALL rows.  ``pairs`` as in
+    :func:`neighbor_counts_pallas` (a pair list covering validity boxes
+    is a superset of any src subset, so sharing one list is sound); a
+    truncated self-extracted list poisons every row to INT32_MIN.
     """
     n, d = _shape_nd(points, layout)
     mode = _norm_precision_mode(precision)
     block = _pallas_block(block, n, d, mode)
     assert n % block == 0, (n, block)
     nt = n // block
-    dp = -(-d // 128) * 128
     tiles = _tiles_t(points, block, layout)
     if row_mask is None:
-        ycols = tiles
-        rlo = jnp.min(tiles, axis=2)
-        rhi = jnp.max(tiles, axis=2)
+        rm_flat = jnp.ones(n, bool)
     else:
-        # The same array is row and source operand; keep coordinates
-        # real wherever EITHER mask holds so a source outside row_mask
-        # is never silently lost (its label sentinel alone governs
-        # source participation).
-        rm = row_mask.reshape(nt, 1, block)
-        ycols = jnp.where(rm | src_mask.reshape(nt, 1, block), tiles, BIG)
-        rlo, rhi = _masked_bounds(tiles, rm)
-    centers = (0.5 * (rlo + rhi))[:, :, None]
-    rlo_p = _lane_pad(rlo, dp)
-    rhi_p = _lane_pad(rhi, dp)
-    # Source-side pruning boxes cover src points only (tighter than the
-    # row-validity boxes; correctness only needs them to *cover* srcs).
-    slo, shi = _masked_bounds(tiles, src_mask.reshape(nt, 1, block))
-    tblo, tbhi, glo, ghi = _grouped_bounds(
-        _lane_pad(slo, dp), _lane_pad(shi, dp)
-    )
-    ng = glo.shape[0]
+        rm_flat = row_mask
+    rm = rm_flat.reshape(nt, 1, block)
+    # The same array is row and source operand; keep coordinates real
+    # wherever EITHER mask holds so a source outside row_mask is never
+    # silently lost (its label sentinel alone governs participation).
+    src_t = src_mask.reshape(nt, 1, block)
+    ycols = jnp.where(rm | src_t, tiles, BIG)
+    centers = _centers(tiles, rm)
+    poison = None
+    if pairs is None:
+        pairs, stats = kernel_pair_list(
+            points, eps, rm_flat, block, precision, layout,
+            src_mask=src_mask,
+        )
+        poison = stats[0] > stats[1]
+    rows, cols = pairs
     labi = jnp.where(src_mask, labels, _INT_INF).reshape(nt, 1, block)
     eps2 = jnp.asarray(eps, jnp.float32).reshape(1) ** 2
-
-    best = pl.pallas_call(
-        functools.partial(_minlab_kernel, mode=mode, group=GROUP),
-        grid=(nt,),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((ng, dp), lambda i: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((ng, dp), lambda i: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec(
-                (1, 1, dp), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
-            ),
-            pl.BlockSpec(
-                (1, 1, dp), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
-            ),
-            pl.BlockSpec(
-                (1, d, 1), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
-            ),
-            pl.BlockSpec(memory_space=pltpu.HBM),
-            pl.BlockSpec(memory_space=pltpu.HBM),
-            pl.BlockSpec(
-                (1, d, block), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
-            ),
-            pl.BlockSpec(memory_space=pltpu.HBM),
-            pl.BlockSpec(memory_space=pltpu.HBM),
-        ],
-        out_specs=pl.BlockSpec(
-            (1, 1, block), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
-        ),
-        out_shape=jax.ShapeDtypeStruct((nt, 1, block), jnp.int32),
-        scratch_shapes=[
-            pltpu.VMEM((d, block), jnp.float32),
-            pltpu.VMEM((1, block), jnp.int32),
-            pltpu.VMEM((GROUP, dp), jnp.float32),
-            pltpu.VMEM((GROUP, dp), jnp.float32),
-            pltpu.SemaphoreType.DMA(()),
-            pltpu.SemaphoreType.DMA(()),
-            pltpu.SemaphoreType.DMA(()),
-            pltpu.SemaphoreType.DMA(()),
-        ],
-        interpret=interpret,
+    acc0 = jnp.full((nt + 1, 1, block), _INT_INF, jnp.int32)
+    ycols_x = _with_dump_block(ycols)
+    best = _pair_call(
+        functools.partial(_minlab_pairs_kernel, mode=mode, nt=nt),
+        nt, d, block, 1, interpret,
     )(
-        eps2, glo, ghi,
-        rlo_p.reshape(nt, 1, dp), rhi_p.reshape(nt, 1, dp),
-        centers, tblo, tbhi, ycols, ycols, labi,
+        rows, cols, eps2, acc0, _with_dump_block(centers), ycols_x,
+        ycols_x, _with_dump_block(labi),
     )
-    return best.reshape(-1)
+    best = best[:nt].reshape(-1)
+    if poison is not None:
+        best = jnp.where(poison, jnp.iinfo(jnp.int32).min, best)
+    return best

@@ -257,28 +257,31 @@ def _merge_loop(lab_map, home_label, core_g, h_gid, h_lab, h_core, axis,
     jax.jit,
     static_argnames=(
         "eps", "min_samples", "metric", "block", "mesh", "axis", "n_points",
-        "precision", "backend",
+        "precision", "backend", "pair_budget",
     ),
 )
 def sharded_step(
     owned, owned_mask, owned_gid, halo, halo_mask, halo_gid,
     *, eps, min_samples, metric, block, mesh, axis, n_points,
-    precision="high", backend="auto",
+    precision="high", backend="auto", pair_budget=None,
 ):
     """One fully-sharded clustering step: local DBSCAN + global merge.
 
     All inputs have leading (partition) axis sharded over ``mesh``;
-    outputs are replicated (N,) final labels and core flags.  This is
-    the whole distributed hot path in one compiled program.
+    outputs are replicated (N,) final labels and core flags plus a
+    per-device (1, 2) ``[live_pairs_total, budget]`` from the Pallas
+    pair extraction (see :func:`sharded_dbscan` for the retry).  This
+    is the whole distributed hot path in one compiled program.
     """
 
     def per_device(o, om, og, h, hm, hg):
-        return _device_cluster_merge(
+        final, core_g, pstats = _device_cluster_merge(
             o, om, og, h, hm, hg,
             eps=eps, min_samples=min_samples, metric=metric, block=block,
             precision=precision, backend=backend, axis=axis,
-            n_points=n_points,
+            n_points=n_points, pair_budget=pair_budget,
         )
+        return final, core_g, pstats[None]
 
     spec = P("p", None, None)
     spec2 = P("p", None)
@@ -286,20 +289,22 @@ def sharded_step(
         per_device,
         mesh=mesh,
         in_specs=(spec, spec2, spec2, spec, spec2, spec2),
-        out_specs=(P(), P()),
+        out_specs=(P(), P(), P("p", None)),
         check_vma=False,
     )(owned, owned_mask, owned_gid, halo, halo_mask, halo_gid)
 
 
 def _device_cluster_merge(
     o, om, og, h, hm, hg, *, eps, min_samples, metric, block, precision,
-    backend, axis, n_points,
+    backend, axis, n_points, pair_budget=None,
 ):
     """Shared shard_map body: per-partition DBSCAN + in-graph merge.
 
     ``o``: (L, cap, k) — this device's partitions; halo slabs ``h`` may
     come from the host layout (build_shards) or a device-side ring
-    exchange (halo.ring_halo_exchange).
+    exchange (halo.ring_halo_exchange).  Returns ``(labels, core,
+    pair_stats)`` — the worst-case (max-total) Pallas pair stats over
+    this device's partitions.
     """
     n1 = n_points + 1
     pts = jnp.concatenate([o, h], axis=1)
@@ -309,14 +314,14 @@ def _device_cluster_merge(
     def one_part(p, m, be):
         return dbscan_fixed_size(
             p, eps, min_samples, m, metric=metric, block=block,
-            precision=precision, backend=be,
+            precision=precision, backend=be, pair_budget=pair_budget,
         )
     if pts.shape[0] == 1:
         # One partition per device (the common layout): call directly
         # so Pallas kernels / lax.cond tile pruning stay usable —
         # under vmap, cond lowers to select and pallas_call batching
-        # is unsupported for these hand-written DMA kernels.
-        l1, c1 = one_part(pts[0], msk[0], backend)
+        # is unsupported for these scalar-prefetch kernels.
+        l1, c1, pair_stats = one_part(pts[0], msk[0], backend)
         labels, core = l1[None], c1[None]
     else:
         if backend == "pallas":
@@ -325,9 +330,13 @@ def _device_cluster_merge(
                 "(the vmapped multi-partition layout runs XLA kernels);"
                 " use backend='auto' or max_partitions <= mesh size"
             )
-        labels, core = jax.vmap(
+        labels, core, ps = jax.vmap(
             functools.partial(one_part, be="xla")
         )(pts, msk)
+        # XLA-path stats are zeros; elementwise max keeps the shape and
+        # stays correct if a batched Pallas path ever lands (the static
+        # budget is shared, so max(total) is the binding constraint).
+        pair_stats = ps.max(axis=0)
     # local root index -> global cluster key (root point gid)
     glabel = jnp.where(
         labels >= 0,
@@ -379,20 +388,20 @@ def _device_cluster_merge(
         -1,
     )
     final = jnp.where(final == _INT_INF, -1, final)
-    return final[:n_points], core_g[:n_points]
+    return final[:n_points], core_g[:n_points], pair_stats
 
 
 @functools.partial(
     jax.jit,
     static_argnames=(
         "eps", "min_samples", "metric", "block", "mesh", "axis", "n_points",
-        "precision", "backend", "hcap",
+        "precision", "backend", "hcap", "pair_budget",
     ),
 )
 def sharded_step_ring(
     owned, owned_mask, owned_gid, exp_lo, exp_hi,
     *, eps, min_samples, metric, block, mesh, axis, n_points,
-    precision="high", backend="auto", hcap,
+    precision="high", backend="auto", hcap, pair_budget=None,
 ):
     """Sharded clustering with a device-resident ring halo exchange.
 
@@ -400,9 +409,9 @@ def sharded_step_ring(
     device's owned slab circulates the ring (``ppermute`` over ICI) and
     every device keeps the points inside its 2*eps-expanded box
     (:mod:`pypardis_tpu.parallel.halo`).  Requires one partition per
-    device.  Returns ``(labels, core, overflow)`` — ``overflow`` is the
-    per-device count of in-box points dropped for capacity; nonzero
-    means rerun with a larger ``hcap``.
+    device.  Returns ``(labels, core, overflow, pair_stats)`` —
+    ``overflow`` is the per-device count of in-box points dropped for
+    capacity; nonzero means rerun with a larger ``hcap``.
     """
     from .halo import ring_halo_exchange
 
@@ -410,13 +419,13 @@ def sharded_step_ring(
         h, hm, hg, ovf = ring_halo_exchange(
             o[0], om[0], og[0], lo[0], hi[0], hcap, axis
         )
-        final, core_g = _device_cluster_merge(
+        final, core_g, pstats = _device_cluster_merge(
             o, om, og, h[None], hm[None], hg[None],
             eps=eps, min_samples=min_samples, metric=metric, block=block,
             precision=precision, backend=backend, axis=axis,
-            n_points=n_points,
+            n_points=n_points, pair_budget=pair_budget,
         )
-        return final, core_g, ovf[None]
+        return final, core_g, ovf[None], pstats[None]
 
     spec = P("p", None, None)
     spec2 = P("p", None)
@@ -424,7 +433,7 @@ def sharded_step_ring(
         per_device,
         mesh=mesh,
         in_specs=(spec, spec2, spec2, spec2, spec2),
-        out_specs=(P(), P(), P("p")),
+        out_specs=(P(), P(), P("p"), P("p", None)),
         check_vma=False,
     )(owned, owned_mask, owned_gid, exp_lo, exp_hi)
 
@@ -517,10 +526,12 @@ def sharded_dbscan(
             round_up(int(hcap), block) if explicit
             else round_up(max(block, cap // 2), block)
         )
-        max_attempts = 1 if explicit else 4
-        for _attempt in range(max_attempts):
-            labels, core, overflow = _with_kernel_fallback(
-                lambda be, hc=this_hcap: sharded_step_ring(
+        hcap_attempts = 1 if explicit else 4
+        this_pair = None
+        pair_attempts = 2  # exact-total retry: one is always enough
+        while True:
+            labels, core, overflow, pstats = _with_kernel_fallback(
+                lambda be, hc=this_hcap, pb=this_pair: sharded_step_ring(
                     *args,
                     eps=float(eps),
                     min_samples=int(min_samples),
@@ -532,42 +543,79 @@ def sharded_dbscan(
                     precision=precision,
                     backend=be,
                     hcap=hc,
+                    pair_budget=pb,
                 ),
                 backend,
             )
-            if int(np.asarray(overflow).sum()) == 0:
-                break
-            this_hcap *= 2
-        else:
-            raise RuntimeError(
-                f"ring halo buffer overflow at hcap={this_hcap // 2}; "
-                f"pass a larger hcap"
-                if explicit
-                else f"ring halo buffer overflow persisted up to "
-                f"hcap={this_hcap // 2}"
-            )
+            if int(np.asarray(overflow).sum()) != 0:
+                hcap_attempts -= 1
+                if hcap_attempts <= 0:
+                    raise RuntimeError(
+                        f"ring halo buffer overflow at hcap={this_hcap}; "
+                        f"pass a larger hcap"
+                        if explicit
+                        else f"ring halo buffer overflow persisted up to "
+                        f"hcap={this_hcap}"
+                    )
+                this_hcap *= 2
+                continue
+            retry_pair = _pair_overflow(pstats)
+            if retry_pair and pair_attempts > 1:
+                pair_attempts -= 1
+                this_pair = retry_pair
+                continue
+            break
         stats = dict(stats, halo_exchange="ring", halo_cap=this_hcap)
         labels, core = np.asarray(labels), np.asarray(core)
         return _canonicalize_roots(labels, core), core, stats
     arrays, stats = build_shards(points, partitioner, eps, n_shards, block)
     arrays = tuple(jax.device_put(a, sharding) for a in arrays)
-    labels, core = _with_kernel_fallback(
-        lambda be: sharded_step(
-            *arrays,
-            eps=float(eps),
-            min_samples=int(min_samples),
-            metric=metric,
-            block=block,
-            mesh=mesh,
-            axis=axis,
-            n_points=len(points),
-            precision=precision,
-            backend=be,
-        ),
-        backend,
-    )
+
+    def run_host_layout(pair_budget):
+        return _with_kernel_fallback(
+            lambda be: sharded_step(
+                *arrays,
+                eps=float(eps),
+                min_samples=int(min_samples),
+                metric=metric,
+                block=block,
+                mesh=mesh,
+                axis=axis,
+                n_points=len(points),
+                precision=precision,
+                backend=be,
+                pair_budget=pair_budget,
+            ),
+            backend,
+        )
+
+    labels, core, pstats = run_host_layout(None)
+    retry_pair = _pair_overflow(pstats)
+    if retry_pair:
+        labels, core, _ = run_host_layout(retry_pair)
     labels, core = np.asarray(labels), np.asarray(core)
     return _canonicalize_roots(labels, core), core, stats
+
+
+def _pair_overflow(pstats) -> int:
+    """Exact pair budget to retry with, or 0 when no shard overflowed.
+
+    ``pstats``: (n_dev, 2) per-device ``[live_pairs_total, budget]``
+    from the Pallas pair extraction.  Budgets are shared (static), so
+    the max total is the binding requirement; the total is exact, so
+    one retry always suffices.
+    """
+    ps = np.asarray(pstats)
+    total, budget = int(ps[:, 0].max()), int(ps[:, 1].max())
+    if budget and total > budget:
+        from ..utils.log import get_logger
+
+        get_logger().warning(
+            "live tile-pair budget overflow (%d > %d); rerunning with "
+            "an exact budget", total, budget,
+        )
+        return round_up(total, 4096)
+    return 0
 
 
 def _canonicalize_roots(labels: np.ndarray, core: np.ndarray) -> np.ndarray:

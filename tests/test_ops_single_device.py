@@ -28,7 +28,7 @@ def _pad(X, block=256):
 
 def _run(X, eps, min_samples, metric="euclidean", block=256):
     pts, mask, n = _pad(X, block)
-    labels, core = dbscan_fixed_size(
+    labels, core, _ = dbscan_fixed_size(
         jnp.asarray(pts), eps, min_samples, jnp.asarray(mask),
         metric=metric, block=block,
     )

@@ -2,11 +2,11 @@
 
 The Pallas kernels compile with Mosaic only on real TPUs; CI runs them
 through the Pallas interpreter, which executes the same kernel body —
-including the manual HBM->VMEM DMAs and the two-level bbox pruning —
-with identical semantics.  Pairs whose distance sits within float ulps of
-eps can legitimately flip between the two paths (different matmul
-accumulation orders), so the comparison data keeps a guard band around
-eps.
+including the scalar-prefetch pair-list grid and the first-visit output
+accumulation — with identical semantics.  Pairs whose distance sits
+within float ulps of eps can legitimately flip between the two paths
+(different matmul accumulation orders), so the comparison data keeps a
+guard band around eps.
 """
 
 import numpy as np
@@ -136,7 +136,7 @@ def test_e2e_backend_pallas_interpret(blob_data, monkeypatch):
     from pypardis_tpu.ops.labels import dbscan_fixed_size
 
     pts, mask = blob_data
-    l_x, core_x = dbscan_fixed_size(
+    l_x, core_x, _ = dbscan_fixed_size(
         pts, 2.0, 8, mask, block=256, backend="xla"
     )
     monkeypatch.setattr(
@@ -149,9 +149,11 @@ def test_e2e_backend_pallas_interpret(blob_data, monkeypatch):
         "min_neighbor_label_pallas",
         functools.partial(pk.min_neighbor_label_pallas, interpret=True),
     )
-    l_p, core_p = dbscan_fixed_size(
+    l_p, core_p, pair_stats = dbscan_fixed_size(
         pts, 2.0, 8, mask, block=256, backend="pallas"
     )
+    total, budget = np.asarray(pair_stats)
+    assert 0 < total <= budget
     valid = np.asarray(mask)
     assert np.array_equal(np.asarray(l_x)[valid], np.asarray(l_p)[valid])
     assert np.array_equal(
