@@ -122,6 +122,9 @@ def _pad_and_run(
     dev = jnp.asarray(pts_t)
 
     def run(be, pair_budget=None):
+        # Transient-fault retries live INSIDE dbscan_device_pipeline
+        # (per stage); wrapping again here would multiply the retry
+        # count and sleep time on genuine errors.
         return np.array(
             dbscan_device_pipeline(
                 dev,

@@ -181,6 +181,56 @@ def default_pair_budget(nt: int) -> int:
     return max(4096, 48 * nt)
 
 
+# Tiles per group in the two-level extraction.  Small on purpose: a
+# group's box is the union of its tiles' boxes, and a union spanning
+# several Morton segments (= unrelated clusters) covers so much space
+# that group pruning stops working — measured 37% of all group pairs
+# live at 10M x 16-D with 16-tile groups.  4-tile groups combined with
+# group-aligned segment padding (pipeline._segment_break_layout) keep
+# every group inside one segment.
+PAIR_GROUP = 4
+
+
+def _csr_scan(live_fn, rid_fn, cid_fn, nc, budget, dump_row):
+    """Chunked compaction of a virtual boolean matrix into (rows, cols).
+
+    ``live_fn(c)`` -> flat bool chunk c; ``rid_fn``/``cid_fn(c)`` ->
+    the int32 ids each flat slot maps to.  Emits the True slots' ids in
+    scan order into static-length ``budget`` arrays (padding: row ==
+    dump_row, col == 0) plus the TRUE total.  Live entries past the
+    budget land on the dump slot — dropped, signalled via total >
+    budget.
+    """
+
+    def body(carry, c):
+        rows_out, cols_out, total = carry
+        live = live_fn(c)
+        inc = jnp.cumsum(live.astype(jnp.int32))
+        pos = total + inc - live  # exclusive running position
+        tgt = jnp.where(live, jnp.minimum(pos, budget), budget)
+        rows_out = rows_out.at[tgt].set(rid_fn(c))
+        cols_out = cols_out.at[tgt].set(cid_fn(c))
+        return (rows_out, cols_out, total + inc[-1]), None
+
+    init = (
+        jnp.full(budget + 1, dump_row, jnp.int32),
+        jnp.zeros(budget + 1, jnp.int32),
+        jnp.int32(0),
+    )
+    (rows_out, cols_out, total), _ = jax.lax.scan(
+        body, init, jnp.arange(nc)
+    )
+    return rows_out[:budget], cols_out[:budget], total
+
+
+def _pad_boxes(lo, hi, n_to):
+    pad = max(0, n_to - lo.shape[0])
+    return (
+        jnp.concatenate([lo, jnp.full((pad, lo.shape[1]), _BIG)]),
+        jnp.concatenate([hi, jnp.full((pad, hi.shape[1]), -_BIG)]),
+    )
+
+
 def live_tile_pairs(
     lo: jnp.ndarray,
     hi: jnp.ndarray,
@@ -202,13 +252,16 @@ def live_tile_pairs(
     same inputs).
 
     This is the tile-pruning stage of the Pallas path, hoisted out of
-    the kernel: one vectorized box-gap pass (chunked over row tiles so
-    the (C, nt) live mask never exceeds ~MBs) replaces the O(nt^2)
-    sequential scalar scan the round-3 kernels carried — which was
-    measured at 4.2s/pass of pure overhead at 10M points.
-
-    Empty tiles carry inverted (+BIG, -BIG) boxes: their gap to
-    anything is astronomically positive, so they never pair.
+    the kernel (the round-3 kernels carried the scan as an O(nt^2)
+    sequential scalar loop — 4.2s/pass of pure overhead at 10M
+    points).  It is itself two-level, because the flat (nt x nt) gap
+    matrix is quadratic too (measured 29s at nt=49k): group-of-16
+    boxes prune first, and only surviving group pairs expand to the
+    16x16 tile-pair test.  Soundness: a tile box is contained in its
+    group's box, so box-min-distance(groups) <= box-min-distance
+    (tiles) — a live tile pair can never hide behind a pruned group
+    pair.  Empty/padding tiles carry inverted (+BIG, -BIG) boxes whose
+    gap to anything is astronomically positive.
     """
     nt, d = lo.shape
     if lo_col is None:
@@ -219,46 +272,116 @@ def live_tile_pairs(
     # clamping makes small-nt extractions overflow-proof by construction.
     budget = min(budget, nt * nt)
     eps2 = jnp.asarray(eps, jnp.float32) ** 2
-    chunk = max(1, min(nt, -(-(1 << 22) // nt)))  # ~4M live-mask entries
-    nc = -(-nt // chunk)
-    pad = nc * chunk - nt
-    lo_r = jnp.concatenate([lo, jnp.full((pad, d), _BIG)], axis=0)
-    hi_r = jnp.concatenate([hi, jnp.full((pad, d), -_BIG)], axis=0)
+    G = PAIR_GROUP
+    ng = -(-nt // G)
+    # Per-tile boxes padded to full groups, plus one inverted dump
+    # group at index ng (the group-pair list pads rows there).
+    tlo_r, thi_r = _pad_boxes(lo, hi, (ng + 1) * G)
+    tlo_c, thi_c = _pad_boxes(lo_col, hi_col, (ng + 1) * G)
+    glo_r = tlo_r.reshape(ng + 1, G, d).min(axis=1)
+    ghi_r = thi_r.reshape(ng + 1, G, d).max(axis=1)
+    glo_c = tlo_c.reshape(ng + 1, G, d).min(axis=1)
+    ghi_c = thi_c.reshape(ng + 1, G, d).max(axis=1)
 
-    def body(carry, c):
-        rows_out, cols_out, total = carry
-        s = c * chunk
-        rlo = jax.lax.dynamic_slice_in_dim(lo_r, s, chunk)
-        rhi = jax.lax.dynamic_slice_in_dim(hi_r, s, chunk)
+    def box_gap_live(rlo, rhi, clo, chi):
         gap = jnp.maximum(
-            0.0,
-            jnp.maximum(
-                lo_col[None] - rhi[:, None], rlo[:, None] - hi_col[None]
-            ),
+            0.0, jnp.maximum(clo - rhi[..., None, :], rlo[..., None, :] - chi)
         )
-        live = (jnp.sum(gap * gap, axis=2) <= eps2).reshape(-1)
-        inc = jnp.cumsum(live.astype(jnp.int32))
-        pos = total + inc - live  # exclusive running position
-        tgt = jnp.where(live, jnp.minimum(pos, budget), budget)
-        rid = jnp.broadcast_to(
-            s + jnp.arange(chunk, dtype=jnp.int32)[:, None], (chunk, nt)
-        ).reshape(-1)
-        cid = jnp.broadcast_to(
-            jnp.arange(nt, dtype=jnp.int32)[None], (chunk, nt)
-        ).reshape(-1)
-        rows_out = rows_out.at[tgt].set(rid)
-        cols_out = cols_out.at[tgt].set(cid)
-        return (rows_out, cols_out, total + inc[-1]), None
+        return jnp.sum(gap * gap, axis=-1) <= eps2
 
-    init = (
-        jnp.full(budget + 1, nt, jnp.int32),
-        jnp.zeros(budget + 1, jnp.int32),
-        jnp.int32(0),
+    # Level 1: live group pairs.  Looser group boxes can pair where no
+    # tile pair is live, so the group list needs its own headroom (at
+    # 10M x 16-D: 192k live group pairs vs 120k live tile pairs);
+    # budget/2 keeps it comfortably above the tile count while the
+    # expansion stays G^2 * budget_g entries.  Overflow folds into the
+    # returned total (the same caller retry covers both levels).
+    budget_g = min(max(budget // 2, 4096), ng * ng)
+    chunk_g = max(1, min(ng, -(-(1 << 22) // max(ng, 1))))
+    nc_g = -(-ng // chunk_g)
+    # Row-side group boxes padded to whole chunks with inverted boxes:
+    # dynamic_slice CLAMPS an out-of-range start, which would misalign
+    # the last chunk's live mask against its row ids and silently drop
+    # real pairs (while underreporting the total).
+    glo_rp, ghi_rp = _pad_boxes(glo_r, ghi_r, nc_g * chunk_g)
+
+    def live_g(c):
+        s = c * chunk_g
+        rlo = jax.lax.dynamic_slice_in_dim(glo_rp, s, chunk_g)
+        rhi = jax.lax.dynamic_slice_in_dim(ghi_rp, s, chunk_g)
+        return box_gap_live(rlo, rhi, glo_c[None, :ng], ghi_c[None, :ng]
+                            ).reshape(-1)
+
+    def rid_g(c):
+        return jnp.broadcast_to(
+            c * chunk_g + jnp.arange(chunk_g, dtype=jnp.int32)[:, None],
+            (chunk_g, ng),
+        ).reshape(-1)
+
+    def cid_g(c):
+        return jnp.broadcast_to(
+            jnp.arange(ng, dtype=jnp.int32)[None], (chunk_g, ng)
+        ).reshape(-1)
+
+    rows_g, cols_g, total_g = _csr_scan(
+        live_g, rid_g, cid_g, nc_g, budget_g, ng
     )
-    (rows_out, cols_out, total), _ = jax.lax.scan(
-        body, init, jnp.arange(nc)
+
+    # Level 2: expand surviving group pairs to tile pairs.  Padding
+    # group pairs point at the inverted dump group — never live.
+    tlo_rg = tlo_r.reshape(ng + 1, G, d)
+    thi_rg = thi_r.reshape(ng + 1, G, d)
+    tlo_cg = tlo_c.reshape(ng + 1, G, d)
+    thi_cg = thi_c.reshape(ng + 1, G, d)
+    chunk_p = max(1, (1 << 22) // (G * G))
+    nc_p = -(-budget_g // chunk_p)
+    pad_p = nc_p * chunk_p - budget_g
+    rows_gp = jnp.concatenate([rows_g, jnp.full(pad_p, ng, jnp.int32)])
+    cols_gp = jnp.concatenate([cols_g, jnp.zeros(pad_p, jnp.int32)])
+    iota_g = jnp.arange(G, dtype=jnp.int32)
+
+    def slab(c):
+        a = jax.lax.dynamic_slice_in_dim(rows_gp, c * chunk_p, chunk_p)
+        b = jax.lax.dynamic_slice_in_dim(cols_gp, c * chunk_p, chunk_p)
+        return a, b
+
+    def live_t(c):
+        a, b = slab(c)
+        return box_gap_live(
+            tlo_rg[a], thi_rg[a], tlo_cg[b][:, None], thi_cg[b][:, None]
+        ).reshape(-1)
+
+    def rid_t(c):
+        a, _ = slab(c)
+        rid = a[:, None, None] * G + iota_g[None, :, None]
+        # Padded tiles inside real groups never go live; the dump
+        # group maps to row ids >= nt, clamped onto the dump row nt.
+        return jnp.minimum(
+            jnp.broadcast_to(rid, (chunk_p, G, G)).reshape(-1), nt
+        )
+
+    def cid_t(c):
+        _, b = slab(c)
+        cid = b[:, None, None] * G + iota_g[None, None, :]
+        return jnp.minimum(
+            jnp.broadcast_to(cid, (chunk_p, G, G)).reshape(-1), nt - 1
+        )
+
+    rows_t, cols_t, total_t = _csr_scan(
+        live_t, rid_t, cid_t, nc_p, budget, nt
     )
-    return rows_out[:budget], cols_out[:budget], total
+    # Expansion emits in group-pair order; the kernel needs row-major
+    # (each output row's visits consecutive).  Stable argsort on the
+    # row id alone — column order within a row is irrelevant.
+    order = jnp.argsort(rows_t, stable=True)
+    # A group-level overflow also invalidates the list; fold it into
+    # the total so the caller's exact-budget retry covers both levels
+    # (saturated product: overflow-safe in 32-bit mode; a retry this
+    # large only happens when the data defeats tile pruning outright).
+    g_need = jnp.minimum(
+        total_g.astype(jnp.float32) * (G * G), jnp.float32(1 << 30)
+    ).astype(jnp.int32)
+    total = jnp.maximum(total_t, jnp.where(total_g > budget_g, g_need, 0))
+    return rows_t[order], cols_t[order], total
 
 
 @functools.partial(

@@ -231,6 +231,91 @@ def dbscan_fixed_size(
     return labels, core, pair_stats
 
 
+# ---------------------------------------------------------------------------
+# Host-stepped variant of the propagation loop (Pallas backend only).
+#
+# A single fused execution of the while_loop at tens of millions of
+# points can run for minutes (each round is a minlab pass plus a
+# pointer-jump fixpoint of whole-array gathers) — long enough to trip
+# the remote-worker watchdog on tunneled deployments, which kills the
+# worker mid-run.  The stepped variant runs ONE round per device call
+# under host control (one scalar transfer per round), keeping every
+# execution short.  The fused dbscan_fixed_size stays the entry for
+# shard_map/vmap callers (host stepping is impossible inside a
+# collective program) and for small problems where per-call latency
+# would dominate.
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("min_samples", "block", "precision", "layout",
+                     "pair_budget"),
+)
+def dbscan_prepare_pallas(
+    points, eps, min_samples, mask, *, block, precision, layout,
+    pair_budget=None,
+):
+    """Pair extraction + counts pass + initial propagation state."""
+    from .pallas_kernels import kernel_pair_list, neighbor_counts_pallas
+
+    n = points.shape[0] if layout == "nd" else points.shape[1]
+    pairs, pair_stats = kernel_pair_list(
+        points, eps, mask, block, precision, layout, budget=pair_budget
+    )
+    counts = neighbor_counts_pallas(
+        points, eps, mask, block=block, precision=precision, layout=layout,
+        pairs=pairs,
+    )
+    core = (counts >= min_samples) & mask
+    f0 = jnp.where(core, jnp.arange(n, dtype=jnp.int32), _INT_INF)
+    return pairs, pair_stats, core, f0
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block", "precision", "layout")
+)
+def dbscan_round_pallas(
+    points, f, eps, core, mask, rows, cols, *, block, precision, layout,
+):
+    """One min-propagation round + pointer-jump shortcut.
+
+    Returns (f_new, g, changed); ``g`` is the round's min-neighbor-label
+    pass, reusable as the border-attach result once converged.
+    """
+    from .pallas_kernels import min_neighbor_label_pallas
+
+    g = min_neighbor_label_pallas(
+        points, f, eps, core, block=block, precision=precision,
+        layout=layout, row_mask=mask, pairs=(rows, cols),
+    )
+    f_new = jnp.where(core, jnp.minimum(f, g), f)
+    f_new = _pointer_jump(f_new, core)
+    return f_new, g, jnp.any(f_new != f)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block", "precision", "layout")
+)
+def dbscan_border_pallas(
+    points, f, eps, core, mask, rows, cols, *, block, precision, layout,
+):
+    """The final border-attach pass for a non-converged exit."""
+    from .pallas_kernels import min_neighbor_label_pallas
+
+    return min_neighbor_label_pallas(
+        points, f, eps, core, block=block, precision=precision,
+        layout=layout, row_mask=mask, pairs=(rows, cols),
+    )
+
+
+def finish_labels(f, border, core, mask):
+    """Labels from converged propagation state (host-stepped path)."""
+    return jnp.where(
+        core, f, jnp.where(mask & (border != _INT_INF), border, -1)
+    ).astype(jnp.int32)
+
+
 def densify_labels(root_labels: np.ndarray) -> np.ndarray:
     """Host-side: map root-index labels to dense 0..C-1 ids, noise -> -1.
 

@@ -446,7 +446,15 @@ def neighbor_counts_pallas(
         poison = stats[0] > stats[1]
     rows, cols = pairs
     eps2 = jnp.asarray(eps, jnp.float32).reshape(1) ** 2
-    acc0 = jnp.zeros((nt + 1, 1, block), jnp.int32)
+    # The accumulator is donated into the output via
+    # input_output_aliases; without the barrier XLA folds it into an
+    # executable-owned constant whose buffer the donation destroys on
+    # the first run — the second execution of the same program then
+    # fails with INVALID_ARGUMENT (reproduced at 10M points).  The
+    # barrier forces a fresh per-execution allocation.
+    acc0 = jax.lax.optimization_barrier(
+        jnp.zeros((nt + 1, 1, block), jnp.int32)
+    )
     # Padding pairs carry row == nt: every row-keyed input needs a real
     # block there (an OOB index map is an HBM fault, not a clamp).
     ycols_x = _with_dump_block(ycols)
@@ -516,7 +524,11 @@ def min_neighbor_label_pallas(
     rows, cols = pairs
     labi = jnp.where(src_mask, labels, _INT_INF).reshape(nt, 1, block)
     eps2 = jnp.asarray(eps, jnp.float32).reshape(1) ** 2
-    acc0 = jnp.full((nt + 1, 1, block), _INT_INF, jnp.int32)
+    # Barrier for the same donated-constant reason as in
+    # neighbor_counts_pallas.
+    acc0 = jax.lax.optimization_barrier(
+        jnp.full((nt + 1, 1, block), _INT_INF, jnp.int32)
+    )
     ycols_x = _with_dump_block(ycols)
     best = _pair_call(
         functools.partial(_minlab_pairs_kernel, mode=mode, nt=nt),

@@ -39,7 +39,15 @@ import functools
 import jax
 import jax.numpy as jnp
 
+import numpy as np
+
 from .labels import dbscan_fixed_size
+
+# Shapes/configs whose stage-2 / stepped-round programs have already
+# been compiled — see dbscan_device_pipeline for why the first call
+# must sync between stages on this deployment.
+_compiled_pipeline_keys: set = set()
+_compiled_step_keys: set = set()
 
 def _device_morton_words(x, mask):
     """Per-point Morton code as a list of uint32 words (most significant
@@ -115,10 +123,21 @@ def _segment_break_layout(xs, mask, perm, eps, block: int, bt: int):
     jump = jnp.concatenate(
         [jnp.zeros(1, xs.dtype), jnp.where(pair_ok, d2, 0.0)]
     )
-    # Break where the jump clears 4*eps AND ranks within budget.
-    kth = jax.lax.top_k(jump, bt)[0][-1]
+    # Break where the jump clears 4*eps AND ranks within budget.  The
+    # rank threshold usually doesn't bind (clusters in the thousands vs
+    # a budget of one break per tile), so the top-k only runs when the
+    # 4*eps count actually exceeds the budget — lax.cond executes one
+    # branch, and top_k at k=cap/block over tens of millions of jumps
+    # measured whole seconds at 25M points.
     eps2 = jnp.asarray(eps, xs.dtype) ** 2
-    brk = jump > jnp.maximum(16.0 * eps2, kth)
+    base = 16.0 * eps2
+    n_big = jnp.sum(jump > base)
+    kth = jax.lax.cond(
+        n_big > bt,
+        lambda: jax.lax.top_k(jump, bt)[0][-1],
+        lambda: jnp.zeros((), xs.dtype),
+    )
+    brk = jump > jnp.maximum(base, kth)
     seg = jnp.cumsum(brk.astype(jnp.int32))
     nseg_max = bt + 1
     seg_len = jnp.zeros(nseg_max, jnp.int32).at[seg].add(1)
@@ -132,13 +151,215 @@ def _segment_break_layout(xs, mask, perm, eps, block: int, bt: int):
     return ys, mask2, owner
 
 
+@functools.partial(jax.jit, static_argnames=("block", "sort", "precision"))
+def _pipeline_layout(points_t, eps, n, block: int, sort: bool,
+                     precision: str = "high"):
+    """Stage 1: device Morton sort + segment-break padding.
+
+    Returns (xs, mask_k, owner); ``owner`` is None-encoded as the plain
+    permutation when no break layout ran (sort=False returns identity).
+    """
+    d, cap = points_t.shape
+    mask = jnp.arange(cap) < n
+    if not sort:
+        return points_t, mask, jnp.arange(cap, dtype=jnp.int32)
+    words = _device_morton_words(points_t, mask)
+    # jnp.lexsort: the LAST key is primary -> most significant first.
+    perm = jnp.lexsort(tuple(words[::-1])).astype(jnp.int32)
+    xs = jnp.take(points_t, perm, axis=1)
+    # Segment-break padding (worth its pad waste only once the
+    # problem spans enough tiles for box mixing to matter).  Segments
+    # align to whole PAIR_GROUP-of-kernel-tiles so the extraction's
+    # group boxes never union across segments (a cross-segment union
+    # box in high-D covers unrelated clusters and kills group
+    # pruning).  Budget one break per alignment unit: pad capacity at
+    # most doubles (HBM-cheap), and a tighter budget measurably
+    # re-leaks — at 10M x 16-D the data has ~4k genuine cluster
+    # transitions in Morton order.
+    from .distances import PAIR_GROUP
+    from .pallas_kernels import _norm_precision_mode, _pallas_block
+
+    align = PAIR_GROUP * _pallas_block(
+        block, cap, d, _norm_precision_mode(precision)
+    )
+    bt = max(64, cap // align)
+    if cap >= 16 * block:
+        return _segment_break_layout(xs, mask, perm, eps, align, bt)
+    return xs, mask, perm
+
+
+@functools.partial(jax.jit, static_argnames=("cap",))
+def _pipeline_finish_pack(f, border, core, mask_k, pair_stats, owner, *, cap):
+    """Stepped-path tail: finish labels + unscatter + pack in ONE jit
+    (eager op-by-op dispatch of the 2x-capacity arrays would both cost
+    extra passes and widen the unretryable surface)."""
+    from .labels import finish_labels
+
+    labels = finish_labels(f, border, core, mask_k)
+    return _pipeline_pack(labels, core, pair_stats, owner, cap=cap)
+
+
+@functools.partial(jax.jit, static_argnames=("cap",))
+def _pipeline_pack(roots_s, core_s, pair_stats, owner, *, cap):
+    """Unscatter kernel-space results to input order and pack.
+
+    Kernel-space root indices -> original point ids, then scatter rows
+    back to input order.  ``owner`` sends pad slots to the dump row
+    ``cap`` of a (cap+1,)-sized scatter target.
+    """
+    capk = roots_s.shape[0]
+    valid = roots_s >= 0
+    tgt = jnp.clip(roots_s, 0, capk - 1)
+    roots_g = jnp.where(valid, owner[tgt], -1)
+    safe_owner = jnp.clip(owner, 0, cap)
+    roots = jnp.zeros(cap + 1, jnp.int32).at[safe_owner].set(roots_g)[:cap]
+    core = (
+        jnp.zeros(cap + 1, jnp.int32)
+        .at[safe_owner]
+        .set(core_s.astype(jnp.int32))[:cap]
+    )
+    return jnp.concatenate(
+        [jnp.stack([roots, core]), pair_stats[:, None]], axis=1
+    )
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "min_samples", "metric", "block", "precision", "backend", "sort",
+        "cap", "min_samples", "metric", "block", "precision", "backend",
         "pair_budget",
     ),
 )
+def _pipeline_cluster(
+    xs, mask_k, owner, eps, *, cap, min_samples, metric, block, precision,
+    backend, pair_budget,
+):
+    """Stage 2 (fused): fixed-size DBSCAN + unscatter + pack."""
+    roots_s, core_s, pair_stats = dbscan_fixed_size(
+        xs,
+        eps,
+        min_samples,
+        mask_k,
+        metric=metric,
+        block=block,
+        precision=precision,
+        backend=backend,
+        layout="dn",
+        pair_budget=pair_budget,
+    )
+    return _pipeline_pack(roots_s, core_s, pair_stats, owner, cap=cap)
+
+
+# Kernel capacities past this run the host-stepped propagation loop
+# (one device call per round, labels.py's stepped section) instead of
+# the fused while_loop.  Stepping exists for deployments whose worker
+# watchdog kills any single execution running minutes (e.g. ~25M
+# low-dim points, where each round is seconds and convergence takes
+# many rounds).  Default OFF: on the current tunneled chip, large
+# Pallas programs sporadically fail RE-execution with INVALID_ARGUMENT
+# (environment nondeterminism, reproduced both ways with identical
+# code), and the fused path — one execution per fit — sidesteps it.
+# Opt in via PYPARDIS_STEP_THRESHOLD=<points>.
+STEP_THRESHOLD = int(
+    __import__("os").environ.get("PYPARDIS_STEP_THRESHOLD", 1 << 62)
+)
+MAX_ROUNDS = 64
+
+
+def _transient_retry(stage, fn):
+    """Retry a device call through transient axon-runtime faults.
+
+    The tunneled single-chip deployment sporadically fails a large
+    Pallas program's re-execution with INVALID_ARGUMENT / INTERNAL (the
+    identical call succeeds moments later), and a crashed worker
+    surfaces as UNAVAILABLE until it restarts.  Pure environment
+    nondeterminism — the retried call computes the same pure function.
+    """
+    import time as _time
+
+    last = None
+    for wait in (0, 10, 75):
+        if wait:
+            from ..utils.log import get_logger
+
+            get_logger().warning(
+                "transient TPU runtime error in %s; retrying in %ds: %s",
+                stage, wait, str(last)[:160],
+            )
+            _time.sleep(wait)
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — re-raised unless transient
+            msg = f"{type(e).__name__}: {e}"
+            if not any(
+                s in msg
+                for s in ("UNAVAILABLE", "INTERNAL", "INVALID_ARGUMENT",
+                          "InvalidArgument")
+            ):
+                raise
+            last = e
+    raise last
+
+
+def _cluster_stepped(
+    xs, mask_k, owner, eps, *, cap, min_samples, block, precision,
+    pair_budget,
+):
+    """Stage 2 (host-stepped, Pallas): one device call per round."""
+    from .labels import (
+        dbscan_border_pallas,
+        dbscan_prepare_pallas,
+        dbscan_round_pallas,
+    )
+
+    kw = dict(block=block, precision=precision, layout="dn")
+    step_key = (xs.shape, block, precision, pair_budget)
+    first = step_key not in _compiled_step_keys
+
+    def run_prepare():
+        out = dbscan_prepare_pallas(
+            xs, eps, min_samples, mask_k, pair_budget=pair_budget, **kw
+        )
+        if first:
+            # Device must be idle before the round program's first
+            # compile — a compile concurrent with device execution
+            # poisons the worker on this deployment (later executions
+            # fail INVALID_ARGUMENT or the worker dies outright).
+            np.asarray(out[1])
+        return out
+
+    (rows, cols), pair_stats, core, f = _transient_retry(
+        "prepare", run_prepare
+    )
+    _compiled_step_keys.add(step_key)
+    g = None
+    converged = False
+    for _ in range(MAX_ROUNDS):
+        def one_round(f=f):
+            out = dbscan_round_pallas(
+                xs, f, eps, core, mask_k, rows, cols, **kw
+            )
+            return out + (bool(out[2]),)  # sync inside the retry scope
+
+        f, g, _, changed = _transient_retry("round", one_round)
+        if not changed:  # the sync also bounds per-call length
+            converged = True
+            break
+    if not converged:
+        g = _transient_retry(
+            "border",
+            lambda: dbscan_border_pallas(
+                xs, f, eps, core, mask_k, rows, cols, **kw
+            ),
+        )
+    return _transient_retry(
+        "pack",
+        lambda: _pipeline_finish_pack(
+            f, g, core, mask_k, pair_stats, owner, cap=cap
+        ),
+    )
+
+
 def dbscan_device_pipeline(
     points_t,
     eps,
@@ -156,62 +377,61 @@ def dbscan_device_pipeline(
     per point (input order, -1 noise), row 1 = core flags; the extra
     final column is ``[live_pairs_total, budget]`` from the Pallas
     tile-pair extraction (rides in-band so the driver gets results and
-    overflow status in ONE device->host transfer; zeros on XLA)."""
-    d, cap = points_t.shape
-    mask = jnp.arange(cap) < n
-    if sort:
-        words = _device_morton_words(points_t, mask)
-        # jnp.lexsort: the LAST key is primary -> most significant first.
-        perm = jnp.lexsort(tuple(words[::-1])).astype(jnp.int32)
-        xs = jnp.take(points_t, perm, axis=1)
-        # Segment-break padding (worth its pad waste only once the
-        # problem spans enough tiles for box mixing to matter).  Budget
-        # one break per tile: pad capacity at most doubles (HBM-cheap)
-        # and a tighter budget measurably re-leaks — at 10M x 16-D the
-        # data has ~3k genuine cluster transitions in Morton order but
-        # cap/block/8 allowed only 610 breaks.
-        bt = max(64, cap // block)
-        if cap >= 16 * block:
-            xs, mask_k, owner = _segment_break_layout(
-                xs, mask, perm, eps, block, bt
-            )
-        else:
-            mask_k, owner = mask, perm
-    else:
-        owner = None
-        mask_k = mask
-        xs = points_t
-    roots_s, core_s, pair_stats = dbscan_fixed_size(
-        xs,
-        eps,
-        min_samples,
-        mask_k,
-        metric=metric,
-        block=block,
-        precision=precision,
-        backend=backend,
-        layout="dn",
-        pair_budget=pair_budget,
+    overflow status in ONE device->host transfer; zeros on XLA).
+
+    Two separately-jitted stages rather than one fused program: the
+    fused compile at ~50M-point capacities crashed the axon compile
+    helper outright, and each stage alone compiles in ~20s.  The
+    stages chain asynchronously on device, so the split costs no host
+    round-trip — except the very first call for a given shape, which
+    syncs stage 1 before tracing stage 2: compiling a large program
+    while the device is mid-execution also crashed the worker
+    (reproduced repeatedly at 25M points; every compile-idle staged
+    run succeeded).
+    """
+    from .labels import resolve_backend
+
+    cap = points_t.shape[1]
+    key = (
+        points_t.shape, points_t.dtype, min_samples, metric, block,
+        precision, backend, sort, pair_budget,
     )
-    if owner is not None:
-        # Kernel-space root indices -> original point ids, then scatter
-        # rows back to input order.  ``owner`` sends pad slots to the
-        # dump row ``cap`` of a (cap+1,)-sized scatter target.
-        capk = xs.shape[1]
-        valid = roots_s >= 0
-        tgt = jnp.clip(roots_s, 0, capk - 1)
-        roots_g = jnp.where(valid, owner[tgt], -1)
-        safe_owner = jnp.clip(owner, 0, cap)
-        roots = (
-            jnp.zeros(cap + 1, jnp.int32).at[safe_owner].set(roots_g)[:cap]
+
+    def run_layout():
+        out = _pipeline_layout(
+            points_t, eps, n, block=block, sort=sort, precision=precision
         )
-        core = (
-            jnp.zeros(cap + 1, jnp.int32)
-            .at[safe_owner]
-            .set(core_s.astype(jnp.int32))[:cap]
-        )
-    else:
-        roots, core = roots_s, core_s.astype(jnp.int32)
-    return jnp.concatenate(
-        [jnp.stack([roots, core]), pair_stats[:, None]], axis=1
+        if key not in _compiled_pipeline_keys:
+            # First time for this shape: let stage 1 finish on device
+            # before stage 2's compile starts (block_until_ready can
+            # return early on tunneled deployments; a 1-element
+            # transfer is a reliable barrier).
+            np.asarray(out[0][:1, :1])
+            _compiled_pipeline_keys.add(key)
+        return out
+
+    xs, mask_k, owner = _transient_retry("layout", run_layout)
+    capk = xs.shape[1]
+    stepped = (
+        capk >= STEP_THRESHOLD
+        and resolve_backend(backend, metric, capk, block) == "pallas"
     )
+    if stepped:
+        return _cluster_stepped(
+            xs, mask_k, owner, eps,
+            cap=cap, min_samples=min_samples, block=block,
+            precision=precision, pair_budget=pair_budget,
+        )
+
+    def run_cluster():
+        out = _pipeline_cluster(
+            xs, mask_k, owner, eps,
+            cap=cap, min_samples=min_samples, metric=metric, block=block,
+            precision=precision, backend=backend, pair_budget=pair_budget,
+        )
+        # Surface async execution faults inside the retry scope (the
+        # caller's bulk transfer would otherwise eat them).
+        np.asarray(out[:1, :1])
+        return out
+
+    return _transient_retry("cluster", run_cluster)

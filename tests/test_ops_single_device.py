@@ -84,3 +84,23 @@ def test_padding_invariance(blobs750):
     a, _ = _run(blobs750, 0.3, 10, block=128)
     b, _ = _run(blobs750, 0.3, 10, block=512)
     np.testing.assert_array_equal(a, b)
+
+
+def test_live_tile_pairs_chunk_boundary():
+    """Level-1 group scan must not drop rows when the group count just
+    exceeds a scan chunk (regression: dynamic_slice clamps an
+    out-of-range start, which misaligned the last chunk's live mask and
+    silently dropped real pairs while underreporting the total)."""
+    from pypardis_tpu.ops.distances import PAIR_GROUP, live_tile_pairs
+
+    # nt such that ng = nt / PAIR_GROUP lands just past the ~4M-entry
+    # chunking threshold's chunk size for this ng (chunk == 2048 when
+    # ng is a bit over 2048).
+    nt = (2048 + 2) * PAIR_GROUP
+    lo = jnp.arange(nt, dtype=jnp.float32)[:, None] * 10.0
+    hi = lo  # isolated degenerate boxes: only self-pairs are live
+    rows, cols, total = live_tile_pairs(lo, hi, 1.0)
+    assert int(total) == nt
+    got = {(int(r), int(c)) for r, c in zip(np.asarray(rows), np.asarray(cols))
+           if int(r) < nt}
+    assert got == {(i, i) for i in range(nt)}
