@@ -230,6 +230,8 @@ class DBSCAN:
         mesh=None,
         precision: str = "high",
         kernel_backend: str = "auto",
+        merge: str = "auto",
+        profile_dir: Optional[str] = None,
     ):
         self.eps = float(eps)
         self.min_samples = int(min_samples)
@@ -240,6 +242,8 @@ class DBSCAN:
         self.mesh = mesh
         self.precision = precision
         self.kernel_backend = kernel_backend
+        self.merge = merge
+        self.profile_dir = profile_dir
         # Reference attribute surface (dbscan.py:93-102).
         self.data = None
         self.result = None
@@ -256,7 +260,19 @@ class DBSCAN:
     # -- training ---------------------------------------------------------
 
     def train(self, data) -> "DBSCAN":
-        """Cluster a (key, vector) dataset (reference dbscan.py:104-126)."""
+        """Cluster a (key, vector) dataset (reference dbscan.py:104-126).
+
+        With ``profile_dir`` set, the whole run executes under a
+        ``jax.profiler`` trace (TensorBoard/Perfetto-viewable), and
+        per-phase wall times always flow through
+        :class:`~pypardis_tpu.utils.profiling.PhaseTimer` into
+        ``metrics_`` — phases end on materialized outputs, so the
+        numbers include async device execution.
+        """
+        import contextlib
+
+        from .utils.profiling import PhaseTimer, trace
+
         keys, points = _as_keys_points(data)
         self._keys = keys
         self.data = points
@@ -271,12 +287,19 @@ class DBSCAN:
             self.metrics_ = {"total_s": 0.0, "points_per_sec": 0.0}
             return self
 
+        timer = PhaseTimer()
+        ctx = (
+            trace(self.profile_dir)
+            if self.profile_dir
+            else contextlib.nullcontext()
+        )
         n_devices = self._n_devices()
-        if n_devices > 1 and len(points) >= 2 * n_devices:
-            self._train_sharded(points, n_devices)
-        else:
-            self._train_single(points)
-
+        with ctx:
+            if n_devices > 1 and len(points) >= 2 * n_devices:
+                self._train_sharded(points, n_devices, timer)
+            else:
+                self._train_single(points, timer)
+        self.metrics_.update(timer.as_dict())
         self.metrics_["total_s"] = time.perf_counter() - t0
         self.metrics_["points_per_sec"] = len(points) / max(
             self.metrics_["total_s"], 1e-9
@@ -288,7 +311,12 @@ class DBSCAN:
             **{k: round(v, 4) for k, v in self.metrics_.items()
                if isinstance(v, float)},
         )
-        self.result = list(zip(self._keys.tolist(), self.labels_.tolist()))
+        # Key-sorted result — the reference's final ``sortByKey()``
+        # (dbscan.py:164) is part of its output contract.
+        order = np.argsort(self._keys, kind="stable")
+        self.result = list(
+            zip(self._keys[order].tolist(), self.labels_[order].tolist())
+        )
         return self
 
     def fit(self, X) -> "DBSCAN":
@@ -312,15 +340,17 @@ class DBSCAN:
 
         return jax.device_count()
 
-    def _train_single(self, points: np.ndarray) -> None:
-        t0 = time.perf_counter()
-        roots, core = _pad_and_run(
-            points, self.eps, self.min_samples, self.metric, self.block,
-            precision=self.precision, backend=self.kernel_backend,
-        )
+    def _train_single(self, points: np.ndarray, timer) -> None:
+        with timer.phase("cluster"):
+            # _pad_and_run materializes numpy outputs, so the phase
+            # bound includes all device execution.
+            roots, core = _pad_and_run(
+                points, self.eps, self.min_samples, self.metric, self.block,
+                precision=self.precision, backend=self.kernel_backend,
+            )
         self.core_sample_mask_ = core
-        self.labels_ = densify_labels(roots)
-        self.metrics_["cluster_s"] = time.perf_counter() - t0
+        with timer.phase("densify"):
+            self.labels_ = densify_labels(roots)
         self.metrics_["n_partitions"] = 1
         lo, hi = points.min(axis=0), points.max(axis=0)
         box = BoundingBox(lower=lo, upper=hi)
@@ -331,45 +361,50 @@ class DBSCAN:
             f"0:{l}": int(l) for l in np.unique(self.labels_) if l >= 0
         }
 
-    def _train_sharded(self, points: np.ndarray, n_devices: int) -> None:
+    def _train_sharded(self, points: np.ndarray, n_devices: int,
+                       timer) -> None:
         from .parallel.sharded import sharded_dbscan
 
-        t0 = time.perf_counter()
-        # max_partitions is a user-facing MAX (reference dbscan.py:74-75)
-        # — never exceed an explicit value.  Only the default rounds up
-        # to a mesh multiple; build_shards pads the partition axis with
-        # fully-masked empty slots when the count isn't one.
-        if self.max_partitions is None:
-            max_parts = n_devices
-        else:
-            max_parts = int(self.max_partitions)
-        part = KDPartitioner(
-            points,
-            max_partitions=max_parts,
-            split_method=self.split_method,
-        )
-        self.partitioner_ = part
-        self.bounding_boxes = part.bounding_boxes
-        self.expanded_boxes = {
-            l: b.expand(2 * self.eps) for l, b in part.bounding_boxes.items()
-        }
-        self.metrics_["partition_s"] = time.perf_counter() - t0
+        with timer.phase("partition"):
+            # max_partitions is a user-facing MAX (reference
+            # dbscan.py:74-75) — never exceed an explicit value.  Only
+            # the default rounds up to a mesh multiple; build_shards
+            # pads the partition axis with fully-masked empty slots
+            # when the count isn't one.
+            if self.max_partitions is None:
+                max_parts = n_devices
+            else:
+                max_parts = int(self.max_partitions)
+            part = KDPartitioner(
+                points,
+                max_partitions=max_parts,
+                split_method=self.split_method,
+            )
+            self.partitioner_ = part
+            self.bounding_boxes = part.bounding_boxes
+            self.expanded_boxes = {
+                l: b.expand(2 * self.eps)
+                for l, b in part.bounding_boxes.items()
+            }
 
-        t1 = time.perf_counter()
-        labels, core, stats = sharded_dbscan(
-            points,
-            part,
-            eps=self.eps,
-            min_samples=self.min_samples,
-            metric=self.metric,
-            block=self.block,
-            mesh=self.mesh,
-            precision=self.precision,
-            backend=self.kernel_backend,
-        )
-        self.labels_ = densify_labels(labels)
+        with timer.phase("cluster"):
+            # sharded_dbscan returns numpy labels — device work is
+            # materialized inside the phase.
+            labels, core, stats = sharded_dbscan(
+                points,
+                part,
+                eps=self.eps,
+                min_samples=self.min_samples,
+                metric=self.metric,
+                block=self.block,
+                mesh=self.mesh,
+                precision=self.precision,
+                backend=self.kernel_backend,
+                merge=self.merge,
+            )
+        with timer.phase("densify"):
+            self.labels_ = densify_labels(labels)
         self.core_sample_mask_ = core
-        self.metrics_["cluster_s"] = time.perf_counter() - t1
         self.metrics_.update(stats)
         self.metrics_["n_partitions"] = part.n_partitions
         # Parity surface (reference dbscan.py:93-102).  ``neighbors``:
@@ -412,11 +447,23 @@ class DBSCAN:
 
     def cluster_mapping(self) -> ClusterAggregator:
         """Host-side ClusterAggregator over the final labels, for parity
-        with the reference's ``cluster_dict`` inspection surface."""
+        with the reference's ``cluster_dict`` inspection surface.
+
+        Labels feed in as the REAL ``partition:cluster`` pairs of the
+        trained model (the sharded path's KD assignment when present,
+        partition 0 otherwise), so the aggregator's ``fwd``/``rev``
+        reflect the actual partition structure rather than a fabricated
+        single-partition view (round-2 review, Weak #8).
+        """
         agg = ClusterAggregator()
         if self.labels_ is not None:
-            for key, label in zip(self._keys, self.labels_):
+            parts = (
+                self.partitioner_.result
+                if self.partitioner_ is not None
+                else np.zeros(len(self.labels_), np.int32)
+            )
+            for key, part, label in zip(self._keys, parts, self.labels_):
                 if label >= 0:
-                    agg + (key, [f"0:{label}"])
+                    agg + (key, [f"{int(part)}:{label}"])
         self.cluster_dict = dict(agg.fwd)
         return agg

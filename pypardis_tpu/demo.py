@@ -114,6 +114,51 @@ def _plots(X, labels, part, out):
         )
     ax.set_title("clusters + partitions")
     fig.savefig(os.path.join(out, "clusters_partitions.png"), dpi=120)
+
+    # Per-partition scatters — the reference ships one partition_N.png
+    # per KD leaf (plots/*/partition_*.png).
+    for label_id in sorted(part.partitions):
+        idx = part.partitions[label_id]
+        fig, ax = plt.subplots(figsize=(4, 4))
+        ax.scatter(X[:, 0], X[:, 1], c="0.85", s=6)
+        if len(idx):
+            ax.scatter(X[idx, 0], X[idx, 1], c=labels[idx], s=8,
+                       cmap="tab10")
+        ax.set_title(f"partition {label_id}")
+        fig.savefig(os.path.join(out, f"partition_{label_id}.png"), dpi=100)
+        plt.close(fig)
+
+    # Animated build-up of the partitions — the reference embeds
+    # dbscan_animated.gif (README.md:36).
+    try:
+        from matplotlib.animation import FuncAnimation, PillowWriter
+
+        fig, ax = plt.subplots(figsize=(5, 5))
+        order = sorted(part.partitions)
+
+        def frame(i):
+            ax.clear()
+            ax.scatter(X[:, 0], X[:, 1], c="0.85", s=6)
+            for label_id in order[: i + 1]:
+                idx = part.partitions[label_id]
+                if len(idx):
+                    ax.scatter(X[idx, 0], X[idx, 1], c=labels[idx], s=8,
+                               cmap="tab10")
+                box = part.bounding_boxes[label_id]
+                lo, hi = box.lower, box.upper
+                ax.add_patch(
+                    plt.Rectangle(lo, *(hi - lo), fill=False, ec="k",
+                                  lw=0.8)
+                )
+            ax.set_title(f"partitions 0..{order[i]}")
+
+        anim = FuncAnimation(fig, frame, frames=len(order))
+        anim.save(
+            os.path.join(out, "dbscan_animated.gif"),
+            writer=PillowWriter(fps=2),
+        )
+    except Exception as e:  # noqa: BLE001 — the GIF is a nicety
+        print(f"animation skipped: {e}", file=sys.stderr)
     plt.close("all")
     print(f"wrote plots to {out}/")
 

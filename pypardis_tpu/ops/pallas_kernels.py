@@ -145,7 +145,7 @@ def _first_visit(rows_ref):
 
 
 def _count_pairs_kernel(rows_ref, cols_ref, eps2_ref, c_ref, x_ref, y_ref,
-                        acc_ref, out_ref, *, mode, nt):
+                        out_ref, *, mode, nt):
     eps2 = eps2_ref[0]
     # Recentre the pair on the output tile's box center: operand
     # magnitudes become tile-local, keeping the matmul expansion's
@@ -156,12 +156,12 @@ def _count_pairs_kernel(rows_ref, cols_ref, eps2_ref, c_ref, x_ref, y_ref,
     real = rows_ref[pl.program_id(0)] < nt
     first = _first_visit(rows_ref)
 
-    # First visit of a row within this call: resume from the aliased
-    # accumulator (identity on the first chunk; the partial of earlier
-    # chunks on seam rows).
+    # First visit of a row within this call: start from the identity.
+    # Rows a call never visits keep uninitialized garbage — callers
+    # mask with the visited-rows set (see _pair_call).
     @pl.when(real & first)
     def _():
-        out_ref[0] = acc_ref[0]
+        out_ref[0] = jnp.zeros_like(out_ref[0])
 
     # Padding pairs carry row == nt: skip their (block x block) matmul
     # entirely (their index maps dump, but the FLOPs would be real —
@@ -174,7 +174,7 @@ def _count_pairs_kernel(rows_ref, cols_ref, eps2_ref, c_ref, x_ref, y_ref,
 
 
 def _minlab_pairs_kernel(rows_ref, cols_ref, eps2_ref, c_ref, x_ref, y_ref,
-                         lab_ref, acc_ref, out_ref, *, mode, nt):
+                         lab_ref, out_ref, *, mode, nt):
     eps2 = eps2_ref[0]
     c = c_ref[0]
     real = rows_ref[pl.program_id(0)] < nt
@@ -182,7 +182,7 @@ def _minlab_pairs_kernel(rows_ref, cols_ref, eps2_ref, c_ref, x_ref, y_ref,
 
     @pl.when(real & first)
     def _():
-        out_ref[0] = acc_ref[0]
+        out_ref[0] = jnp.full_like(out_ref[0], _INT_INF)
 
     @pl.when(real)
     def _():
@@ -253,13 +253,17 @@ def _shape_nd(points, layout):
 # Pairs per pallas_call: the row/col index arrays ride in SMEM (scalar
 # prefetch), and SMEM is ~1MB/core — 48k pairs is 384KB of int32 x2,
 # comfortable alongside Mosaic's own scalars.  Longer lists run as a
-# lax.scan of chunked calls threading the accumulator through
-# input_output_aliases (seam rows resume from it via the first-visit
-# read; unvisited blocks pass through untouched).
+# lax.scan of chunked calls whose partials merge into a carried
+# accumulator on the rows each chunk visited.  (An earlier design
+# threaded the accumulator through input_output_aliases instead; the
+# axon runtime deterministically failed RE-execution of such programs
+# with INVALID_ARGUMENT, and the merge's extra traffic is only the
+# (nt+1, block) accumulator per chunk — tens of ms per pass.)
 CHUNK_PAIRS = 48 * 1024
 
 
-def _pair_call(kernel, nt, d, block, n_extra_in, interpret):
+def _pair_call(kernel, nt, d, block, n_extra_in, interpret, identity,
+               combine):
     """Common pallas_call plumbing for the two pair-list kernels.
 
     Grid = one program per pair-list entry; the row/col tile index
@@ -267,10 +271,11 @@ def _pair_call(kernel, nt, d, block, n_extra_in, interpret):
     can address HBM blocks by them.  Padding entries carry row nt — the
     dump row of the (nt+1)-row output, sliced off by callers.
 
-    ``call(rows, cols, eps2, acc, *arrays)``: ``acc`` is the (nt+1, 1,
-    block) int32 accumulator holding each row's identity (0 / INT_INF);
-    it is aliased into the output, so rows without a single live pair
-    keep their identity value instead of exposing uninitialized memory.
+    ``identity``: the neutral value rows start from (0 / INT_INF);
+    ``combine``: how per-chunk partials fold into the accumulator (add
+    / minimum).  Rows a chunk never visits hold uninitialized memory in
+    its partial; the visited-rows mask keeps them out of the merge, and
+    rows no chunk visits come back as ``identity``.
     """
 
     def specs(n_pairs):
@@ -300,9 +305,7 @@ def _pair_call(kernel, nt, d, block, n_extra_in, interpret):
                 (1, 1, block), lambda p, r, c, e: (c[p], 0, 0),
                 memory_space=pltpu.VMEM,
             )
-        ] * n_extra_in + [
-            row_keyed  # the aliased accumulator, same map as the output
-        ]
+        ] * n_extra_in
         return pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=3,
             grid=(n_pairs,),
@@ -310,22 +313,25 @@ def _pair_call(kernel, nt, d, block, n_extra_in, interpret):
             out_specs=row_keyed,
         )
 
-    # Flat input index of ``acc`` (scalar-prefetch args included).
-    acc_idx = 3 + 3 + n_extra_in
-
-    def one_call(rows, cols, eps2, acc, arrays):
+    def one_call(rows, cols, eps2, arrays):
         return pl.pallas_call(
             kernel,
             grid_spec=specs(rows.shape[0]),
             out_shape=jax.ShapeDtypeStruct((nt + 1, 1, block), jnp.int32),
-            input_output_aliases={acc_idx: 0},
             interpret=interpret,
-        )(rows, cols, eps2, *arrays, acc)
+        )(rows, cols, eps2, *arrays)
 
-    def call(rows, cols, eps2, acc, *arrays):
+    def merge(acc, partial, rows):
+        visited = jnp.zeros(nt + 1, bool).at[rows].set(True)
+        return jnp.where(
+            visited[:, None, None], combine(acc, partial), acc
+        )
+
+    def call(rows, cols, eps2, *arrays):
         n_pairs = rows.shape[0]
+        acc0 = jnp.full((nt + 1, 1, block), identity, jnp.int32)
         if n_pairs <= CHUNK_PAIRS:
-            return one_call(rows, cols, eps2, acc, arrays)
+            return merge(acc0, one_call(rows, cols, eps2, arrays), rows)
         nch = -(-n_pairs // CHUNK_PAIRS)
         pad = nch * CHUNK_PAIRS - n_pairs
         rows = jnp.concatenate([rows, jnp.full(pad, nt, jnp.int32)])
@@ -333,11 +339,11 @@ def _pair_call(kernel, nt, d, block, n_extra_in, interpret):
 
         def body(carry, rc):
             r, c = rc
-            return one_call(r, c, eps2, carry, arrays), None
+            return merge(carry, one_call(r, c, eps2, arrays), r), None
 
         acc, _ = jax.lax.scan(
             body,
-            acc,
+            acc0,
             (
                 rows.reshape(nch, CHUNK_PAIRS),
                 cols.reshape(nch, CHUNK_PAIRS),
@@ -446,22 +452,14 @@ def neighbor_counts_pallas(
         poison = stats[0] > stats[1]
     rows, cols = pairs
     eps2 = jnp.asarray(eps, jnp.float32).reshape(1) ** 2
-    # The accumulator is donated into the output via
-    # input_output_aliases; without the barrier XLA folds it into an
-    # executable-owned constant whose buffer the donation destroys on
-    # the first run — the second execution of the same program then
-    # fails with INVALID_ARGUMENT (reproduced at 10M points).  The
-    # barrier forces a fresh per-execution allocation.
-    acc0 = jax.lax.optimization_barrier(
-        jnp.zeros((nt + 1, 1, block), jnp.int32)
-    )
     # Padding pairs carry row == nt: every row-keyed input needs a real
     # block there (an OOB index map is an HBM fault, not a clamp).
     ycols_x = _with_dump_block(ycols)
     counts = _pair_call(
         functools.partial(_count_pairs_kernel, mode=mode, nt=nt),
         nt, d, block, 0, interpret,
-    )(rows, cols, eps2, acc0, _with_dump_block(centers), ycols_x, ycols_x)
+        identity=0, combine=jnp.add,
+    )(rows, cols, eps2, _with_dump_block(centers), ycols_x, ycols_x)
     counts = jnp.where(mask, counts[:nt].reshape(-1), 0)
     if poison is not None:
         counts = jnp.where(poison, -1, counts)
@@ -524,17 +522,13 @@ def min_neighbor_label_pallas(
     rows, cols = pairs
     labi = jnp.where(src_mask, labels, _INT_INF).reshape(nt, 1, block)
     eps2 = jnp.asarray(eps, jnp.float32).reshape(1) ** 2
-    # Barrier for the same donated-constant reason as in
-    # neighbor_counts_pallas.
-    acc0 = jax.lax.optimization_barrier(
-        jnp.full((nt + 1, 1, block), _INT_INF, jnp.int32)
-    )
     ycols_x = _with_dump_block(ycols)
     best = _pair_call(
         functools.partial(_minlab_pairs_kernel, mode=mode, nt=nt),
         nt, d, block, 1, interpret,
+        identity=_INT_INF, combine=jnp.minimum,
     )(
-        rows, cols, eps2, acc0, _with_dump_block(centers), ycols_x,
+        rows, cols, eps2, _with_dump_block(centers), ycols_x,
         ycols_x, _with_dump_block(labi),
     )
     best = best[:nt].reshape(-1)

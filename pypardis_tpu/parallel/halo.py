@@ -57,19 +57,77 @@ def ring_halo_exchange(
 ):
     """Collect every remote point inside this device's expanded box.
 
-    Must run inside ``shard_map``.  ``owned``: (cap, k) this device's
-    points; ``mask``: (cap,) validity; ``gid``: (cap,) global point ids.
-    ``box_lo``/``box_hi``: (k,) this device's bounding box already
-    expanded by 2*eps (the reference's duplication rule, README.md:20).
-    Returns ``(halo, halo_mask, halo_gid, overflow)`` with leading
-    dimension ``hcap``; ``overflow`` counts in-box points dropped because
-    the buffer filled — callers must treat nonzero as an error.
+    Single-partition-per-device convenience wrapper around
+    :func:`ring_halo_exchange_multi` (adds/strips the partition axis).
+    """
+    halo, hmask, hgid, overflow = ring_halo_exchange_multi(
+        owned[None], mask[None], gid[None],
+        box_lo[None], box_hi[None], hcap, axis,
+    )
+    return halo[0], hmask[0], hgid[0], overflow[0]
+
+
+def ring_halo_exchange_multi(
+    owned: jnp.ndarray,
+    mask: jnp.ndarray,
+    gid: jnp.ndarray,
+    boxes_lo: jnp.ndarray,
+    boxes_hi: jnp.ndarray,
+    hcap: int,
+    axis: str,
+):
+    """Collect each local partition's halo from the whole mesh.
+
+    Must run inside ``shard_map``.  ``owned``: (L, cap, k) this
+    device's partitions; ``mask``: (L, cap) validity; ``gid``: (L, cap)
+    global point ids.  ``boxes_lo``/``boxes_hi``: (L, k) each
+    partition's bounding box already expanded by 2*eps (the reference's
+    duplication rule, README.md:20).  Returns ``(halo, halo_mask,
+    halo_gid, overflow)`` with shapes (L, hcap, ...) / (L,).
+
+    Round 0 filters the device's OWN slab (cross-partition halos within
+    a device, excluding each partition's own points); rounds 1..n_dev-1
+    circulate the full (L, cap) slab over the ring and filter remote
+    points — so any ``L = n_partitions / n_devices`` works, not just
+    one partition per device (round-2 restriction).
     """
     n_dev = jax.lax.axis_size(axis)
-    cap, k = owned.shape
-    halo = jnp.zeros((hcap, k), owned.dtype)
-    hmask = jnp.zeros((hcap,), bool)
-    hgid = jnp.full((hcap,), jnp.int32(2**31 - 1))
+    L, cap, k = owned.shape
+    halo = jnp.zeros((L, hcap, k), owned.dtype)
+    hmask = jnp.zeros((L, hcap), bool)
+    hgid = jnp.full((L, hcap), jnp.int32(2**31 - 1))
+    overflow = jnp.zeros((L,), jnp.int32)
+
+    flat_pts = owned.reshape(L * cap, k)
+    flat_msk = mask.reshape(L * cap)
+    flat_gid = gid.reshape(L * cap)
+    # Which local partition each flat slot belongs to (for the local
+    # round's own-partition exclusion).
+    part_of = jnp.repeat(jnp.arange(L, dtype=jnp.int32), cap)
+
+    def filter_into(halo, hmask, hgid, overflow, pts, msk, gids, excl):
+        def one(l, h, hm, hgd):
+            inbox = (
+                msk
+                & jnp.all(pts >= boxes_lo[l][None, :], axis=1)
+                & jnp.all(pts <= boxes_hi[l][None, :], axis=1)
+            )
+            if excl:
+                inbox &= part_of != l
+            return _compact_merge(h, hm, hgd, pts, inbox, gids)
+
+        out = [one(l, halo[l], hmask[l], hgid[l]) for l in range(L)]
+        return (
+            jnp.stack([o[0] for o in out]),
+            jnp.stack([o[1] for o in out]),
+            jnp.stack([o[2] for o in out]),
+            overflow + jnp.stack([o[3] for o in out]),
+        )
+
+    # Local round: other partitions on this device.
+    halo, hmask, hgid, overflow = filter_into(
+        halo, hmask, hgid, overflow, flat_pts, flat_msk, flat_gid, True
+    )
 
     perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
 
@@ -78,22 +136,14 @@ def ring_halo_exchange(
         buf_pts = jax.lax.ppermute(buf_pts, axis, perm)
         buf_msk = jax.lax.ppermute(buf_msk, axis, perm)
         buf_gid = jax.lax.ppermute(buf_gid, axis, perm)
-        inbox = (
-            buf_msk
-            & jnp.all(buf_pts >= box_lo[None, :], axis=1)
-            & jnp.all(buf_pts <= box_hi[None, :], axis=1)
+        halo, hmask, hgid, overflow = filter_into(
+            halo, hmask, hgid, overflow, buf_pts, buf_msk, buf_gid, False
         )
-        halo, hmask, hgid, dropped = _compact_merge(
-            halo, hmask, hgid, buf_pts, inbox, buf_gid
-        )
-        return (
-            buf_pts, buf_msk, buf_gid, halo, hmask, hgid,
-            overflow + dropped,
-        )
+        return buf_pts, buf_msk, buf_gid, halo, hmask, hgid, overflow
 
     # fori_loop (not a Python unroll): the traced program stays O(1) in
     # mesh size — 255-device rings compile the same graph as 8-device.
-    state = (owned, mask, gid, halo, hmask, hgid, jnp.int32(0))
+    state = (flat_pts, flat_msk, flat_gid, halo, hmask, hgid, overflow)
     state = jax.lax.fori_loop(0, n_dev - 1, step, state)
     _, _, _, halo, hmask, hgid, overflow = state
     return halo, hmask, hgid, overflow

@@ -95,8 +95,9 @@ def merge_occurrences(
         np.concatenate([home_label[home_label >= 0], edges.reshape(-1)])
     )
     mapping = resolve_label_edges(edges, ids)
-    lut = np.full(int(ids.max()) + 2 if len(ids) else 1, -1, np.int64)
+    lut = np.full(int(ids.max()) + 2 if len(ids) else 1, -1, np.int32)
     for k, v in mapping.items():
         lut[k] = v
-    final = np.where(home_label >= 0, lut[np.clip(home_label, 0, None)], -1)
-    return final.astype(np.int32), mapping
+    from .._native import relabel_i32
+
+    return relabel_i32(home_label, lut, fill=-1), mapping

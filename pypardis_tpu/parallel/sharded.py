@@ -125,6 +125,17 @@ def build_owned_shards(points, partitioner, eps, n_shards, block):
     _, arrays, cap, p_total = _owned_layout(
         pts32, partitioner, labels, n_shards, block
     )
+    if p_total > len(labels):
+        # Padding partitions get inverted boxes (lo > hi): their ring
+        # filter matches nothing and they collect no halo.
+        k = exp_lo.shape[1]
+        pad = p_total - len(labels)
+        exp_lo = np.concatenate(
+            [exp_lo, np.full((pad, k), np.float32(3e38))]
+        )
+        exp_hi = np.concatenate(
+            [exp_hi, np.full((pad, k), np.float32(-3e38))]
+        )
     stats = {
         "owned_cap": cap,
         "n_shard_partitions": p_total,
@@ -188,6 +199,46 @@ def build_shards(points, partitioner, eps, n_shards, block):
 # ---------------------------------------------------------------------------
 # the jitted sharded step
 # ---------------------------------------------------------------------------
+
+
+def _cluster_local_partitions(
+    pts, msk, *, eps, min_samples, metric, block, precision, backend,
+    pair_budget,
+):
+    """Run per-partition DBSCAN over a device's (L, cap, k) partitions.
+
+    L == 1 calls the kernel directly.  For L > 1 the Pallas backend
+    runs a Python loop over partitions (static L — pallas_call cannot
+    batch under vmap, and the round-2 design simply refused multi-
+    partition Pallas); the XLA backend vmaps.  Returns (labels, core,
+    pair_stats) with the worst-case (max-total) pair stats.
+    """
+    from ..ops.labels import resolve_backend
+
+    def one_part(p, m, be):
+        return dbscan_fixed_size(
+            p, eps, min_samples, m, metric=metric, block=block,
+            precision=precision, backend=be, pair_budget=pair_budget,
+        )
+
+    if pts.shape[0] == 1:
+        l1, c1, pair_stats = one_part(pts[0], msk[0], backend)
+        return l1[None], c1[None], pair_stats
+    if resolve_backend(backend, metric, pts.shape[1], block) == "pallas":
+        outs = [
+            one_part(pts[i], msk[i], backend) for i in range(pts.shape[0])
+        ]
+        labels = jnp.stack([o[0] for o in outs])
+        core = jnp.stack([o[1] for o in outs])
+        pair_stats = jnp.stack([o[2] for o in outs]).max(axis=0)
+        return labels, core, pair_stats
+    labels, core, ps = jax.vmap(
+        functools.partial(one_part, be="xla")
+    )(pts, msk)
+    # XLA-path stats are zeros; elementwise max keeps the shape and
+    # stays meaningful if totals ever become nonzero (the static
+    # budget is shared, so max(total) is the binding constraint).
+    return labels, core, ps.max(axis=0)
 
 
 def _merge_loop(lab_map, home_label, core_g, h_gid, h_lab, h_core, axis,
@@ -311,32 +362,11 @@ def _device_cluster_merge(
     msk = jnp.concatenate([om, hm], axis=1)
     gid = jnp.concatenate([og, hg], axis=1)
 
-    def one_part(p, m, be):
-        return dbscan_fixed_size(
-            p, eps, min_samples, m, metric=metric, block=block,
-            precision=precision, backend=be, pair_budget=pair_budget,
-        )
-    if pts.shape[0] == 1:
-        # One partition per device (the common layout): call directly
-        # so Pallas kernels / lax.cond tile pruning stay usable —
-        # under vmap, cond lowers to select and pallas_call batching
-        # is unsupported for these scalar-prefetch kernels.
-        l1, c1, pair_stats = one_part(pts[0], msk[0], backend)
-        labels, core = l1[None], c1[None]
-    else:
-        if backend == "pallas":
-            raise ValueError(
-                "backend='pallas' requires one partition per device "
-                "(the vmapped multi-partition layout runs XLA kernels);"
-                " use backend='auto' or max_partitions <= mesh size"
-            )
-        labels, core, ps = jax.vmap(
-            functools.partial(one_part, be="xla")
-        )(pts, msk)
-        # XLA-path stats are zeros; elementwise max keeps the shape and
-        # stays correct if a batched Pallas path ever lands (the static
-        # budget is shared, so max(total) is the binding constraint).
-        pair_stats = ps.max(axis=0)
+    labels, core, pair_stats = _cluster_local_partitions(
+        pts, msk, eps=eps, min_samples=min_samples, metric=metric,
+        block=block, precision=precision, backend=backend,
+        pair_budget=pair_budget,
+    )
     # local root index -> global cluster key (root point gid)
     glabel = jnp.where(
         labels >= 0,
@@ -394,6 +424,65 @@ def _device_cluster_merge(
 @functools.partial(
     jax.jit,
     static_argnames=(
+        "eps", "min_samples", "metric", "block", "mesh", "axis",
+        "precision", "backend", "pair_budget",
+    ),
+)
+def sharded_step_local(
+    owned, owned_mask, owned_gid, halo, halo_mask, halo_gid,
+    *, eps, min_samples, metric, block, mesh, axis,
+    precision="high", backend="auto", pair_budget=None,
+):
+    """Per-shard clustering WITHOUT the in-graph merge.
+
+    The companion of :func:`sharded_step` for ``merge='host'``: each
+    device clusters its partitions (owned + halo slabs) and ships back
+    only compact per-slot labels — owned labels, owned core flags, and
+    the labels its HALO duplicates received — all still sharded on the
+    partition axis.  No collective and no replicated (N+1,) state runs
+    on device; the cross-partition reconciliation happens on the host
+    over these occurrence tables (:mod:`pypardis_tpu.parallel.merge`),
+    which is the memory-safe path once N-sized replicated arrays stop
+    fitting beside the point data (~20 bytes/point/device).
+    """
+
+    def per_device(o, om, og, h, hm, hg):
+        pts = jnp.concatenate([o, h], axis=1)
+        msk = jnp.concatenate([om, hm], axis=1)
+        gid = jnp.concatenate([og, hg], axis=1)
+
+        labels, core, pair_stats = _cluster_local_partitions(
+            pts, msk, eps=eps, min_samples=min_samples, metric=metric,
+            block=block, precision=precision, backend=backend,
+            pair_budget=pair_budget,
+        )
+        glabel = jnp.where(
+            labels >= 0,
+            jnp.take_along_axis(gid, jnp.clip(labels, 0, None), axis=1),
+            -1,
+        ).astype(jnp.int32)
+        l_cap = o.shape[1]
+        return (
+            glabel[:, :l_cap],
+            core[:, :l_cap],
+            glabel[:, l_cap:],
+            pair_stats[None],
+        )
+
+    spec = P("p", None, None)
+    spec2 = P("p", None)
+    return jax.shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(spec, spec2, spec2, spec, spec2, spec2),
+        out_specs=(spec2, spec2, spec2, P("p", None)),
+        check_vma=False,
+    )(owned, owned_mask, owned_gid, halo, halo_mask, halo_gid)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
         "eps", "min_samples", "metric", "block", "mesh", "axis", "n_points",
         "precision", "backend", "hcap", "pair_budget",
     ),
@@ -407,25 +496,26 @@ def sharded_step_ring(
 
     Like :func:`sharded_step`, but halos never touch the host: each
     device's owned slab circulates the ring (``ppermute`` over ICI) and
-    every device keeps the points inside its 2*eps-expanded box
-    (:mod:`pypardis_tpu.parallel.halo`).  Requires one partition per
-    device.  Returns ``(labels, core, overflow, pair_stats)`` —
-    ``overflow`` is the per-device count of in-box points dropped for
-    capacity; nonzero means rerun with a larger ``hcap``.
+    every device keeps the points inside its partitions' 2*eps-expanded
+    boxes (:mod:`pypardis_tpu.parallel.halo` — any number of partitions
+    per device; the round-2 design required exactly one).  Returns
+    ``(labels, core, overflow, pair_stats)`` — ``overflow`` is the
+    per-partition count of in-box points dropped for capacity; nonzero
+    means rerun with a larger ``hcap``.
     """
-    from .halo import ring_halo_exchange
+    from .halo import ring_halo_exchange_multi
 
     def per_device(o, om, og, lo, hi):
-        h, hm, hg, ovf = ring_halo_exchange(
-            o[0], om[0], og[0], lo[0], hi[0], hcap, axis
+        h, hm, hg, ovf = ring_halo_exchange_multi(
+            o, om, og, lo, hi, hcap, axis
         )
         final, core_g, pstats = _device_cluster_merge(
-            o, om, og, h[None], hm[None], hg[None],
+            o, om, og, h, hm, hg,
             eps=eps, min_samples=min_samples, metric=metric, block=block,
             precision=precision, backend=backend, axis=axis,
             n_points=n_points, pair_budget=pair_budget,
         )
-        return final, core_g, ovf[None], pstats[None]
+        return final, core_g, ovf, pstats[None]
 
     spec = P("p", None, None)
     spec2 = P("p", None)
@@ -463,6 +553,14 @@ def _with_kernel_fallback(fn, backend):
         return fn("xla")
 
 
+# Above this point count, merge='auto' reconciles labels on the host:
+# the in-graph merge replicates five (N+1,)-sized int32/bool arrays per
+# device (~20 bytes/point/device, ~2GB at 100M) which eventually stops
+# fitting beside the point data; the host merge ships only compact
+# per-slot label tables.
+MERGE_HOST_AUTO = 32_000_000
+
+
 def sharded_dbscan(
     points,
     partitioner,
@@ -475,6 +573,7 @@ def sharded_dbscan(
     backend: str = "auto",
     halo: str = "host",
     hcap: Optional[int] = None,
+    merge: str = "auto",
 ):
     """Cluster ``points`` over the device mesh.
 
@@ -484,16 +583,39 @@ def sharded_dbscan(
     ``halo``: ``"host"`` materializes halo slabs on the host from one
     vectorized box query (build_shards); ``"ring"`` ships only owned
     slabs and exchanges halos device-side via ``ppermute`` over the
-    mesh interconnect (requires exactly one partition per device; the
-    host never computes halo sets).  ``hcap`` caps the ring halo buffer
-    per device (rounded up to a block multiple) and overflow raises;
-    ``None`` starts at half the owned capacity and doubles on overflow
-    (each retry recompiles).
+    mesh interconnect (any ``max_partitions``; the host never computes
+    halo sets).  ``hcap`` caps the ring halo buffer per partition
+    (rounded up to a block multiple) and overflow raises; ``None``
+    starts at half the owned capacity and doubles on overflow (each
+    retry recompiles).
+
+    ``merge``: ``"device"`` reconciles cross-partition labels in-graph
+    (pmin collectives over replicated (N+1,) arrays — the lowest
+    latency path); ``"host"`` pulls compact per-slot label tables and
+    merges on the host (:mod:`pypardis_tpu.parallel.merge` — the
+    memory-safe path when N-sized replicated arrays stop fitting,
+    ~20 bytes/point/device); ``"auto"`` switches to host past
+    ``MERGE_HOST_AUTO`` points.  ``merge="host"`` requires
+    ``halo="host"`` (the ring exchange never materializes halo tables
+    off-device).
     """
     from ..ops.distances import _norm_metric
     from .mesh import default_mesh
 
     metric = _norm_metric(metric)
+    if merge not in ("auto", "device", "host"):
+        raise ValueError(f"merge must be auto|device|host, got {merge!r}")
+    if merge == "auto":
+        merge = (
+            "host"
+            if halo != "ring" and len(points) >= MERGE_HOST_AUTO
+            else "device"
+        )
+    if merge == "host" and halo == "ring":
+        raise ValueError(
+            "merge='host' requires halo='host': the ring exchange never "
+            "materializes halo occurrence tables off-device"
+        )
     if mesh is None:
         mesh = default_mesh()
     n_shards = mesh.devices.size
@@ -509,13 +631,6 @@ def sharded_dbscan(
         arrays, exp_lo, exp_hi, labels_sorted, stats = build_owned_shards(
             points, partitioner, eps, n_shards, block
         )
-        owned = arrays[0]
-        if owned.shape[0] != n_shards or len(labels_sorted) != n_shards:
-            raise ValueError(
-                f"halo='ring' needs exactly one partition per device "
-                f"(got {len(labels_sorted)} partitions on {n_shards} "
-                f"devices)"
-            )
         args = tuple(
             jax.device_put(a, sharding)
             for a in (*arrays, exp_lo, exp_hi)
@@ -570,6 +685,47 @@ def sharded_dbscan(
         return _canonicalize_roots(labels, core), core, stats
     arrays, stats = build_shards(points, partitioner, eps, n_shards, block)
     arrays = tuple(jax.device_put(a, sharding) for a in arrays)
+
+    if merge == "host":
+        from .merge import merge_occurrences
+
+        def run_local(pair_budget):
+            return _with_kernel_fallback(
+                lambda be: sharded_step_local(
+                    *arrays,
+                    eps=float(eps),
+                    min_samples=int(min_samples),
+                    metric=metric,
+                    block=block,
+                    mesh=mesh,
+                    axis=axis,
+                    precision=precision,
+                    backend=be,
+                    pair_budget=pair_budget,
+                ),
+                backend,
+            )
+
+        own_glab, own_core, halo_glab, pstats = run_local(None)
+        retry_pair = _pair_overflow(pstats)
+        if retry_pair:
+            own_glab, own_core, halo_glab, _ = run_local(retry_pair)
+        n = len(points)
+        og = arrays[2]  # (P, cap) owned gids; padding slots carry n
+        hg = arrays[5]  # (P, hcap) halo gids
+        own_glab = np.asarray(own_glab).reshape(-1)
+        own_core = np.asarray(own_core).reshape(-1)
+        og_flat = np.asarray(og).reshape(-1)
+        sel = og_flat < n
+        home_label = np.full(n, -1, np.int32)
+        home_label[og_flat[sel]] = own_glab[sel]
+        core = np.zeros(n, bool)
+        core[og_flat[sel]] = own_core[sel]
+        labels, _mapping = merge_occurrences(
+            home_label, core, np.asarray(hg), np.asarray(halo_glab)
+        )
+        stats = dict(stats, merge="host")
+        return _canonicalize_roots(labels, core), core, stats
 
     def run_host_layout(pair_budget):
         return _with_kernel_fallback(

@@ -296,12 +296,24 @@ class KDPartitioner:
         self._sample_size = sample_size
         self._rng = np.random.default_rng(seed)
 
-        lo = points.min(axis=0)
-        hi = points.max(axis=0)
+        # Global box as a union-reduction of chunk boxes — the same
+        # shape as the reference's BoundingBox.union aggregate
+        # (partition.py:135-137), just over host chunks instead of RDD
+        # partitions; vectorized per chunk, never an (N, P, k) temp.
+        chunk = 1 << 20
+        global_box = BoundingBox(k=self.k)  # empty: union identity
+        for s in range(0, len(points), chunk):
+            e = min(s + chunk, len(points))
+            global_box = global_box.union(
+                BoundingBox(
+                    lower=points[s:e].min(axis=0),
+                    upper=points[s:e].max(axis=0),
+                )
+            )
         self.bounding_boxes: Dict[int, BoundingBox] = {}
         self.partitions: Dict[int, np.ndarray] = {}
         self.tree = []
-        self._create_partitions(BoundingBox(lower=lo, upper=hi))
+        self._create_partitions(global_box)
 
         self.result = np.empty(len(points), dtype=np.int32)
         for label, idx in self.partitions.items():

@@ -65,3 +65,43 @@ def test_map_cluster_id():
     assert map_cluster_id((3, ["0:1*"]), mapping) == (3, 5)
     assert map_cluster_id((4, ["0:-1"]), mapping) == (4, -1)
     assert map_cluster_id((5, ["9:9"]), mapping) == (5, -1)
+
+
+def test_assignments_key_sorted():
+    """assignments() returns key-sorted pairs — the reference's final
+    sortByKey() (dbscan.py:164) is part of its output contract."""
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(200, 2)).astype(np.float32)
+    keys = rng.permutation(1000)[:200]  # unsorted, non-contiguous
+    m = DBSCAN(eps=0.5, min_samples=5).train((keys, X))
+    got_keys = [k for k, _ in m.assignments()]
+    assert got_keys == sorted(got_keys)
+    # labels still line up with their keys
+    by_key = dict(m.assignments())
+    order = np.argsort(keys, kind="stable")
+    for k, l in zip(keys[order], m.labels_[order]):
+        assert by_key[int(k)] == int(l)
+
+
+def test_cluster_mapping_real_partitions():
+    """cluster_mapping() reflects the actual partition:cluster pairs
+    of a sharded run (not a fabricated single-partition view)."""
+    from sklearn.datasets import make_blobs
+
+    X, _ = make_blobs(
+        n_samples=2000, centers=6, n_features=3, cluster_std=0.3,
+        random_state=1,
+    )
+    m = DBSCAN(eps=0.5, min_samples=5, block=128, max_partitions=8)
+    m.fit(X)
+    if m.partitioner_ is None:  # single-device environment: skip
+        import pytest
+
+        pytest.skip("sharded path unavailable")
+    agg = m.cluster_mapping()
+    parts_seen = {int(k.split(":")[0]) for k in agg.fwd}
+    real_parts = {
+        int(p) for p, l in zip(m.partitioner_.result, m.labels_) if l >= 0
+    }
+    assert parts_seen == real_parts
+    assert len(parts_seen) > 1

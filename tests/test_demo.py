@@ -21,8 +21,11 @@ def test_demo_plots(tmp_path):
 
         pytest.skip("matplotlib not installed")
     run_demo(n=200, eps=0.3, min_samples=5, out=str(tmp_path))
-    for f in ("partitioning.png", "clusters.png", "clusters_partitions.png"):
+    for f in ("partitioning.png", "clusters.png", "clusters_partitions.png",
+              "dbscan_animated.gif"):
         assert (tmp_path / f).exists()
+    # One scatter per KD leaf, like the reference's plots/*/partition_N.png.
+    assert list(tmp_path.glob("partition_*.png"))
 
 
 def test_demo_data_shape():

@@ -50,11 +50,27 @@ def test_ring_matches_single_node(sharded_setup):
     assert adjusted_rand_score(sk.labels_, densify_labels(l_ring)) >= 0.99
 
 
-def test_ring_requires_one_partition_per_device(sharded_setup):
+def test_ring_multi_partition_per_device(sharded_setup):
+    """halo='ring' with max_partitions > n_devices (two KD partitions
+    per device) must match the host-halo labels exactly — the round-2
+    one-partition-per-device restriction is lifted."""
+    X, mesh, _ = sharded_setup
+    part16 = KDPartitioner(X, max_partitions=16)
+    kw = dict(eps=0.4, min_samples=5, block=128, mesh=mesh)
+    l_host, c_host, _ = sharded_dbscan(X, part16, halo="host", **kw)
+    l_ring, c_ring, s_ring = sharded_dbscan(X, part16, halo="ring", **kw)
+    assert s_ring["halo_exchange"] == "ring"
+    assert np.array_equal(c_host, c_ring)
+    assert np.array_equal(densify_labels(l_host), densify_labels(l_ring))
+
+
+def test_ring_fewer_partitions_than_devices(sharded_setup):
+    """max_partitions below the mesh size pads empty ring slots whose
+    inverted boxes collect no halo."""
     X, mesh, _ = sharded_setup
     part4 = KDPartitioner(X, max_partitions=4)
-    with pytest.raises(ValueError, match="one partition per device"):
-        sharded_dbscan(
-            X, part4, eps=0.4, min_samples=5, block=128, mesh=mesh,
-            halo="ring",
-        )
+    kw = dict(eps=0.4, min_samples=5, block=128, mesh=mesh)
+    l_host, c_host, _ = sharded_dbscan(X, part4, halo="host", **kw)
+    l_ring, c_ring, _ = sharded_dbscan(X, part4, halo="ring", **kw)
+    assert np.array_equal(c_host, c_ring)
+    assert np.array_equal(densify_labels(l_host), densify_labels(l_ring))
