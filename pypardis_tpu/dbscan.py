@@ -341,6 +341,10 @@ class DBSCAN:
         return jax.device_count()
 
     def _train_single(self, points: np.ndarray, timer) -> None:
+        # A previous sharded fit's partition tree describes the OLD
+        # dataset; clear it so cluster_mapping() can't pair new labels
+        # with stale partition assignments.
+        self.partitioner_ = None
         with timer.phase("cluster"):
             # _pad_and_run materializes numpy outputs, so the phase
             # bound includes all device execution.

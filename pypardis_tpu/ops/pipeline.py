@@ -151,22 +151,65 @@ def _segment_break_layout(xs, mask, perm, eps, block: int, bt: int):
     return ys, mask2, owner
 
 
-@functools.partial(jax.jit, static_argnames=("block", "sort", "precision"))
+@jax.jit
+def _layout_words(points_t, n):
+    """Layout program 1: per-point Morton words (masked-last)."""
+    mask = jnp.arange(points_t.shape[1]) < n
+    return _device_morton_words(points_t, mask), mask
+
+
+@jax.jit
+def _layout_perm(words):
+    """Layout program 2: the variadic lexsort alone.
+
+    jnp.lexsort: the LAST key is primary -> most significant first.
+    """
+    return jnp.lexsort(tuple(words[::-1])).astype(jnp.int32)
+
+
+@jax.jit
+def _layout_gather(points_t, perm, n):
+    """Layout program 3: gather points into sorted order.
+
+    Invalid points carry all-ones codes and sort last, so the
+    ``arange(cap) < n`` mask is permutation-invariant.
+    """
+    return jnp.take(points_t, perm, axis=1), jnp.arange(points_t.shape[1]) < n
+
+
+_segment_break_jit = jax.jit(
+    _segment_break_layout, static_argnames=("block", "bt")
+)
+
+
 def _pipeline_layout(points_t, eps, n, block: int, sort: bool,
                      precision: str = "high"):
     """Stage 1: device Morton sort + segment-break padding.
 
     Returns (xs, mask_k, owner); ``owner`` is None-encoded as the plain
     permutation when no break layout ran (sort=False returns identity).
+
+    NOT one fused jit: each step (Morton words / lexsort / gather /
+    segment-break) dispatches as its own small program.  The axon
+    client deterministically corrupts its executable session once a
+    second *large* fused program is compiled — after which RE-executing
+    any later-compiled large program (the Pallas cluster stage) fails
+    INVALID_ARGUMENT and the session is dead until process restart
+    (reproduced: merely .lower().compile() of the fused layout, never
+    executed, was enough; each sub-program alone is benign).  The steps
+    chain asynchronously on device and have no fusion opportunities
+    across the sort barrier, so the split costs only dispatch latency.
     """
     d, cap = points_t.shape
-    mask = jnp.arange(cap) < n
     if not sort:
-        return points_t, mask, jnp.arange(cap, dtype=jnp.int32)
-    words = _device_morton_words(points_t, mask)
-    # jnp.lexsort: the LAST key is primary -> most significant first.
-    perm = jnp.lexsort(tuple(words[::-1])).astype(jnp.int32)
-    xs = jnp.take(points_t, perm, axis=1)
+        return (
+            points_t,
+            jnp.arange(cap) < n,
+            jnp.arange(cap, dtype=jnp.int32),
+        )
+    words, mask = _layout_words(points_t, n)
+    perm = _layout_perm(words)
+    xs, mask = _layout_gather(points_t, perm, n)
     # Segment-break padding (worth its pad waste only once the
     # problem spans enough tiles for box mixing to matter).  Segments
     # align to whole PAIR_GROUP-of-kernel-tiles so the extraction's
@@ -184,7 +227,7 @@ def _pipeline_layout(points_t, eps, n, block: int, sort: bool,
     )
     bt = max(64, cap // align)
     if cap >= 16 * block:
-        return _segment_break_layout(xs, mask, perm, eps, align, bt)
+        return _segment_break_jit(xs, mask, perm, eps, block=align, bt=bt)
     return xs, mask, perm
 
 

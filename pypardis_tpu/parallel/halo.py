@@ -46,27 +46,6 @@ def _compact_merge(halo, hmask, hgid, pts, valid, gid):
     )
 
 
-def ring_halo_exchange(
-    owned: jnp.ndarray,
-    mask: jnp.ndarray,
-    gid: jnp.ndarray,
-    box_lo: jnp.ndarray,
-    box_hi: jnp.ndarray,
-    hcap: int,
-    axis: str,
-):
-    """Collect every remote point inside this device's expanded box.
-
-    Single-partition-per-device convenience wrapper around
-    :func:`ring_halo_exchange_multi` (adds/strips the partition axis).
-    """
-    halo, hmask, hgid, overflow = ring_halo_exchange_multi(
-        owned[None], mask[None], gid[None],
-        box_lo[None], box_hi[None], hcap, axis,
-    )
-    return halo[0], hmask[0], hgid[0], overflow[0]
-
-
 def ring_halo_exchange_multi(
     owned: jnp.ndarray,
     mask: jnp.ndarray,
@@ -124,10 +103,12 @@ def ring_halo_exchange_multi(
             overflow + jnp.stack([o[3] for o in out]),
         )
 
-    # Local round: other partitions on this device.
-    halo, hmask, hgid, overflow = filter_into(
-        halo, hmask, hgid, overflow, flat_pts, flat_msk, flat_gid, True
-    )
+    # Local round: other partitions on this device.  At L == 1 the
+    # own-partition exclusion empties it — skip the wasted filter pass.
+    if L > 1:
+        halo, hmask, hgid, overflow = filter_into(
+            halo, hmask, hgid, overflow, flat_pts, flat_msk, flat_gid, True
+        )
 
     perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
 

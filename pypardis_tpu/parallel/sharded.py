@@ -119,7 +119,7 @@ def build_owned_shards(points, partitioner, eps, n_shards, block):
     """Ring-mode layout: owned slabs + expanded boxes, NO host halos.
 
     The halo sets are never materialized on the host — sizing and
-    duplication happen device-side (halo.ring_halo_exchange).
+    duplication happen device-side (halo.ring_halo_exchange_multi).
     """
     pts32, exp_lo, exp_hi, labels = _expanded_frame(points, partitioner, eps)
     _, arrays, cap, p_total = _owned_layout(
@@ -353,7 +353,7 @@ def _device_cluster_merge(
 
     ``o``: (L, cap, k) — this device's partitions; halo slabs ``h`` may
     come from the host layout (build_shards) or a device-side ring
-    exchange (halo.ring_halo_exchange).  Returns ``(labels, core,
+    exchange (halo.ring_halo_exchange_multi).  Returns ``(labels, core,
     pair_stats)`` — the worst-case (max-total) Pallas pair stats over
     this device's partitions.
     """
@@ -628,7 +628,7 @@ def sharded_dbscan(
 
     sharding = NamedSharding(mesh, P(axis))
     if halo == "ring":
-        arrays, exp_lo, exp_hi, labels_sorted, stats = build_owned_shards(
+        arrays, exp_lo, exp_hi, _labels_sorted, stats = build_owned_shards(
             points, partitioner, eps, n_shards, block
         )
         args = tuple(

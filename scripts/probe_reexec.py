@@ -1,0 +1,48 @@
+"""Probe: does a given dbscan_fixed_size config survive re-execution?
+
+The tunneled chip poisons its worker when the bug hits, so each config
+must run in a fresh process: `python scripts/probe_reexec.py block layout
+cap n [min_samples] [eps]`.  Prints `RESULT <ok|FAIL> <ok|FAIL> ...`.
+"""
+
+import sys
+
+import numpy as np
+import jax.numpy as jnp
+
+from pypardis_tpu.ops.labels import dbscan_fixed_size
+from pypardis_tpu.partition import spatial_order
+
+block = int(sys.argv[1])
+layout = sys.argv[2]
+cap = int(sys.argv[3])
+n = int(sys.argv[4])
+min_samples = int(sys.argv[5]) if len(sys.argv) > 5 else 10
+eps = float(sys.argv[6]) if len(sys.argv) > 6 else 2.4
+
+rng = np.random.default_rng(0)
+centers = rng.uniform(-10, 10, size=(32, 16))
+pts = (
+    centers[rng.integers(0, 32, size=n)]
+    + rng.normal(scale=0.4, size=(n, 16))
+).astype(np.float32)
+pts = pts[spatial_order(pts)]
+pt = np.zeros((cap, 16), np.float32)
+pt[:n] = pts - pts.mean(0)
+mask = np.zeros(cap, bool)
+mask[:n] = True
+
+x = jnp.asarray(pt.T) if layout == "dn" else jnp.asarray(pt)
+mask = jnp.asarray(mask)
+results = []
+for i in range(3):
+    try:
+        r, c, st = dbscan_fixed_size(
+            x, eps, min_samples, mask, block=block, layout=layout,
+            backend="pallas",
+        )
+        np.asarray(r[:1])
+        results.append("ok")
+    except Exception as e:  # noqa: BLE001
+        results.append("FAIL")
+print("RESULT", *results, flush=True)
