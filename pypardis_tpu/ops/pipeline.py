@@ -151,6 +151,32 @@ def _segment_break_layout(xs, mask, perm, eps, block: int, bt: int):
     return ys, mask2, owner
 
 
+@functools.partial(jax.jit, static_argnames=("cap",))
+def device_prep(points, *, cap):
+    """Center / transpose / pad an (n, d) device-resident array to the
+    (d, cap) float32 pipeline layout, entirely on device.
+
+    The host path computes the centering mean in float64; here it is
+    float32 — harmless, because centering by ANY constant vector
+    preserves pairwise distances exactly, and an f32 mean is within
+    ~1e-7 relative of the true mean, so the centered coordinates stay
+    small (the only property the matmul distance expansion needs).
+    Device-resident input is the TPU analogue of the reference's
+    already-distributed RDD (``/root/reference/dbscan/dbscan.py:104``):
+    data produced by an upstream device pipeline never round-trips
+    through the host.
+    """
+    n, d = points.shape
+    # Center in the INPUT dtype, cast after: under enable_x64 a float64
+    # device array keeps its precision through the subtraction, so
+    # GPS-scale magnitudes (~1e6) don't quantize at f32 before the
+    # mean comes off (the same guarantee the host path's f64 mean
+    # provides).
+    mean = jnp.mean(points, axis=0)
+    xt = (points - mean).astype(jnp.float32).T
+    return jnp.pad(xt, ((0, 0), (0, cap - n)))
+
+
 @jax.jit
 def _layout_words(points_t, n):
     """Layout program 1: per-point Morton words (masked-last)."""
@@ -249,21 +275,37 @@ def _pipeline_pack(roots_s, core_s, pair_stats, owner, *, cap):
     Kernel-space root indices -> original point ids, then scatter rows
     back to input order.  ``owner`` sends pad slots to the dump row
     ``cap`` of a (cap+1,)-sized scatter target.
+
+    Output is ONE (cap + 2,) int32 row — ``(root + 1) | core << 30``
+    per point plus the two pair stats — rather than separate root/core
+    rows: the device->host result transfer runs at single-digit MB/s on
+    degraded tunnel sessions, so halving its bytes is wall-clock that
+    matters.  Roots are < cap <= 2^30 (checked at trace time), so bit
+    30 is free.  Decode: ``root = (v & 0x3FFFFFFF) - 1``,
+    ``core = v >> 30``.
     """
+    if cap >= 1 << 30:
+        raise ValueError(f"cap {cap} overflows the packed-label encoding")
     capk = roots_s.shape[0]
     valid = roots_s >= 0
     tgt = jnp.clip(roots_s, 0, capk - 1)
     roots_g = jnp.where(valid, owner[tgt], -1)
+    packed = (roots_g + 1) | (core_s.astype(jnp.int32) << 30)
     safe_owner = jnp.clip(owner, 0, cap)
-    roots = jnp.zeros(cap + 1, jnp.int32).at[safe_owner].set(roots_g)[:cap]
-    core = (
-        jnp.zeros(cap + 1, jnp.int32)
-        .at[safe_owner]
-        .set(core_s.astype(jnp.int32))[:cap]
-    )
-    return jnp.concatenate(
-        [jnp.stack([roots, core]), pair_stats[:, None]], axis=1
-    )
+    out = jnp.zeros(cap + 1, jnp.int32).at[safe_owner].set(packed)[:cap]
+    return jnp.concatenate([out, pair_stats])
+
+
+def unpack_pipeline_result(packed):
+    """Host-side decode of :func:`_pipeline_pack`'s single int32 row.
+
+    Returns ``(roots, core, total, budget)`` — roots in input order
+    (-1 noise), core as bool, plus the live tile-pair stats.
+    """
+    body = packed[:-2]
+    roots = (body & 0x3FFFFFFF) - 1
+    core = (body >> 30) > 0
+    return roots, core, int(packed[-2]), int(packed[-1])
 
 
 @functools.partial(
@@ -397,9 +439,9 @@ def _cluster_stepped(
         )
     return _transient_retry(
         "pack",
-        lambda: _pipeline_finish_pack(
+        lambda: np.array(_pipeline_finish_pack(
             f, g, core, mask_k, pair_stats, owner, cap=cap
-        ),
+        )),
     )
 
 
@@ -416,11 +458,14 @@ def dbscan_device_pipeline(
     pair_budget: int | None = None,
 ):
     """points_t: (d, cap) float32, centered, zero-padded past ``n``
-    (traced).  Returns (2, cap + 1) int32: row 0 = cluster root index
-    per point (input order, -1 noise), row 1 = core flags; the extra
-    final column is ``[live_pairs_total, budget]`` from the Pallas
-    tile-pair extraction (rides in-band so the driver gets results and
-    overflow status in ONE device->host transfer; zeros on XLA).
+    (traced).  Returns a host (cap + 2,) int32 array: per point the
+    packed ``(root + 1) | core << 30`` value (input order; decode via
+    :func:`unpack_pipeline_result`), then ``[live_pairs_total,
+    budget]`` from the Pallas tile-pair extraction (rides in-band so
+    the driver gets results and overflow status in ONE device->host
+    transfer; zeros on XLA).  Materialized on host here so the bulk
+    transfer doubles as the execution-fault sync inside the retry
+    scope.
 
     Two separately-jitted stages rather than one fused program: the
     fused compile at ~50M-point capacities crashed the axon compile
@@ -472,9 +517,11 @@ def dbscan_device_pipeline(
             cap=cap, min_samples=min_samples, metric=metric, block=block,
             precision=precision, backend=backend, pair_budget=pair_budget,
         )
-        # Surface async execution faults inside the retry scope (the
-        # caller's bulk transfer would otherwise eat them).
-        np.asarray(out[:1, :1])
-        return out
+        # The bulk transfer IS the sync: execution faults surface here,
+        # inside the retry scope, and the steady-state fit pays exactly
+        # one device->host round trip (a separate 1-element probe fetch
+        # costs a full tunnel round trip — ~0.2s at best, seconds under
+        # load — per fit).
+        return np.array(out)
 
     return _transient_retry("cluster", run_cluster)
