@@ -147,7 +147,9 @@ def _pad_and_run(
         n, k = points.shape
         block = clamp_block(block, n)
         cap = round_up(n, block)
-        dev = device_prep(points, cap=cap)
+
+        def make_dev():
+            return device_prep(points, cap=cap)
     else:
         points = _as_float(points)
         n, k = points.shape
@@ -176,16 +178,22 @@ def _pad_and_run(
                 points[s:e].T, center[:, None], out=pts_t[:, s:e],
                 casting="unsafe",
             )
-        dev = jnp.asarray(pts_t)
+        def make_dev():
+            # Re-put from the staging buffer: the first transfer is the
+            # real cost; repeats from the same pinned buffer are ~8ms.
+            return jnp.asarray(pts_t)
 
     def run(be, pair_budget=None):
         # Transient-fault retries live INSIDE dbscan_device_pipeline
         # (per stage); wrapping again here would multiply the retry
         # count and sleep time on genuine errors.  The pipeline already
         # returns a host array (its bulk fetch is the execution sync).
+        # A fresh device copy per attempt: the layout gather DONATES
+        # its input (the difference between fitting and OOM at high
+        # dimension), so the previous attempt's copy is consumed.
         return np.asarray(
             dbscan_device_pipeline(
-                dev,
+                make_dev(),
                 eps,
                 n,
                 min_samples=min_samples,
@@ -198,8 +206,36 @@ def _pad_and_run(
             )
         )
 
+    def run_with_restage(be, pair_budget=None):
+        # The layout gather donates its input, so an in-pipeline retry
+        # (or the overflow rerun) can observe the device copy as
+        # deleted; re-staging from source recovers.  Backed-off
+        # attempts also cover make_dev() itself failing UNAVAILABLE
+        # while a crashed worker restarts — without them donation
+        # would collapse the pipeline's own 0/10/75s retry ladder
+        # into near-instant failures.
+        last = None
+        for wait in (0, 10, 75):
+            if wait:
+                get_logger().warning(
+                    "re-staging device input and retrying in %ds: %s",
+                    wait, str(last)[:160],
+                )
+                time.sleep(wait)
+            try:
+                return run(be, pair_budget)
+            except RuntimeError as e:
+                if "deleted" not in str(e):
+                    raise
+                last = e
+            except Exception as e:  # noqa: BLE001 — transient only
+                if "UNAVAILABLE" not in f"{type(e).__name__}: {e}":
+                    raise
+                last = e
+        raise last
+
     try:
-        packed = run(backend)
+        packed = run_with_restage(backend)
         total, budget = int(packed[-2]), int(packed[-1])
         if total > budget:
             # The live tile-pair list overflowed its static budget
@@ -209,7 +245,9 @@ def _pad_and_run(
                 "live tile-pair budget overflow (%d > %d); rerunning "
                 "with an exact budget", total, budget,
             )
-            packed = run(backend, pair_budget=round_up(total, 4096))
+            packed = run_with_restage(
+                backend, pair_budget=round_up(total, 4096)
+            )
     except Exception as e:  # noqa: BLE001 — rethrown unless a kernel fails
         from .ops.labels import is_kernel_lowering_error
 
@@ -224,7 +262,7 @@ def _pad_and_run(
             "Pallas kernel failed to lower on %s; falling back to the "
             "XLA kernel path (%s)", jax_backend_name(), e,
         )
-        packed = run("xla")
+        packed = run_with_restage("xla")
     if staged is not None:
         # The pipeline's host fetch has completed, so the input
         # transfer is long since consumed — safe to recycle the buffer.

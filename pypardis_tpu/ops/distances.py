@@ -124,6 +124,8 @@ def _tile_adjacency_t(xi, yj, eps, metric, precision):
 
 def _tiles_t(points, mask, block, layout):
     """Normalize to transposed tiles: (nt, d, block) + (nt, block) mask."""
+    if layout not in ("nd", "dn"):
+        raise ValueError(f"layout must be 'nd' or 'dn', got {layout!r}")
     if layout == "nd":
         n, d = points.shape
         assert n % block == 0, (n, block)
@@ -296,7 +298,10 @@ def live_tile_pairs(
     # expansion stays G^2 * budget_g entries.  Overflow folds into the
     # returned total (the same caller retry covers both levels).
     budget_g = min(max(budget // 2, 4096), ng * ng)
-    chunk_g = max(1, min(ng, -(-(1 << 22) // max(ng, 1))))
+    # Chunk so the (chunk, ng, d) gap tensor stays ~256MB — the d
+    # factor matters: at 512-D an un-scaled chunk materialized 8.6GB
+    # and OOM'd the chip.  (At d=16 this reduces to the old 1<<22/ng.)
+    chunk_g = max(1, min(ng, -(-(1 << 26) // max(ng * d, 1))))
     nc_g = -(-ng // chunk_g)
     # Row-side group boxes padded to whole chunks with inverted boxes:
     # dynamic_slice CLAMPS an out-of-range start, which would misalign
@@ -332,7 +337,7 @@ def live_tile_pairs(
     thi_rg = thi_r.reshape(ng + 1, G, d)
     tlo_cg = tlo_c.reshape(ng + 1, G, d)
     thi_cg = thi_c.reshape(ng + 1, G, d)
-    chunk_p = max(1, (1 << 22) // (G * G))
+    chunk_p = max(1, (1 << 26) // (G * G * d))
     nc_p = -(-budget_g // chunk_p)
     pad_p = nc_p * chunk_p - budget_g
     rows_gp = jnp.concatenate([rows_g, jnp.full(pad_p, ng, jnp.int32)])

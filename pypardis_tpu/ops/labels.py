@@ -149,6 +149,8 @@ def dbscan_fixed_size(
       sklearn's ``core_sample_indices_`` that the reference reads at
       dbscan.py:30.
     """
+    if layout not in ("nd", "dn"):
+        raise ValueError(f"layout must be 'nd' or 'dn', got {layout!r}")
     n = points.shape[0] if layout == "nd" else points.shape[1]
     pair_stats = jnp.zeros(2, jnp.int32)
     if resolve_backend(backend, metric, n, block) == "pallas":

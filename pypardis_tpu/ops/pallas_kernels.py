@@ -244,6 +244,8 @@ def _pallas_block(block: int, n: int, d: int, mode: str = "high") -> int:
 
 
 def _shape_nd(points, layout):
+    if layout not in ("nd", "dn"):
+        raise ValueError(f"layout must be 'nd' or 'dn', got {layout!r}")
     if layout == "nd":
         return points.shape
     d, n = points.shape

@@ -193,16 +193,22 @@ def _layout_perm(words):
     return jnp.lexsort(tuple(words[::-1])).astype(jnp.int32)
 
 
-@jax.jit
+@functools.partial(jax.jit, donate_argnums=(0,))
 def _layout_gather(points_t, perm, n):
     """Layout program 3: gather points into sorted order.
 
     Invalid points carry all-ones codes and sort last, so the
-    ``arange(cap) < n`` mask is permutation-invariant.
+    ``arange(cap) < n`` mask is permutation-invariant.  ``points_t`` is
+    DONATED: the sorted copy reuses its HBM, which is the difference
+    between fitting and OOM at e.g. 1M x 512-D (2GB per full-dataset
+    copy).  Callers needing the original after a fault re-stage it
+    (dbscan.py's rerun path).
     """
     return jnp.take(points_t, perm, axis=1), jnp.arange(points_t.shape[1]) < n
 
 
+# No donation here: every output is cap2-sized (> cap), so the input
+# can never alias — donating would only delete xs and emit warnings.
 _segment_break_jit = jax.jit(
     _segment_break_layout, static_argnames=("block", "bt")
 )
@@ -252,7 +258,12 @@ def _pipeline_layout(points_t, eps, n, block: int, sort: bool,
         block, cap, d, _norm_precision_mode(precision)
     )
     bt = max(64, cap // align)
-    if cap >= 16 * block:
+    # High-D gate: past ~64 dims Morton boxes barely prune (the code
+    # covers only the top-32-variance axes and box volumes concentrate),
+    # so the break layout's up-to-2x capacity pad buys nothing and its
+    # extra full-dataset copy OOMs HBM at e.g. 1M x 512-D (2GB input,
+    # ~14GB of staged copies measured before the fix).
+    if cap >= 16 * block and d <= 64:
         return _segment_break_jit(xs, mask, perm, eps, block=align, bt=bt)
     return xs, mask, perm
 
@@ -338,15 +349,16 @@ def _pipeline_cluster(
 # Kernel capacities past this run the host-stepped propagation loop
 # (one device call per round, labels.py's stepped section) instead of
 # the fused while_loop.  Stepping exists for deployments whose worker
-# watchdog kills any single execution running minutes (e.g. ~25M
-# low-dim points, where each round is seconds and convergence takes
-# many rounds).  Default OFF: on the current tunneled chip, large
-# Pallas programs sporadically fail RE-execution with INVALID_ARGUMENT
-# (environment nondeterminism, reproduced both ways with identical
-# code), and the fused path — one execution per fit — sidesteps it.
-# Opt in via PYPARDIS_STEP_THRESHOLD=<points>.
+# watchdog kills any single execution running minutes: a fused 25M
+# x 2-D fit (kernel capacity ~50M after break padding) reproducibly
+# crashed the tunneled worker mid-execution, while the stepped run —
+# each round seconds long — completed at 287k pts/sec/chip.  A fused
+# 10M x 16-D fit (capacity ~23M) runs 30s and is fine, so the default
+# threshold sits between the two observed points; override via
+# PYPARDIS_STEP_THRESHOLD=<points> (stepping trades one fused
+# execution for per-round dispatch latency, so small fits stay fused).
 STEP_THRESHOLD = int(
-    __import__("os").environ.get("PYPARDIS_STEP_THRESHOLD", 1 << 62)
+    __import__("os").environ.get("PYPARDIS_STEP_THRESHOLD", 1 << 25)
 )
 MAX_ROUNDS = 64
 

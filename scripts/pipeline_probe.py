@@ -34,11 +34,14 @@ def main():
     dev = jnp.asarray(pts_t)
     jax.block_until_ready(dev)
     t_upload = time.perf_counter() - t0
+    del dev
 
     def run():
+        # Fresh device copy per call: the pipeline's layout gather
+        # donates (and so deletes) its input.
         return dbscan_device_pipeline(
-            dev, eps, n, min_samples=10, metric="euclidean", block=block,
-            precision="high", backend="auto", sort=True,
+            jnp.asarray(pts_t), eps, n, min_samples=10, metric="euclidean",
+            block=block, precision="high", backend="auto", sort=True,
         )
 
     run()  # warm-up (compiles)

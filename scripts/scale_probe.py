@@ -1,14 +1,23 @@
-"""Scale probe: constant-density blobs at increasing N on one chip."""
+"""Scale probe: constant-density blobs at increasing N on one chip.
+
+Prints one JSON line per run with both timings the driver cares about:
+``device_pps`` (fit on device-resident data — the engine rate) and
+``host_pps`` (end-to-end from host numpy, including the tunnel
+transfer).  Collected into BENCH_SCALE_r*.json artifacts.
+"""
+import json
 import sys
 import time
 
 import numpy as np
 
 
-def make_data(n, dim, pts_per_center=6250, seed=0):
+def make_data(n, dim, pts_per_center=6250, seed=0, spread=10.0):
     rng = np.random.default_rng(seed)
     n_centers = max(32, n // pts_per_center)
-    centers = rng.uniform(-10, 10, size=(n_centers, dim)).astype(np.float32)
+    centers = rng.uniform(
+        -spread, spread, size=(n_centers, dim)
+    ).astype(np.float32)
     assign = rng.integers(0, n_centers, size=n)
     out = centers[assign]
     del assign
@@ -23,22 +32,47 @@ def main():
     n = int(sys.argv[1])
     dim = int(sys.argv[2]) if len(sys.argv) > 2 else 16
     eps = float(sys.argv[3]) if len(sys.argv) > 3 else 2.4
-    X = make_data(n, dim)
+    spread = float(sys.argv[4]) if len(sys.argv) > 4 else 10.0
+    X = make_data(n, dim, spread=spread)
+
+    import jax
+
     from pypardis_tpu import DBSCAN
 
-    def run():
-        return DBSCAN(eps=eps, min_samples=10, block=2048).fit_predict(X)
+    def run(data):
+        return DBSCAN(eps=eps, min_samples=10, block=2048).fit_predict(data)
 
     t0 = time.perf_counter()
-    labels = run()
+    labels = run(X)
     tc = time.perf_counter() - t0
     t0 = time.perf_counter()
-    labels = run()
-    dt = time.perf_counter() - t0
+    labels = run(X)
+    host_dt = time.perf_counter() - t0
+
+    Xd = jax.device_put(X)
+    run(Xd)  # device-path warm-up (layout programs for this shape)
+    dev_dt = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        labels = run(Xd)
+        dev_dt = min(dev_dt, time.perf_counter() - t0)
+
     print(
-        f"n={n} d={dim} compile+run={tc:.2f}s steady={dt:.2f}s "
-        f"pps={n / dt:.0f} clusters={labels.max() + 1} "
-        f"noise={(labels == -1).sum()}"
+        json.dumps(
+            {
+                "n": n,
+                "dim": dim,
+                "eps": eps,
+                "compile_plus_run_s": round(tc, 2),
+                "host_e2e_s": round(host_dt, 2),
+                "host_pps": round(n / host_dt),
+                "device_s": round(dev_dt, 2),
+                "device_pps": round(n / dev_dt),
+                "clusters": int(labels.max() + 1),
+                "noise": int((labels == -1).sum()),
+            }
+        ),
+        flush=True,
     )
 
 

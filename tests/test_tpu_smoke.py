@@ -148,3 +148,20 @@ def test_default_backend_driver_matches_sklearn():
     got = DBSCAN(eps=1.5, min_samples=10, block=2048).fit_predict(X)
     want = SKDBSCAN(eps=1.5, min_samples=10).fit_predict(X)
     assert adjusted_rand_score(got, want) >= 0.99
+
+
+def test_stepped_propagation_path(monkeypatch):
+    """The host-stepped propagation loop (auto-selected past
+    STEP_THRESHOLD to keep single executions under deployment
+    watchdogs) must match sklearn like the fused path does."""
+    from sklearn.cluster import DBSCAN as SKDBSCAN
+    from sklearn.metrics import adjusted_rand_score
+
+    from pypardis_tpu import DBSCAN
+    from pypardis_tpu.ops import pipeline
+
+    monkeypatch.setattr(pipeline, "STEP_THRESHOLD", 1)
+    X = _blob_points(30_000, 16, seed=4)
+    got = DBSCAN(eps=1.5, min_samples=10, block=2048).fit_predict(X)
+    want = SKDBSCAN(eps=1.5, min_samples=10).fit_predict(X)
+    assert adjusted_rand_score(got, want) >= 0.99
