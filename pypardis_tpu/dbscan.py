@@ -116,6 +116,11 @@ def _staging_return(buf: np.ndarray) -> None:
     _staging[buf.shape] = buf
 
 
+# Last sufficient live tile-pair budget per (shape, block, precision):
+# seeds later fits so dense datasets don't re-pay the overflow rerun.
+_pair_budget_hint: dict = {}
+
+
 def _pad_and_run(
     points, eps, min_samples, metric, block, precision="high", sort=True,
     backend="auto",
@@ -206,36 +211,36 @@ def _pad_and_run(
             )
         )
 
-    def run_with_restage(be, pair_budget=None):
-        # The layout gather donates its input, so an in-pipeline retry
-        # (or the overflow rerun) can observe the device copy as
-        # deleted; re-staging from source recovers.  Backed-off
-        # attempts also cover make_dev() itself failing UNAVAILABLE
-        # while a crashed worker restarts — without them donation
-        # would collapse the pipeline's own 0/10/75s retry ladder
-        # into near-instant failures.
-        last = None
-        for wait in (0, 10, 75):
-            if wait:
-                get_logger().warning(
-                    "re-staging device input and retrying in %ds: %s",
-                    wait, str(last)[:160],
-                )
-                time.sleep(wait)
-            try:
-                return run(be, pair_budget)
-            except RuntimeError as e:
-                if "deleted" not in str(e):
-                    raise
-                last = e
-            except Exception as e:  # noqa: BLE001 — transient only
-                if "UNAVAILABLE" not in f"{type(e).__name__}: {e}":
-                    raise
-                last = e
-        raise last
+    def _restageable(e: BaseException) -> bool:
+        # A retry can observe the donated device copy as deleted
+        # (re-staging from source recovers), and make_dev() itself can
+        # fail UNAVAILABLE while a crashed worker restarts.  Both are
+        # worth the backed-off ladder; everything else re-raises.
+        return "deleted" in str(e) or "UNAVAILABLE" in (
+            f"{type(e).__name__}: {e}"
+        )
 
+    def run_with_restage(be, pair_budget=None):
+        # The layout gather donates its input, so each attempt
+        # re-stages a fresh device copy; the retry ladder is the shared
+        # one from the pipeline (ops/pipeline._transient_retry).
+        from .ops.pipeline import _transient_retry
+
+        return _transient_retry(
+            "restage", lambda: run(be, pair_budget), retryable=_restageable
+        )
+
+    # Start from the last budget that sufficed for this shape+query:
+    # data whose density defeats the default budget would otherwise pay
+    # the double extract-overflow-rerun (and its recompile) on EVERY
+    # fit — observed at 30M x 16-D.  eps/metric are part of the key:
+    # the live-pair count depends on them directly, and alternating
+    # queries on one shape must not thrash each other's hints.
+    budget_key = ((k, cap), block, precision, float(eps), str(metric))
     try:
-        packed = run_with_restage(backend)
+        packed = run_with_restage(
+            backend, pair_budget=_pair_budget_hint.get(budget_key)
+        )
         total, budget = int(packed[-2]), int(packed[-1])
         if total > budget:
             # The live tile-pair list overflowed its static budget
@@ -248,6 +253,12 @@ def _pad_and_run(
             packed = run_with_restage(
                 backend, pair_budget=round_up(total, 4096)
             )
+            # Re-read: the first run's total can be the saturated
+            # group-overflow BOUND, not the true count — hint from the
+            # rerun's exact figure.
+            total = int(packed[-2])
+        if total > 0:
+            _pair_budget_hint[budget_key] = round_up(total, 4096)
     except Exception as e:  # noqa: BLE001 — rethrown unless a kernel fails
         from .ops.labels import is_kernel_lowering_error
 

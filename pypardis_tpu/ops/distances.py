@@ -292,12 +292,16 @@ def live_tile_pairs(
         return jnp.sum(gap * gap, axis=-1) <= eps2
 
     # Level 1: live group pairs.  Looser group boxes can pair where no
-    # tile pair is live, so the group list needs its own headroom (at
-    # 10M x 16-D: 192k live group pairs vs 120k live tile pairs);
-    # budget/2 keeps it comfortably above the tile count while the
-    # expansion stays G^2 * budget_g entries.  Overflow folds into the
-    # returned total (the same caller retry covers both levels).
-    budget_g = min(max(budget // 2, 4096), ng * ng)
+    # tile pair is live, so the group list needs headroom ABOVE the
+    # tile budget (at 10M x 16-D: 192k live group pairs vs 120k live
+    # tile pairs) — 2x covers the observed ratio with margin.  An
+    # earlier budget//2 sizing inverted this: at 30M x 16-D the 1.66M
+    # true group pairs overflowed the 1.4M group budget, inflating the
+    # returned total to the saturated g_need bound (26.6M vs 1.7M true
+    # tile pairs) and sending every fit through a 10x-oversized retry.
+    # Memory is two budget_g int32 rows — negligible.  A genuine
+    # overflow still folds into the returned total (same caller retry).
+    budget_g = min(max(2 * budget, 8192), ng * ng)
     # Chunk so the (chunk, ng, d) gap tensor stays ~256MB — the d
     # factor matters: at 512-D an un-scaled chunk materialized 8.6GB
     # and OOM'd the chip.  (At d=16 this reduces to the old 1<<22/ng.)

@@ -363,7 +363,16 @@ STEP_THRESHOLD = int(
 MAX_ROUNDS = 64
 
 
-def _transient_retry(stage, fn):
+def _default_transient(e: BaseException) -> bool:
+    msg = f"{type(e).__name__}: {e}"
+    return any(
+        s in msg
+        for s in ("UNAVAILABLE", "INTERNAL", "INVALID_ARGUMENT",
+                  "InvalidArgument")
+    )
+
+
+def _transient_retry(stage, fn, retryable=_default_transient):
     """Retry a device call through transient axon-runtime faults.
 
     The tunneled single-chip deployment sporadically fails a large
@@ -371,6 +380,8 @@ def _transient_retry(stage, fn):
     identical call succeeds moments later), and a crashed worker
     surfaces as UNAVAILABLE until it restarts.  Pure environment
     nondeterminism — the retried call computes the same pure function.
+    ``retryable`` classifies which exceptions are worth the 0/10/75s
+    ladder; everything else re-raises immediately.
     """
     import time as _time
 
@@ -387,12 +398,7 @@ def _transient_retry(stage, fn):
         try:
             return fn()
         except Exception as e:  # noqa: BLE001 — re-raised unless transient
-            msg = f"{type(e).__name__}: {e}"
-            if not any(
-                s in msg
-                for s in ("UNAVAILABLE", "INTERNAL", "INVALID_ARGUMENT",
-                          "InvalidArgument")
-            ):
+            if not retryable(e):
                 raise
             last = e
     raise last
