@@ -116,9 +116,8 @@ def _staging_return(buf: np.ndarray) -> None:
     _staging[buf.shape] = buf
 
 
-# Last sufficient live tile-pair budget per (shape, block, precision):
-# seeds later fits so dense datasets don't re-pay the overflow rerun.
-_pair_budget_hint: dict = {}
+# Pair-budget hints live in the shared LRU cache (utils.hints); both
+# drivers consult and seed it through utils.budget.run_ladders.
 
 
 def _pad_and_run(
@@ -186,7 +185,15 @@ def _pad_and_run(
         def make_dev():
             # Re-put from the staging buffer: the first transfer is the
             # real cost; repeats from the same pinned buffer are ~8ms.
-            return jnp.asarray(pts_t)
+            # Off-TPU the "transfer" may be a zero-copy view over the
+            # numpy memory — which _layout_gather then DONATES, so the
+            # next same-shape fit would mutate freed/aliased storage.
+            # An explicit copy keeps the reuse correct everywhere; the
+            # pin/dedupe win only exists on the tunneled TPU runtime
+            # anyway.
+            if jax_backend_name() == "tpu":
+                return jnp.asarray(pts_t)
+            return jnp.array(pts_t, copy=True)
 
     def run(be, pair_budget=None):
         # Transient-fault retries live INSIDE dbscan_device_pipeline
@@ -230,35 +237,30 @@ def _pad_and_run(
             "restage", lambda: run(be, pair_budget), retryable=_restageable
         )
 
-    # Start from the last budget that sufficed for this shape+query:
-    # data whose density defeats the default budget would otherwise pay
-    # the double extract-overflow-rerun (and its recompile) on EVERY
-    # fit — observed at 30M x 16-D.  eps/metric are part of the key:
-    # the live-pair count depends on them directly, and alternating
-    # queries on one shape must not thrash each other's hints.
-    budget_key = ((k, cap), block, precision, float(eps), str(metric))
+    # The shared ladder (utils.budget.run_ladders) consults and seeds
+    # the hint cache: data whose density defeats the default budget
+    # would otherwise pay the double extract-overflow-rerun (and its
+    # recompile) on EVERY fit — observed at 30M x 16-D.  eps/metric
+    # are part of the key (the live-pair count depends on them
+    # directly); the metric is normalized so callable specs share
+    # hints with their string spellings.
+    from .ops.distances import _norm_metric
+    from .utils.budget import run_ladders
+
+    budget_key = (
+        (k, cap), block, precision, float(eps), _norm_metric(metric)
+    )
+
+    def ladder(be):
+        def run_step(pb, _mr):
+            packed = run_with_restage(be, pair_budget=pb)
+            # In-band stats ride as the packed row's last two entries.
+            return packed, packed[-2:], True
+
+        return run_ladders(run_step, budget_key, None, 1)
+
     try:
-        packed = run_with_restage(
-            backend, pair_budget=_pair_budget_hint.get(budget_key)
-        )
-        total, budget = int(packed[-2]), int(packed[-1])
-        if total > budget:
-            # The live tile-pair list overflowed its static budget
-            # (pairs were dropped -> labels invalid).  The returned
-            # total is exact, so one retry with that capacity wins.
-            get_logger().warning(
-                "live tile-pair budget overflow (%d > %d); rerunning "
-                "with an exact budget", total, budget,
-            )
-            packed = run_with_restage(
-                backend, pair_budget=round_up(total, 4096)
-            )
-            # Re-read: the first run's total can be the saturated
-            # group-overflow BOUND, not the true count — hint from the
-            # rerun's exact figure.
-            total = int(packed[-2])
-        if total > 0:
-            _pair_budget_hint[budget_key] = round_up(total, 4096)
+        packed = ladder(backend)
     except Exception as e:  # noqa: BLE001 — rethrown unless a kernel fails
         from .ops.labels import is_kernel_lowering_error
 
@@ -273,7 +275,7 @@ def _pad_and_run(
             "Pallas kernel failed to lower on %s; falling back to the "
             "XLA kernel path (%s)", jax_backend_name(), e,
         )
-        packed = run_with_restage("xla")
+        packed = ladder("xla")
     if staged is not None:
         # The pipeline's host fetch has completed, so the input
         # transfer is long since consumed — safe to recycle the buffer.

@@ -171,6 +171,56 @@ def tile_skip_mask(lo_i, hi_i, lo, hi, eps, metric):
     return jnp.sum(gap, axis=1) > eps
 
 
+def count_live_tile_pairs(
+    points: jnp.ndarray,
+    mask: jnp.ndarray,
+    eps,
+    metric: str = "euclidean",
+    block: int = 1024,
+    layout: str = "nd",
+) -> jnp.ndarray:
+    """Scalar int32: total (row, col) tile pairs the gap test keeps.
+
+    The XLA-path analogue of the Pallas extraction's true pair total
+    (:func:`live_tile_pairs`): exactly the column-tile visits the tiled
+    passes will compute.  The XLA kernels never drop pairs, so this is
+    purely diagnostic/reporting — it lets the drivers' budget-overflow
+    ladder (and its tests) exercise off-TPU, where Mosaic is absent.
+    Row tiles are processed in CHUNKS (a scan of ~nt/chunk batched gap
+    tests, the live_tile_pairs memory discipline), not one sequential
+    dispatch per row — per-row lax.map at nt~10k would re-create the
+    serialized-scan overhead the extraction was restructured to avoid.
+    """
+    metric = _norm_metric(metric)
+    layout = _norm_layout(layout)
+    nt, pts, msk = _tiles_t(points, mask, block, layout)
+    d = pts.shape[1]
+    lo, hi = tile_bounds(pts, msk)
+    # (chunk, nt, d) gap tensor bounded ~256MB, like live_tile_pairs.
+    chunk = max(1, min(nt, -(-(1 << 26) // max(nt * d, 1))))
+    nc = -(-nt // chunk)
+    # Padding rows carry inverted boxes: their gap to anything is
+    # astronomically positive, so they never count as live.
+    lo_p, hi_p = _pad_boxes(lo, hi, nc * chunk)
+
+    def body(acc, c):
+        s = c * chunk
+        rlo = jax.lax.dynamic_slice_in_dim(lo_p, s, chunk)
+        rhi = jax.lax.dynamic_slice_in_dim(hi_p, s, chunk)
+        gap = jnp.maximum(
+            0.0,
+            jnp.maximum(lo[None] - rhi[:, None], rlo[:, None] - hi[None]),
+        )
+        if metric == "euclidean":
+            live = jnp.sum(gap * gap, axis=-1) <= jnp.float32(eps) ** 2
+        else:
+            live = jnp.sum(gap, axis=-1) <= eps
+        return acc + jnp.sum(live.astype(jnp.int32)), None
+
+    total, _ = jax.lax.scan(body, jnp.int32(0), jnp.arange(nc))
+    return total
+
+
 def default_pair_budget(nt: int) -> int:
     """Default live-pair capacity: 48 pairs per row tile.
 

@@ -133,12 +133,15 @@ def dbscan_fixed_size(
     ``block``; ``mask``: (N,) bool validity.  Returns ``(labels, core,
     pair_stats)``:
 
-    * ``pair_stats``: (2,) int32 ``[live_pairs_total, budget]`` from
-      the Pallas tile-pair extraction (zeros on the XLA path).  When
-      ``total > budget`` the labels are INVALID — pairs were dropped —
-      and the caller must rerun with ``pair_budget >= total``
-      (``pair_budget`` is static; the returned total is exact, so one
-      retry always suffices).
+    * ``pair_stats``: (2,) int32 ``[live_pairs_total, budget]``.  On
+      the Pallas path, from the tile-pair extraction: when ``total >
+      budget`` the labels are INVALID — pairs were dropped — and the
+      caller must rerun with ``pair_budget >= total`` (``pair_budget``
+      is static; the returned total is exact, so one retry always
+      suffices).  The XLA path reports its true total with budget 0
+      ("cannot overflow") — or the caller's explicit ``pair_budget``,
+      mirroring the overflow contract so the drivers' rerun ladder is
+      exercisable off-TPU (labels stay valid either way).
 
     * ``labels``: (N,) int32 — the *root point index* of the point's
       cluster (min index over the component's core points), or -1 for
@@ -152,7 +155,6 @@ def dbscan_fixed_size(
     if layout not in ("nd", "dn"):
         raise ValueError(f"layout must be 'nd' or 'dn', got {layout!r}")
     n = points.shape[0] if layout == "nd" else points.shape[1]
-    pair_stats = jnp.zeros(2, jnp.int32)
     if resolve_backend(backend, metric, n, block) == "pallas":
         from .pallas_kernels import (
             kernel_pair_list,
@@ -183,6 +185,23 @@ def dbscan_fixed_size(
         minlab_fn = functools.partial(
             min_neighbor_label, metric=metric, block=block, precision=precision,
             layout=layout,
+        )
+        # Real [total, budget] stats on the XLA path too.  budget == 0
+        # when no static budget is in play (the XLA kernels never drop
+        # pairs) — drivers treat 0 as "cannot overflow".  With an
+        # explicit pair_budget the stats mirror the Pallas overflow
+        # contract, which is what lets the drivers' rerun ladder (and
+        # CI, where Mosaic is absent) exercise off-hardware.
+        from .distances import count_live_tile_pairs
+
+        pair_stats = jnp.stack(
+            [
+                count_live_tile_pairs(
+                    points, mask, eps, metric=metric, block=block,
+                    layout=layout,
+                ),
+                jnp.int32(0 if pair_budget is None else pair_budget),
+            ]
         )
     counts = count_fn(points, eps, mask)
     core = (counts >= min_samples) & mask

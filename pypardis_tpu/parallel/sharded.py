@@ -43,6 +43,7 @@ from ..geometry import BoxStack
 from ..ops.labels import dbscan_fixed_size
 from ..partition import spatial_order
 from ..utils import clamp_block, round_up
+from ..utils.budget import run_ladders
 
 _INT_INF = jnp.iinfo(jnp.int32).max
 
@@ -235,9 +236,9 @@ def _cluster_local_partitions(
     labels, core, ps = jax.vmap(
         functools.partial(one_part, be="xla")
     )(pts, msk)
-    # XLA-path stats are zeros; elementwise max keeps the shape and
-    # stays meaningful if totals ever become nonzero (the static
-    # budget is shared, so max(total) is the binding constraint).
+    # Elementwise max over partitions: the static budget is shared, so
+    # max(total) is the binding constraint (XLA-path totals are real
+    # live-pair counts too — ops.distances.count_live_tile_pairs).
     return labels, core, ps.max(axis=0)
 
 
@@ -251,6 +252,16 @@ def _merge_loop(lab_map, home_label, core_g, h_gid, h_lab, h_core, axis,
     Per round: points take the min canonical label over all their
     occurrences (home vectorized + halo scatter-min, pmin across mesh),
     clusters take the min over their member points, then pointer-jump.
+
+    Returns ``(lab_map, rounds, converged)``.  ``converged`` is False
+    when the loop exited at ``max_rounds`` with the last round still
+    changing labels — the result may be UNDER-MERGED (a cluster chain
+    threading more partitions than rounds covered comes back as several
+    clusters) and callers must treat it like the other capacity
+    overflows: retry bigger or raise, never return silently (round-3
+    review, Weak #1).  All quantities here are replicated across the
+    mesh (every update flows through pmin), so the flag is identical on
+    every device and the while_loop steps in lockstep.
     """
     n1 = lab_map.shape[0]
 
@@ -296,43 +307,45 @@ def _merge_loop(lab_map, home_label, core_g, h_gid, h_lab, h_core, axis,
         )
         return new_map, jnp.any(new_map != lab_map), rounds + 1
 
-    lab_map, _, _ = jax.lax.while_loop(
+    lab_map, changed, rounds = jax.lax.while_loop(
         lambda st: st[1] & (st[2] < max_rounds),
         body,
         (lab_map, jnp.bool_(True), 0),
     )
-    return lab_map
+    return lab_map, rounds, ~changed
 
 
 @functools.partial(
     jax.jit,
     static_argnames=(
         "eps", "min_samples", "metric", "block", "mesh", "axis", "n_points",
-        "precision", "backend", "pair_budget",
+        "precision", "backend", "pair_budget", "merge_rounds",
     ),
 )
 def sharded_step(
     owned, owned_mask, owned_gid, halo, halo_mask, halo_gid,
     *, eps, min_samples, metric, block, mesh, axis, n_points,
-    precision="high", backend="auto", pair_budget=None,
+    precision="high", backend="auto", pair_budget=None, merge_rounds=32,
 ):
     """One fully-sharded clustering step: local DBSCAN + global merge.
 
     All inputs have leading (partition) axis sharded over ``mesh``;
-    outputs are replicated (N,) final labels and core flags plus a
-    per-device (1, 2) ``[live_pairs_total, budget]`` from the Pallas
-    pair extraction (see :func:`sharded_dbscan` for the retry).  This
-    is the whole distributed hot path in one compiled program.
+    outputs are replicated (N,) final labels and core flags, a
+    per-device (1, 2) ``[live_pairs_total, budget]`` from the pair
+    extraction, and the merge loop's replicated ``(rounds, converged)``
+    (see :func:`sharded_dbscan` for the retries).  This is the whole
+    distributed hot path in one compiled program.
     """
 
     def per_device(o, om, og, h, hm, hg):
-        final, core_g, pstats = _device_cluster_merge(
+        final, core_g, pstats, rounds, converged = _device_cluster_merge(
             o, om, og, h, hm, hg,
             eps=eps, min_samples=min_samples, metric=metric, block=block,
             precision=precision, backend=backend, axis=axis,
             n_points=n_points, pair_budget=pair_budget,
+            merge_rounds=merge_rounds,
         )
-        return final, core_g, pstats[None]
+        return final, core_g, pstats[None], rounds, converged
 
     spec = P("p", None, None)
     spec2 = P("p", None)
@@ -340,22 +353,23 @@ def sharded_step(
         per_device,
         mesh=mesh,
         in_specs=(spec, spec2, spec2, spec, spec2, spec2),
-        out_specs=(P(), P(), P("p", None)),
+        out_specs=(P(), P(), P("p", None), P(), P()),
         check_vma=False,
     )(owned, owned_mask, owned_gid, halo, halo_mask, halo_gid)
 
 
 def _device_cluster_merge(
     o, om, og, h, hm, hg, *, eps, min_samples, metric, block, precision,
-    backend, axis, n_points, pair_budget=None,
+    backend, axis, n_points, pair_budget=None, merge_rounds=32,
 ):
     """Shared shard_map body: per-partition DBSCAN + in-graph merge.
 
     ``o``: (L, cap, k) — this device's partitions; halo slabs ``h`` may
     come from the host layout (build_shards) or a device-side ring
     exchange (halo.ring_halo_exchange_multi).  Returns ``(labels, core,
-    pair_stats)`` — the worst-case (max-total) Pallas pair stats over
-    this device's partitions.
+    pair_stats, rounds, converged)`` — the worst-case (max-total) pair
+    stats over this device's partitions, plus the merge loop's
+    convergence signal (replicated scalars).
     """
     n1 = n_points + 1
     pts = jnp.concatenate([o, h], axis=1)
@@ -407,9 +421,9 @@ def _device_cluster_merge(
     # only ever reads entries at live label values.
     lab_map = jnp.arange(n1, dtype=jnp.int32)
 
-    lab_map = _merge_loop(
+    lab_map, rounds, converged = _merge_loop(
         lab_map, home_label, core_g, h_gid, h_lab, h_core, axis,
-        max_rounds=32,
+        max_rounds=merge_rounds,
     )
 
     final = jnp.where(
@@ -418,7 +432,7 @@ def _device_cluster_merge(
         -1,
     )
     final = jnp.where(final == _INT_INF, -1, final)
-    return final[:n_points], core_g[:n_points], pair_stats
+    return final[:n_points], core_g[:n_points], pair_stats, rounds, converged
 
 
 @functools.partial(
@@ -484,13 +498,14 @@ def sharded_step_local(
     jax.jit,
     static_argnames=(
         "eps", "min_samples", "metric", "block", "mesh", "axis", "n_points",
-        "precision", "backend", "hcap", "pair_budget",
+        "precision", "backend", "hcap", "pair_budget", "merge_rounds",
     ),
 )
 def sharded_step_ring(
     owned, owned_mask, owned_gid, exp_lo, exp_hi,
     *, eps, min_samples, metric, block, mesh, axis, n_points,
     precision="high", backend="auto", hcap, pair_budget=None,
+    merge_rounds=32,
 ):
     """Sharded clustering with a device-resident ring halo exchange.
 
@@ -499,9 +514,9 @@ def sharded_step_ring(
     every device keeps the points inside its partitions' 2*eps-expanded
     boxes (:mod:`pypardis_tpu.parallel.halo` — any number of partitions
     per device; the round-2 design required exactly one).  Returns
-    ``(labels, core, overflow, pair_stats)`` — ``overflow`` is the
-    per-partition count of in-box points dropped for capacity; nonzero
-    means rerun with a larger ``hcap``.
+    ``(labels, core, overflow, pair_stats, rounds, converged)`` —
+    ``overflow`` is the per-partition count of in-box points dropped
+    for capacity; nonzero means rerun with a larger ``hcap``.
     """
     from .halo import ring_halo_exchange_multi
 
@@ -509,13 +524,14 @@ def sharded_step_ring(
         h, hm, hg, ovf = ring_halo_exchange_multi(
             o, om, og, lo, hi, hcap, axis
         )
-        final, core_g, pstats = _device_cluster_merge(
+        final, core_g, pstats, rounds, converged = _device_cluster_merge(
             o, om, og, h, hm, hg,
             eps=eps, min_samples=min_samples, metric=metric, block=block,
             precision=precision, backend=backend, axis=axis,
             n_points=n_points, pair_budget=pair_budget,
+            merge_rounds=merge_rounds,
         )
-        return final, core_g, ovf, pstats[None]
+        return final, core_g, ovf, pstats[None], rounds, converged
 
     spec = P("p", None, None)
     spec2 = P("p", None)
@@ -523,7 +539,7 @@ def sharded_step_ring(
         per_device,
         mesh=mesh,
         in_specs=(spec, spec2, spec2, spec2, spec2),
-        out_specs=(P(), P(), P("p"), P("p", None)),
+        out_specs=(P(), P(), P("p"), P("p", None), P(), P()),
         check_vma=False,
     )(owned, owned_mask, owned_gid, exp_lo, exp_hi)
 
@@ -561,6 +577,23 @@ def _with_kernel_fallback(fn, backend):
 MERGE_HOST_AUTO = 32_000_000
 
 
+def _sharded_hint_key(owned_shape, halo_cap, block, precision, eps, metric):
+    """Pair-budget hint key for the sharded path (utils.hints cache).
+
+    The binding extraction runs per partition over (cap + hcap) points,
+    so both capacities key the entry; eps/metric shape the live-pair
+    count directly.
+    """
+    return (
+        "sharded", tuple(owned_shape), int(halo_cap), block, precision,
+        float(eps), str(metric),
+    )
+
+
+class _HaloOverflow(Exception):
+    """Ring halo buffer dropped in-box points; the hcap ladder retries."""
+
+
 def sharded_dbscan(
     points,
     partitioner,
@@ -574,6 +607,8 @@ def sharded_dbscan(
     halo: str = "host",
     hcap: Optional[int] = None,
     merge: str = "auto",
+    pair_budget: Optional[int] = None,
+    merge_rounds: int = 32,
 ):
     """Cluster ``points`` over the device mesh.
 
@@ -598,6 +633,14 @@ def sharded_dbscan(
     ``MERGE_HOST_AUTO`` points.  ``merge="host"`` requires
     ``halo="host"`` (the ring exchange never materializes halo tables
     off-device).
+
+    ``pair_budget``: static live tile-pair capacity for the kernels'
+    pair extraction; ``None`` consults the shared hint cache
+    (utils.hints) and otherwise lets the kernel default apply —
+    overflow is detected from the in-band stats and retried once with
+    the exact total (a persisting overflow raises).  ``merge_rounds``
+    caps the in-graph merge loop; non-convergence retries once at 4x
+    and then raises (never returns under-merged labels silently).
     """
     from ..ops.distances import _norm_metric
     from .mesh import default_mesh
@@ -642,27 +685,44 @@ def sharded_dbscan(
             else round_up(max(block, cap // 2), block)
         )
         hcap_attempts = 1 if explicit else 4
-        this_pair = None
-        pair_attempts = 2  # exact-total retry: one is always enough
         while True:
-            labels, core, overflow, pstats = _with_kernel_fallback(
-                lambda be, hc=this_hcap, pb=this_pair: sharded_step_ring(
-                    *args,
-                    eps=float(eps),
-                    min_samples=int(min_samples),
-                    metric=metric,
-                    block=block,
-                    mesh=mesh,
-                    axis=axis,
-                    n_points=len(points),
-                    precision=precision,
-                    backend=be,
-                    hcap=hc,
-                    pair_budget=pb,
-                ),
-                backend,
+            # hcap changes the tile count, so it keys the hint too.
+            hint_key = _sharded_hint_key(
+                arrays[0].shape, this_hcap, block, precision, eps, metric
             )
-            if int(np.asarray(overflow).sum()) != 0:
+
+            def run_step(pb, mr, hc=this_hcap):
+                labels, core, overflow, pstats, m_rounds, converged = (
+                    _with_kernel_fallback(
+                        lambda be: sharded_step_ring(
+                            *args,
+                            eps=float(eps),
+                            min_samples=int(min_samples),
+                            metric=metric,
+                            block=block,
+                            mesh=mesh,
+                            axis=axis,
+                            n_points=len(points),
+                            precision=precision,
+                            backend=be,
+                            hcap=hc,
+                            pair_budget=pb,
+                            merge_rounds=mr,
+                        ),
+                        backend,
+                    )
+                )
+                # Halo capacity is checked FIRST: with dropped in-box
+                # points the pair stats and merge result are moot.
+                if int(np.asarray(overflow).sum()) != 0:
+                    raise _HaloOverflow()
+                return (labels, core, m_rounds), pstats, converged
+
+            try:
+                labels, core, m_rounds = run_ladders(
+                    run_step, hint_key, pair_budget, merge_rounds
+                )
+            except _HaloOverflow:
                 hcap_attempts -= 1
                 if hcap_attempts <= 0:
                     raise RuntimeError(
@@ -671,26 +731,27 @@ def sharded_dbscan(
                         if explicit
                         else f"ring halo buffer overflow persisted up to "
                         f"hcap={this_hcap}"
-                    )
+                    ) from None
                 this_hcap *= 2
                 continue
-            retry_pair = _pair_overflow(pstats)
-            if retry_pair and pair_attempts > 1:
-                pair_attempts -= 1
-                this_pair = retry_pair
-                continue
             break
-        stats = dict(stats, halo_exchange="ring", halo_cap=this_hcap)
+        stats = dict(
+            stats, halo_exchange="ring", halo_cap=this_hcap,
+            merge_rounds=int(m_rounds), merge_converged=True,
+        )
         labels, core = np.asarray(labels), np.asarray(core)
         return _canonicalize_roots(labels, core), core, stats
     arrays, stats = build_shards(points, partitioner, eps, n_shards, block)
     arrays = tuple(jax.device_put(a, sharding) for a in arrays)
+    hint_key = _sharded_hint_key(
+        arrays[0].shape, arrays[3].shape[1], block, precision, eps, metric
+    )
 
     if merge == "host":
         from .merge import merge_occurrences
 
-        def run_local(pair_budget):
-            return _with_kernel_fallback(
+        def run_step(pb, _mr):
+            out = _with_kernel_fallback(
                 lambda be: sharded_step_local(
                     *arrays,
                     eps=float(eps),
@@ -701,15 +762,16 @@ def sharded_dbscan(
                     axis=axis,
                     precision=precision,
                     backend=be,
-                    pair_budget=pair_budget,
+                    pair_budget=pb,
                 ),
                 backend,
             )
+            # The host union-find merge is exact — no rounds ladder.
+            return out[:3], out[3], True
 
-        own_glab, own_core, halo_glab, pstats = run_local(None)
-        retry_pair = _pair_overflow(pstats)
-        if retry_pair:
-            own_glab, own_core, halo_glab, _ = run_local(retry_pair)
+        own_glab, own_core, halo_glab = run_ladders(
+            run_step, hint_key, pair_budget, merge_rounds
+        )
         n = len(points)
         og = arrays[2]  # (P, cap) owned gids; padding slots carry n
         hg = arrays[5]  # (P, hcap) halo gids
@@ -727,8 +789,8 @@ def sharded_dbscan(
         stats = dict(stats, merge="host")
         return _canonicalize_roots(labels, core), core, stats
 
-    def run_host_layout(pair_budget):
-        return _with_kernel_fallback(
+    def run_step(pb, mr):
+        labels, core, pstats, m_rounds, converged = _with_kernel_fallback(
             lambda be: sharded_step(
                 *arrays,
                 eps=float(eps),
@@ -740,38 +802,22 @@ def sharded_dbscan(
                 n_points=len(points),
                 precision=precision,
                 backend=be,
-                pair_budget=pair_budget,
+                pair_budget=pb,
+                merge_rounds=mr,
             ),
             backend,
         )
+        return (labels, core, m_rounds), pstats, converged
 
-    labels, core, pstats = run_host_layout(None)
-    retry_pair = _pair_overflow(pstats)
-    if retry_pair:
-        labels, core, _ = run_host_layout(retry_pair)
+    labels, core, m_rounds = run_ladders(
+        run_step, hint_key, pair_budget, merge_rounds
+    )
+    stats = dict(
+        stats, merge="device", merge_rounds=int(m_rounds),
+        merge_converged=True,
+    )
     labels, core = np.asarray(labels), np.asarray(core)
     return _canonicalize_roots(labels, core), core, stats
-
-
-def _pair_overflow(pstats) -> int:
-    """Exact pair budget to retry with, or 0 when no shard overflowed.
-
-    ``pstats``: (n_dev, 2) per-device ``[live_pairs_total, budget]``
-    from the Pallas pair extraction.  Budgets are shared (static), so
-    the max total is the binding requirement; the total is exact, so
-    one retry always suffices.
-    """
-    ps = np.asarray(pstats)
-    total, budget = int(ps[:, 0].max()), int(ps[:, 1].max())
-    if budget and total > budget:
-        from ..utils.log import get_logger
-
-        get_logger().warning(
-            "live tile-pair budget overflow (%d > %d); rerunning with "
-            "an exact budget", total, budget,
-        )
-        return round_up(total, 4096)
-    return 0
 
 
 def _canonicalize_roots(labels: np.ndarray, core: np.ndarray) -> np.ndarray:

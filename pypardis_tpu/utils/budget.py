@@ -1,0 +1,110 @@
+"""Shared pair-budget / merge-rounds retry driver.
+
+The kernels' live tile-pair extraction runs against a static budget
+(``ops.distances.live_tile_pairs``); overflow is reported in-band as
+``[total, budget]`` stats and the labels built from a truncated pair
+list are INVALID.  Every driver — single-shard (`dbscan._pad_and_run`)
+and all three sharded paths (`parallel.sharded.sharded_dbscan`) — must
+therefore run the same ladder: consult the hint cache, retry once with
+the exact total, raise if overflow persists, and seed the hint only
+after an observed overflow.  One implementation here so the paths
+cannot silently diverge.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .hints import PAIR_BUDGET_HINTS
+from .shaping import round_up
+
+
+def pair_overflow(pstats) -> int:
+    """Exact pair budget to retry with, or 0 when nothing overflowed.
+
+    ``pstats``: (n_runs, 2) per-run ``[live_pairs_total, budget]``.
+    Budgets are shared (static), so the max total is the binding
+    requirement; the total is exact, so one retry always suffices.
+    ``budget == 0`` means no static budget was in play (the XLA path's
+    "cannot overflow" report).
+    """
+    ps = np.asarray(pstats).reshape(-1, 2)
+    total, budget = int(ps[:, 0].max()), int(ps[:, 1].max())
+    if budget and total > budget:
+        from .log import get_logger
+
+        get_logger().warning(
+            "live tile-pair budget overflow (%d > %d); rerunning with "
+            "an exact budget", total, budget,
+        )
+        return round_up(total, 4096)
+    return 0
+
+
+def seed_hint(key, pstats) -> None:
+    """Remember the exact budget that sufficed after an observed
+    overflow (seed-on-overflow-only — see utils.hints)."""
+    total = int(np.asarray(pstats).reshape(-1, 2)[:, 0].max())
+    if total > 0:
+        PAIR_BUDGET_HINTS.put(key, round_up(total, 4096))
+
+
+def unconverged_error(merge_rounds: int) -> RuntimeError:
+    return RuntimeError(
+        f"cross-partition label merge did not converge within "
+        f"{merge_rounds} rounds — the result would be under-merged "
+        f"(a cluster chain threading more partitions than the rounds "
+        f"covered would come back split); raise merge_rounds"
+    )
+
+
+def run_ladders(run_step, hint_key, pair_budget, merge_rounds):
+    """Drive ``run_step`` through the pair-budget and merge-rounds
+    retry ladders.
+
+    ``run_step(pair_budget, merge_rounds)`` returns ``(outputs, pstats,
+    converged)``.  Handles, in order: hint lookup when ``pair_budget``
+    is None, one exact-total pair-overflow retry (a persisting overflow
+    raises — never returns labels built from a truncated pair list),
+    hint seeding after an observed overflow, and one 4x merge-rounds
+    retry on non-convergence (then raises).
+    """
+    from .log import get_logger
+
+    this_pair = pair_budget
+    pair_attempts = 2  # exact-total retry: one is always enough
+    this_rounds = merge_rounds
+    rounds_attempts = 2
+    overflowed = False
+    while True:
+        use_pair = (
+            this_pair if this_pair is not None
+            else PAIR_BUDGET_HINTS.get(hint_key)
+        )
+        outputs, pstats, converged = run_step(use_pair, this_rounds)
+        retry_pair = pair_overflow(pstats)
+        if retry_pair:
+            pair_attempts -= 1
+            if pair_attempts <= 0:
+                raise RuntimeError(
+                    f"live tile-pair budget overflow persisted after an "
+                    f"exact-total retry ({retry_pair})"
+                )
+            this_pair = retry_pair
+            overflowed = True
+            continue
+        if not bool(np.asarray(converged)):
+            rounds_attempts -= 1
+            if rounds_attempts <= 0:
+                raise unconverged_error(this_rounds)
+            nxt = max(1, 4 * this_rounds)
+            get_logger().warning(
+                "label merge unconverged after %d rounds; retrying with "
+                "%d", this_rounds, nxt,
+            )
+            this_rounds = nxt
+            continue
+        break
+    if overflowed:
+        seed_hint(hint_key, pstats)
+    return outputs

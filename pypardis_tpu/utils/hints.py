@@ -1,0 +1,59 @@
+"""Bounded cache of sufficient live tile-pair budgets.
+
+The pair extraction's static budget (``ops.distances.live_tile_pairs``)
+is a compile-time shape: a dataset dense enough to defeat the default
+budget pays an extract-overflow-rerun (plus a 30-300s recompile) on the
+first fit.  This cache remembers the exact budget that sufficed, keyed
+by (shape, block, precision, eps, metric), so later fits of the same
+configuration compile the right program the first time.
+
+Seeding policy (round-3 advisor finding): entries are written ONLY when
+an overflow was actually observed.  Seeding after every fit made the
+hint a *new* static value for configurations whose default budget was
+fine, recompiling the whole cluster program on the second fit of
+everything — the exact cost the hint exists to avoid.
+
+The cache is LRU-bounded: one long-lived process sweeping eps values or
+fitting many shapes must not leak an unbounded dict (each entry is tiny,
+but the single-shard staging buffer keeps only the latest shape for the
+same reason).
+"""
+
+from __future__ import annotations
+
+from typing import Hashable, Optional
+
+
+class BudgetHintCache:
+    """Insertion-ordered dict with LRU eviction past ``maxsize``."""
+
+    def __init__(self, maxsize: int = 64):
+        self.maxsize = int(maxsize)
+        self._d: dict = {}
+
+    def get(self, key: Hashable) -> Optional[int]:
+        val = self._d.pop(key, None)
+        if val is not None:
+            self._d[key] = val  # refresh recency
+        return val
+
+    def put(self, key: Hashable, value: int) -> None:
+        self._d.pop(key, None)
+        self._d[key] = int(value)
+        while len(self._d) > self.maxsize:
+            self._d.pop(next(iter(self._d)))
+
+    def clear(self) -> None:
+        self._d.clear()
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+    def __contains__(self, key: Hashable) -> bool:
+        return key in self._d
+
+
+# One shared instance: the single-shard driver (dbscan._pad_and_run) and
+# the sharded driver (parallel.sharded.sharded_dbscan) key their entries
+# differently, so they coexist without collisions.
+PAIR_BUDGET_HINTS = BudgetHintCache()

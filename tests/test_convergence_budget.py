@@ -1,0 +1,198 @@
+"""Merge-loop convergence signalling + sharded pair-budget ladder.
+
+Round-3 review items: (1) the in-graph merge must never return
+under-merged labels silently — non-convergence is detected, retried
+once at 4x rounds, then raised; (2) the sharded driver's pair-budget
+overflow rerun must be exercisable off-hardware (the XLA path now
+reports real live-pair totals), and reruns must seed the shared hint
+cache so refits compile the right program the first time.
+"""
+
+import numpy as np
+import pytest
+
+import pypardis_tpu.parallel.sharded as sharded_mod
+from pypardis_tpu.parallel import default_mesh, sharded_dbscan
+from pypardis_tpu.partition import KDPartitioner
+from pypardis_tpu.utils.hints import PAIR_BUDGET_HINTS
+
+
+@pytest.fixture(autouse=True)
+def _clean_hints():
+    PAIR_BUDGET_HINTS.clear()
+    yield
+    PAIR_BUDGET_HINTS.clear()
+
+
+def _chain_data(n=256, k=2, step=0.09):
+    """A single line of points threading every KD partition: the
+    worst case for merge depth (one cluster chained across all 8)."""
+    x = np.arange(n, dtype=np.float64) * step
+    pts = np.zeros((n, k))
+    pts[:, 0] = x
+    return pts
+
+
+def test_nonconvergence_detected_and_retried():
+    """merge_rounds=1 cannot certify a fixpoint on chained-partition
+    data; the driver must retry at 4x and return CORRECT labels (the
+    silent under-merge of round 3 is gone)."""
+    X = _chain_data()
+    mesh = default_mesh(8)
+    part = KDPartitioner(X, max_partitions=8)
+    ref, _, _ = sharded_dbscan(
+        X, part, eps=0.2, min_samples=2, block=64, mesh=mesh,
+        merge="device",
+    )
+    labels, _, stats = sharded_dbscan(
+        X, part, eps=0.2, min_samples=2, block=64, mesh=mesh,
+        merge="device", merge_rounds=1,
+    )
+    assert stats["merge_converged"] is True
+    np.testing.assert_array_equal(labels, ref)
+    # the chain really is one cluster — under-merge would split it
+    assert labels.max() == labels.min() >= 0
+
+
+def test_nonconvergence_raises_instead_of_silent_undermerge():
+    """With zero rounds allowed (retry: still zero), the driver must
+    raise — not hand back the identity label map as a result."""
+    X = _chain_data()
+    part = KDPartitioner(X, max_partitions=8)
+    with pytest.raises(RuntimeError, match="did not converge"):
+        sharded_dbscan(
+            X, part, eps=0.2, min_samples=2, block=64,
+            mesh=default_mesh(8), merge="device", merge_rounds=0,
+        )
+
+
+def test_nonconvergence_ring_detected():
+    X = _chain_data()
+    part = KDPartitioner(X, max_partitions=8)
+    ref, _, _ = sharded_dbscan(
+        X, part, eps=0.2, min_samples=2, block=64, mesh=default_mesh(8),
+        halo="ring",
+    )
+    labels, _, stats = sharded_dbscan(
+        X, part, eps=0.2, min_samples=2, block=64, mesh=default_mesh(8),
+        halo="ring", merge_rounds=1,
+    )
+    assert stats["merge_converged"] is True
+    np.testing.assert_array_equal(labels, ref)
+    with pytest.raises(RuntimeError, match="did not converge"):
+        sharded_dbscan(
+            X, part, eps=0.2, min_samples=2, block=64,
+            mesh=default_mesh(8), halo="ring", merge_rounds=0,
+        )
+
+
+def _spy_step(monkeypatch):
+    calls = []
+    orig = sharded_mod.sharded_step
+
+    def spy(*args, **kwargs):
+        calls.append(kwargs.get("pair_budget"))
+        return orig(*args, **kwargs)
+
+    monkeypatch.setattr(sharded_mod, "sharded_step", spy)
+    return calls
+
+
+def test_pair_budget_overflow_rerun_and_hint_reuse(monkeypatch):
+    """An explicit too-small pair budget triggers the overflow rerun on
+    the CPU mesh (real XLA-path totals), labels stay correct, the exact
+    budget lands in the hint cache, and the NEXT fit of the same
+    configuration runs the compiled-right program once."""
+    from sklearn.datasets import make_blobs
+
+    X, _ = make_blobs(
+        n_samples=2000, centers=8, n_features=3, cluster_std=0.3,
+        random_state=1,
+    )
+    mesh = default_mesh(8)
+    part = KDPartitioner(X, max_partitions=8)
+    ref, _, _ = sharded_dbscan(
+        X, part, eps=0.4, min_samples=5, block=64, mesh=mesh,
+        merge="device",
+    )
+
+    calls = _spy_step(monkeypatch)
+    labels, _, _ = sharded_dbscan(
+        X, part, eps=0.4, min_samples=5, block=64, mesh=mesh,
+        merge="device", pair_budget=1,
+    )
+    np.testing.assert_array_equal(labels, ref)
+    assert calls[0] == 1 and len(calls) == 2 and calls[1] > 1
+    assert len(PAIR_BUDGET_HINTS) == 1  # seeded from the rerun
+
+    calls.clear()
+    labels2, _, _ = sharded_dbscan(
+        X, part, eps=0.4, min_samples=5, block=64, mesh=mesh,
+        merge="device",
+    )
+    np.testing.assert_array_equal(labels2, ref)
+    assert len(calls) == 1 and calls[0] is not None  # hint, no rerun
+
+
+def test_no_hint_seeded_without_overflow(monkeypatch):
+    """ADVICE r3 (medium): a fit whose default budget was fine must NOT
+    seed a hint — seeding would recompile the second fit of every
+    configuration."""
+    from sklearn.datasets import make_blobs
+
+    X, _ = make_blobs(
+        n_samples=1000, centers=4, n_features=3, cluster_std=0.3,
+        random_state=2,
+    )
+    part = KDPartitioner(X, max_partitions=8)
+    calls = _spy_step(monkeypatch)
+    sharded_dbscan(
+        X, part, eps=0.4, min_samples=5, block=64, mesh=default_mesh(8),
+        merge="device",
+    )
+    assert len(calls) == 1 and calls[0] is None
+    assert len(PAIR_BUDGET_HINTS) == 0
+    # a refit passes pair_budget=None again -> same compiled program
+    calls.clear()
+    sharded_dbscan(
+        X, part, eps=0.4, min_samples=5, block=64, mesh=default_mesh(8),
+        merge="device",
+    )
+    assert calls == [None]
+
+
+def test_host_merge_budget_rerun(monkeypatch):
+    """The merge='host' path's rerun site also executes in CI."""
+    from sklearn.datasets import make_blobs
+
+    X, _ = make_blobs(
+        n_samples=2000, centers=8, n_features=3, cluster_std=0.3,
+        random_state=4,
+    )
+    mesh = default_mesh(8)
+    part = KDPartitioner(X, max_partitions=8)
+    ref, _, _ = sharded_dbscan(
+        X, part, eps=0.4, min_samples=5, block=64, mesh=mesh,
+        merge="host",
+    )
+    labels, _, stats = sharded_dbscan(
+        X, part, eps=0.4, min_samples=5, block=64, mesh=mesh,
+        merge="host", pair_budget=1,
+    )
+    assert stats["merge"] == "host"
+    np.testing.assert_array_equal(labels, ref)
+    assert len(PAIR_BUDGET_HINTS) == 1
+
+
+def test_single_shard_hint_cache_bounded():
+    """ADVICE r3 (low): the hint cache is LRU-bounded, not a leak."""
+    from pypardis_tpu.utils.hints import BudgetHintCache
+
+    c = BudgetHintCache(maxsize=4)
+    for i in range(10):
+        c.put(("k", i), i)
+    assert len(c) == 4
+    assert c.get(("k", 9)) == 9 and c.get(("k", 0)) is None
+    # recency refresh: touching an old entry protects it
+    c.put(("fresh", 0), 1)
+    assert c.get(("k", 9)) == 9  # still present (was refreshed by get)
