@@ -284,6 +284,19 @@ def _pad_and_run(
     return roots[:n], core[:n]
 
 
+def _partition_cluster_dict(parts: np.ndarray, labels: np.ndarray) -> Dict:
+    """{"partition:cluster" -> global id} parity codes (reference
+    ``cluster_dict``, dbscan.py:99-102): the global dense label doubles
+    as the per-partition cluster id after the in-graph merge."""
+    sel = labels >= 0
+    codes = np.unique(
+        parts[sel].astype(np.int64) << 32 | labels[sel].astype(np.int64)
+    )
+    return {
+        f"{c >> 32}:{c & 0xFFFFFFFF}": int(c & 0xFFFFFFFF) for c in codes
+    }
+
+
 def dbscan_partition(iterable, params):
     """API-parity port of the per-partition worker (dbscan.py:12-34).
 
@@ -494,9 +507,23 @@ class DBSCAN:
         from .parallel.sharded import sharded_dbscan
 
         if _is_device_array(points):
-            # The KD partitioner is a host structure; the sharded path
-            # re-lays shards out host-side anyway.
-            points = np.asarray(points)
+            if self.merge == "host":
+                # The device route runs ring halo + in-graph merge; an
+                # explicit host merge is honored by fetching the data
+                # and taking the host path (loudly, not silently).
+                get_logger().info(
+                    "merge='host' requested for a device-resident "
+                    "input: fetching the dataset and using the host "
+                    "sharded path"
+                )
+                points = np.asarray(points)
+            else:
+                # Device-resident input never round-trips the
+                # coordinates through the host (the analogue of
+                # train(rdd) on already-distributed data, reference
+                # dbscan.py:104).
+                self._train_sharded_device(points, timer)
+                return
 
         with timer.phase("partition"):
             # max_partitions is a user-facing MAX (reference
@@ -551,14 +578,74 @@ class DBSCAN:
 
         members = expanded_members(part.tree, points, 2 * self.eps)
         self.neighbors = {l: members[l][0] for l in sorted(members)}
-        sel = self.labels_ >= 0
-        codes = np.unique(
-            part.result[sel].astype(np.int64) << 32
-            | self.labels_[sel].astype(np.int64)
+        self.cluster_dict = _partition_cluster_dict(
+            part.result, self.labels_
         )
-        self.cluster_dict = {
-            f"{c >> 32}:{c & 0xFFFFFFFF}": int(c & 0xFFFFFFFF) for c in codes
+
+    def _train_sharded_device(self, points, timer) -> None:
+        """Sharded fit of a device-resident ``jax.Array``.
+
+        KD boundaries come from a host subsample; routing, layout, ring
+        halo exchange, clustering, and merge run on device
+        (:func:`pypardis_tpu.parallel.sharded.sharded_dbscan_device`).
+        Host traffic: the subsample, (P,) counts, (N,) labels/core, and
+        the (N,) int32 partition assignment for the parity surface —
+        never the (N, k) coordinates.
+        """
+        from .parallel.sharded import sharded_dbscan_device
+
+        with timer.phase("cluster"):
+            labels, core, stats, part, pid = sharded_dbscan_device(
+                points,
+                eps=self.eps,
+                min_samples=self.min_samples,
+                metric=self.metric,
+                block=self.block,
+                mesh=self.mesh,
+                precision=self.precision,
+                backend=self.kernel_backend,
+                max_partitions=self.max_partitions,
+                split_method=self.split_method,
+            )
+        with timer.phase("densify"):
+            self.labels_ = densify_labels(labels)
+        self.core_sample_mask_ = core
+        self.metrics_.update(stats)
+        # Promote the subsample-built partitioner to the full-data view:
+        # ``result``/``partitions`` come from the device routing (int
+        # fetch), so cluster_mapping() and the parity surface reflect
+        # the real partition structure.  One stable argsort, not a
+        # boolean scan per partition (O(N log N), not O(P*N)).
+        pid_np = np.asarray(pid)
+        part.result = pid_np
+        order = np.argsort(pid_np, kind="stable")
+        uniq, starts = np.unique(pid_np[order], return_index=True)
+        bounds = np.append(starts, len(order))
+        part.partitions = {
+            int(l): order[s:e]
+            for l, s, e in zip(uniq, bounds[:-1], bounds[1:])
         }
+        self.partitioner_ = part
+        self.metrics_["n_partitions"] = len(part.partitions)
+        # Boxes replay the SPLIT PLANES from an all-space root, so every
+        # routed point is inside its partition's box by construction —
+        # the subsample-extent boxes would exclude full-data extremes
+        # the tree routes by half-space.
+        boxes = {0: BoundingBox(k=points.shape[1], all_space=True)}
+        for parent, axis, boundary, _left, right in part.tree:
+            left_box, right_box = boxes[parent].split(axis, boundary)
+            boxes[parent] = left_box
+            boxes[right] = right_box
+        part.bounding_boxes = boxes
+        self.bounding_boxes = boxes
+        self.expanded_boxes = {
+            l: b.expand(2 * self.eps) for l, b in boxes.items()
+        }
+        # The device path never materializes expanded membership
+        # host-side (tight-box halos live only on device), so
+        # ``neighbors`` lists each partition's OWNED points.
+        self.neighbors = dict(part.partitions)
+        self.cluster_dict = _partition_cluster_dict(pid_np, self.labels_)
 
     def save(self, path: str) -> None:
         """Checkpoint the trained model (labels, boxes, hyperparams)."""

@@ -12,6 +12,6 @@ collectives.  One jit, no host round-trips.
 """
 
 from .mesh import default_mesh
-from .sharded import sharded_dbscan
+from .sharded import sharded_dbscan, sharded_dbscan_device
 
-__all__ = ["default_mesh", "sharded_dbscan"]
+__all__ = ["default_mesh", "sharded_dbscan", "sharded_dbscan_device"]

@@ -1,0 +1,147 @@
+"""Device-resident sharded input: route and lay out shards on device.
+
+The reference's ``train(rdd)`` consumes *already-distributed* data
+(``/root/reference/dbscan/dbscan.py:104``) — the driver never holds the
+dataset.  The TPU analogue is a device-resident ``jax.Array``: the
+round-3 sharded path bounced it through ``np.asarray`` and re-built the
+whole layout host-side, paying a full device->host->device round trip
+of the dataset.  This module removes the bounce:
+
+* KD split boundaries come from a small host SUBSAMPLE (statistically
+  identical for the moment-based strategies — partition.py's
+  ``sample_size`` argument does the same thing host-side);
+* everything that touches all N points — tree routing, Morton
+  ordering, the (P, cap, k) slab gather, per-partition bounding
+  boxes — runs on device in a handful of jitted programs;
+* halos are exchanged device-side by the ring path
+  (:mod:`pypardis_tpu.parallel.halo`), which never needed host halo
+  tables in the first place.
+
+Partition boxes here are the TIGHT boxes of each partition's routed
+members (scatter-min/max in the recentred f32 frame), not the KD split
+boxes: every owned point lies inside its tight box by construction, so
+the 2*eps expansion argument (README.md:20 — an owned point's full
+eps-ball is inside the expanded box) holds unchanged, and tighter boxes
+only shrink the halo.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_BIG = jnp.float32(3e38)
+
+
+def tree_arrays(tree):
+    """Split-tree records as flat arrays for the device router.
+
+    ``tree``: [(parent_label, axis, boundary, left_label, right_label)]
+    in construction order (KDPartitioner.tree).  Returns (parent, axis,
+    boundary, right) — left children keep the parent label, so only the
+    right label is needed.
+    """
+    if not tree:
+        return (
+            np.zeros(0, np.int32), np.zeros(0, np.int32),
+            np.zeros(0, np.float32), np.zeros(0, np.int32),
+        )
+    parent = np.array([t[0] for t in tree], np.int32)
+    axis = np.array([t[1] for t in tree], np.int32)
+    boundary = np.array([t[2] for t in tree], np.float32)
+    right = np.array([t[4] for t in tree], np.int32)
+    return parent, axis, boundary, right
+
+
+@jax.jit
+def device_route(points, parent, axis, boundary, right):
+    """Replay the split tree on device: (N,) partition label per point.
+
+    Split semantics match :func:`pypardis_tpu.partition.route_tree`
+    (strict ``<`` stays left, ``>=`` goes right) — a ``lax.scan`` over
+    the tiny tree, each step one masked column compare over all points.
+    Comparisons evaluate in float32 (JAX's default device precision;
+    boundaries are f32-rounded in :func:`tree_arrays`), so a point
+    within one f32 ULP of a split plane can route differently than the
+    host's float64 replay.  That is immaterial for clustering:
+    ownership stays a partition of unity either way, and the device
+    path's boxes/halos derive from the ROUTED members, so every
+    membership decision downstream is self-consistent.
+    """
+    n = points.shape[0]
+    labels = jnp.zeros(n, jnp.int32)
+    if parent.shape[0] == 0:
+        return labels
+
+    def body(lab, rec):
+        p, a, b, r = rec
+        c = jnp.take(points, a, axis=1).astype(jnp.float32)
+        go_right = (lab == p) & (c >= b)
+        return jnp.where(go_right, r, lab), None
+
+    labels, _ = jax.lax.scan(body, labels, (parent, axis, boundary, right))
+    return labels
+
+
+@functools.partial(jax.jit, static_argnames=("p_total",))
+def device_partition_counts(pid, *, p_total):
+    return jnp.zeros(p_total, jnp.int32).at[pid].add(1)
+
+
+@functools.partial(jax.jit, static_argnames=("p_total", "cap"))
+def device_owned_layout(points, pid, counts, *, p_total, cap):
+    """Gather routed points into Morton-sorted (P, cap, k) owned slabs.
+
+    One global ``lexsort`` keyed (partition, morton-words) produces the
+    partition-grouped, spatially-ordered permutation — the device
+    analogue of the host layout's per-partition ``spatial_order`` pass.
+    ``counts``: the (P,) per-partition counts the caller already built
+    with :func:`device_partition_counts` (to size ``cap`` host-side) —
+    passed in rather than recomputed.  Returns ``(owned, mask, gid,
+    lo, hi)`` where the boxes are the TIGHT per-partition bounds in
+    the recentred f32 frame (callers expand by 2*eps); empty/padding
+    partitions carry inverted (+BIG, -BIG) boxes that match nothing.
+    """
+    from ..ops.pipeline import _device_morton_words
+
+    n, k = points.shape
+    # Centering by the (input-dtype) mean preserves distances exactly
+    # and keeps f32 coordinates small for the matmul expansion — the
+    # same contract as ops.pipeline.device_prep.
+    center = jnp.mean(points, axis=0)
+    xc = (points - center).astype(jnp.float32)
+    words = _device_morton_words(xc.T, jnp.ones(n, bool))
+    # jnp.lexsort: the LAST key is primary -> partition id first, then
+    # morton words most-significant first within each partition.
+    perm = jnp.lexsort(tuple(words[::-1]) + (pid,)).astype(jnp.int32)
+    pid_s = pid[perm]
+    start = jnp.cumsum(counts) - counts
+    within = jnp.arange(n, dtype=jnp.int32) - start[pid_s]
+    target = pid_s * cap + within
+    # Rows are PLACED BY GATHER, never by a 2-D scatter: a 1-D int
+    # scatter builds slot -> sorted-source (target is a bijection on
+    # valid slots, so no collisions), and the row move is a gather
+    # through it.  The axon XLA backend's scatter emitter CHECK-fails
+    # outright on scatters with multi-dim operands
+    # (scatter_emitter.cc: operand_indices.size() == 1), so row
+    # scatters must not appear anywhere in this program.
+    src = (
+        jnp.full(p_total * cap, n, jnp.int32)
+        .at[target]
+        .set(jnp.arange(n, dtype=jnp.int32))
+    )
+    mask = src < n
+    safe = jnp.clip(src, 0, n - 1)
+    owned = jnp.where(mask[:, None], xc[perm[safe]], 0.0)
+    gid = jnp.where(mask, perm[safe], n)
+    owned = owned.reshape(p_total, cap, k)
+    mask = mask.reshape(p_total, cap)
+    # Tight per-partition boxes reduce straight off the slabs (empty
+    # and padding partitions come out inverted: +BIG/-BIG).
+    valid3 = mask[:, :, None]
+    lo = jnp.min(jnp.where(valid3, owned, _BIG), axis=1)
+    hi = jnp.max(jnp.where(valid3, owned, -_BIG), axis=1)
+    return owned, mask, gid.reshape(p_total, cap), lo, hi

@@ -48,14 +48,15 @@ from ..utils.budget import run_ladders
 _INT_INF = jnp.iinfo(jnp.int32).max
 
 
-def _expanded_frame(points, partitioner, eps):
-    """The recentred float32 frame shared by every halo path.
+def _expanded_frame_meta(points, partitioner, eps):
+    """The recentred float32 frame shared by every halo path — metadata
+    only, never a full recentred copy of the dataset.
 
-    Returns (pts32, exp_lo, exp_hi, labels): points recentred on the
-    dataset mean, and each sorted partition's 2*eps-expanded box in the
-    same frame.  All halo membership decisions — host box query and
-    device-side ring filter — must evaluate in exactly these numbers so
-    borderline points land identically everywhere.
+    Returns (center, exp_lo, exp_hi, labels): the float64 dataset mean
+    and each sorted partition's 2*eps-expanded box recentred on it.
+    All halo membership decisions — host box query and device-side ring
+    filter — must evaluate in exactly these numbers so borderline
+    points land identically everywhere.
 
     Boundary tolerance: membership is evaluated in float32, so a point
     the reference's float64 filter would include could sit one f32 ULP
@@ -66,7 +67,6 @@ def _expanded_frame(points, partitioner, eps):
     """
     points = np.asarray(points)
     center = points.mean(axis=0, dtype=np.float64)
-    pts32 = _recentre_f32(points, center)
     labels = sorted(partitioner.partitions)
     stack = BoxStack.from_boxes(
         partitioner.bounding_boxes[l] for l in labels
@@ -78,41 +78,57 @@ def _expanded_frame(points, partitioner, eps):
     ulp_hi = np.spacing(np.abs(exp_hi), dtype=np.float32)
     exp_lo = exp_lo - 4 * ulp_lo
     exp_hi = exp_hi + 4 * ulp_hi
-    return pts32, exp_lo, exp_hi, labels
+    return center, exp_lo, exp_hi, labels
 
 
-def _recentre_f32(points, center, chunk: int = 1 << 20):
-    """(points - center) as float32 without a full-size float64 temp.
+def _recentre_rows(points, idx, center, chunk: int = 1 << 20):
+    """(points[idx] - center) as float32, chunked.
 
-    A whole-array ``points - center`` would materialize an (N, k) float64
-    intermediate (the round-1 memory wall); chunking keeps the peak extra
-    memory at O(chunk * k) regardless of N.
+    The round-3 layout recentred the WHOLE dataset up front and then
+    gathered slabs from the copy — holding input + full f32 copy +
+    owned slabs + halo slabs simultaneously (~3x the dataset in host
+    RAM at the 100M north star).  Gathering per partition bounds the
+    extra footprint at one partition's rows; chunking bounds the f64
+    subtraction temp at O(chunk * k) regardless of partition size.
     """
-    points = np.asarray(points)
-    out = np.empty(points.shape, np.float32)
-    for s in range(0, len(points), chunk):
-        e = min(s + chunk, len(points))
-        np.subtract(points[s:e], center, out=out[s:e], casting="unsafe")
-    return out
+    sub = np.empty((len(idx), points.shape[1]), np.float32)
+    for s in range(0, len(idx), chunk):
+        e = min(s + chunk, len(idx))
+        np.subtract(
+            points[idx[s:e]], center, out=sub[s:e], casting="unsafe"
+        )
+    return sub
 
 
-def _owned_layout(pts32, partitioner, labels, n_shards, block):
-    """(P, cap, ...) owned slabs, Morton-sorted per partition."""
-    n, k = pts32.shape
+def _fill_slab(slab, mask, gid, j, points, idx, center):
+    """Morton-sort partition ``idx`` in the recentred f32 frame and
+    write it into row ``j`` of the (P, cap, ...) slab arrays.  Returns
+    the sorted index array."""
+    if len(idx):
+        sub = _recentre_rows(points, idx, center)
+        order = spatial_order(sub)
+        idx = idx[order]
+        slab[j, : len(idx)] = sub[order]
+    mask[j, : len(idx)] = True
+    gid[j, : len(idx)] = idx
+    return idx
+
+
+def _owned_layout(points, center, partitioner, labels, n_shards, block):
+    """(P, cap, ...) owned slabs, Morton-sorted per partition, gathered
+    straight from the input (no dataset-sized recentred temp)."""
+    n, k = points.shape
     p_real = len(labels)
     p_total = round_up(max(p_real, n_shards), n_shards)
-    owned_idx = [
-        idx[spatial_order(pts32[idx])] if len(idx) else idx
-        for idx in (partitioner.partitions[l] for l in labels)
-    ]
-    cap = round_up(max(len(i) for i in owned_idx), block)
+    part_idx = [partitioner.partitions[l] for l in labels]
+    cap = round_up(max(len(i) for i in part_idx), block)
     owned = np.zeros((p_total, cap, k), np.float32)
     owned_mask = np.zeros((p_total, cap), bool)
     owned_gid = np.full((p_total, cap), n, np.int32)
-    for j, oi in enumerate(owned_idx):
-        owned[j, : len(oi)] = pts32[oi]
-        owned_mask[j, : len(oi)] = True
-        owned_gid[j, : len(oi)] = oi
+    owned_idx = [
+        _fill_slab(owned, owned_mask, owned_gid, j, points, idx, center)
+        for j, idx in enumerate(part_idx)
+    ]
     return owned_idx, (owned, owned_mask, owned_gid), cap, p_total
 
 
@@ -122,9 +138,12 @@ def build_owned_shards(points, partitioner, eps, n_shards, block):
     The halo sets are never materialized on the host — sizing and
     duplication happen device-side (halo.ring_halo_exchange_multi).
     """
-    pts32, exp_lo, exp_hi, labels = _expanded_frame(points, partitioner, eps)
+    points = np.asarray(points)
+    center, exp_lo, exp_hi, labels = _expanded_frame_meta(
+        points, partitioner, eps
+    )
     _, arrays, cap, p_total = _owned_layout(
-        pts32, partitioner, labels, n_shards, block
+        points, center, partitioner, labels, n_shards, block
     )
     if p_total > len(labels):
         # Padding partitions get inverted boxes (lo > hi): their ring
@@ -158,7 +177,9 @@ def build_shards(points, partitioner, eps, n_shards, block):
     """
     points = np.asarray(points)
     n, k = points.shape
-    pts32, exp_lo, exp_hi, labels = _expanded_frame(points, partitioner, eps)
+    center, exp_lo, exp_hi, labels = _expanded_frame_meta(
+        points, partitioner, eps
+    )
     # Halo sets from an O(N·depth) split-tree replay with 2*eps-widened
     # comparisons — never a broadcasted (N, P, k) membership temp (the
     # round-1 memory wall).  Replay runs on the raw points in float64
@@ -168,27 +189,24 @@ def build_shards(points, partitioner, eps, n_shards, block):
     from ..partition import expanded_members
 
     members = expanded_members(partitioner.tree, points, 2 * eps)
-    halo_idx = []
-    for l in labels:
-        arr, own = members[l]
-        idx = arr[~own]
-        halo_idx.append(idx[spatial_order(pts32[idx])] if len(idx) else idx)
+    halo_idx = [arr[~own] for arr, own in (members[l] for l in labels)]
     del members
 
     owned_idx, (owned, owned_mask, owned_gid), cap, p_total = _owned_layout(
-        pts32, partitioner, labels, n_shards, block
+        points, center, partitioner, labels, n_shards, block
     )
     hcap = round_up(max(max((len(h) for h in halo_idx), default=1), 1), block)
     halo = np.zeros((p_total, hcap, k), np.float32)
     halo_mask = np.zeros((p_total, hcap), bool)
     halo_gid = np.full((p_total, hcap), n, np.int32)
+    n_halo = sum(len(h) for h in halo_idx)
     for j, hi in enumerate(halo_idx):
-        halo[j, : len(hi)] = pts32[hi]
-        halo_mask[j, : len(hi)] = True
-        halo_gid[j, : len(hi)] = hi
+        halo_idx[j] = _fill_slab(
+            halo, halo_mask, halo_gid, j, points, hi, center
+        )
 
     stats = {
-        "halo_factor": float(sum(len(h) for h in halo_idx)) / max(n, 1),
+        "halo_factor": float(n_halo) / max(n, 1),
         "owned_cap": cap,
         "halo_cap": hcap,
         "n_shard_partitions": p_total,
@@ -495,12 +513,38 @@ def sharded_step_local(
 
 
 @functools.partial(
-    jax.jit,
-    static_argnames=(
-        "eps", "min_samples", "metric", "block", "mesh", "axis", "n_points",
-        "precision", "backend", "hcap", "pair_budget", "merge_rounds",
-    ),
+    jax.jit, static_argnames=("mesh", "axis", "hcap")
 )
+def ring_exchange_step(
+    owned, owned_mask, owned_gid, exp_lo, exp_hi, *, mesh, axis, hcap
+):
+    """The device-resident ring halo exchange as its OWN program.
+
+    Separate from the cluster+merge program on purpose: the axon TPU
+    compiler's fusion pass CHECK-fails outright (scatter_emitter.cc,
+    ``operand_indices.size() == 1``) when the exchange and the merge
+    share one module — each compiles and runs fine alone — and the
+    split also lets the ring path chain into the very same compiled
+    :func:`sharded_step` the host-halo path uses.  The two programs
+    chain asynchronously on device, so the split costs dispatch
+    latency only.
+    """
+    from .halo import ring_halo_exchange_multi
+
+    def per_device(o, om, og, lo, hi):
+        return ring_halo_exchange_multi(o, om, og, lo, hi, hcap, axis)
+
+    spec = P("p", None, None)
+    spec2 = P("p", None)
+    return jax.shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(spec, spec2, spec2, spec2, spec2),
+        out_specs=(spec, spec2, spec2, P("p")),
+        check_vma=False,
+    )(owned, owned_mask, owned_gid, exp_lo, exp_hi)
+
+
 def sharded_step_ring(
     owned, owned_mask, owned_gid, exp_lo, exp_hi,
     *, eps, min_samples, metric, block, mesh, axis, n_points,
@@ -513,35 +557,24 @@ def sharded_step_ring(
     device's owned slab circulates the ring (``ppermute`` over ICI) and
     every device keeps the points inside its partitions' 2*eps-expanded
     boxes (:mod:`pypardis_tpu.parallel.halo` — any number of partitions
-    per device; the round-2 design required exactly one).  Returns
+    per device; the round-2 design required exactly one).  Two chained
+    device programs (see :func:`ring_exchange_step` for why).  Returns
     ``(labels, core, overflow, pair_stats, rounds, converged)`` —
     ``overflow`` is the per-partition count of in-box points dropped
     for capacity; nonzero means rerun with a larger ``hcap``.
     """
-    from .halo import ring_halo_exchange_multi
-
-    def per_device(o, om, og, lo, hi):
-        h, hm, hg, ovf = ring_halo_exchange_multi(
-            o, om, og, lo, hi, hcap, axis
-        )
-        final, core_g, pstats, rounds, converged = _device_cluster_merge(
-            o, om, og, h, hm, hg,
-            eps=eps, min_samples=min_samples, metric=metric, block=block,
-            precision=precision, backend=backend, axis=axis,
-            n_points=n_points, pair_budget=pair_budget,
-            merge_rounds=merge_rounds,
-        )
-        return final, core_g, ovf, pstats[None], rounds, converged
-
-    spec = P("p", None, None)
-    spec2 = P("p", None)
-    return jax.shard_map(
-        per_device,
-        mesh=mesh,
-        in_specs=(spec, spec2, spec2, spec2, spec2),
-        out_specs=(P(), P(), P("p"), P("p", None), P(), P()),
-        check_vma=False,
-    )(owned, owned_mask, owned_gid, exp_lo, exp_hi)
+    halo, halo_mask, halo_gid, overflow = ring_exchange_step(
+        owned, owned_mask, owned_gid, exp_lo, exp_hi,
+        mesh=mesh, axis=axis, hcap=hcap,
+    )
+    labels, core, pstats, rounds, converged = sharded_step(
+        owned, owned_mask, owned_gid, halo, halo_mask, halo_gid,
+        eps=eps, min_samples=min_samples, metric=metric, block=block,
+        mesh=mesh, axis=axis, n_points=n_points, precision=precision,
+        backend=backend, pair_budget=pair_budget,
+        merge_rounds=merge_rounds,
+    )
+    return labels, core, overflow, pstats, rounds, converged
 
 
 # ---------------------------------------------------------------------------
@@ -678,65 +711,15 @@ def sharded_dbscan(
             jax.device_put(a, sharding)
             for a in (*arrays, exp_lo, exp_hi)
         )
-        cap = int(stats["owned_cap"])
-        explicit = hcap is not None
-        this_hcap = (
-            round_up(int(hcap), block) if explicit
-            else round_up(max(block, cap // 2), block)
+        labels, core, m_rounds, used_hcap = _ring_ladder(
+            args, eps=eps, min_samples=min_samples, metric=metric,
+            block=block, mesh=mesh, axis=axis, n_points=len(points),
+            precision=precision, backend=backend, hcap=hcap,
+            pair_budget=pair_budget, merge_rounds=merge_rounds,
+            cap=int(stats["owned_cap"]),
         )
-        hcap_attempts = 1 if explicit else 4
-        while True:
-            # hcap changes the tile count, so it keys the hint too.
-            hint_key = _sharded_hint_key(
-                arrays[0].shape, this_hcap, block, precision, eps, metric
-            )
-
-            def run_step(pb, mr, hc=this_hcap):
-                labels, core, overflow, pstats, m_rounds, converged = (
-                    _with_kernel_fallback(
-                        lambda be: sharded_step_ring(
-                            *args,
-                            eps=float(eps),
-                            min_samples=int(min_samples),
-                            metric=metric,
-                            block=block,
-                            mesh=mesh,
-                            axis=axis,
-                            n_points=len(points),
-                            precision=precision,
-                            backend=be,
-                            hcap=hc,
-                            pair_budget=pb,
-                            merge_rounds=mr,
-                        ),
-                        backend,
-                    )
-                )
-                # Halo capacity is checked FIRST: with dropped in-box
-                # points the pair stats and merge result are moot.
-                if int(np.asarray(overflow).sum()) != 0:
-                    raise _HaloOverflow()
-                return (labels, core, m_rounds), pstats, converged
-
-            try:
-                labels, core, m_rounds = run_ladders(
-                    run_step, hint_key, pair_budget, merge_rounds
-                )
-            except _HaloOverflow:
-                hcap_attempts -= 1
-                if hcap_attempts <= 0:
-                    raise RuntimeError(
-                        f"ring halo buffer overflow at hcap={this_hcap}; "
-                        f"pass a larger hcap"
-                        if explicit
-                        else f"ring halo buffer overflow persisted up to "
-                        f"hcap={this_hcap}"
-                    ) from None
-                this_hcap *= 2
-                continue
-            break
         stats = dict(
-            stats, halo_exchange="ring", halo_cap=this_hcap,
+            stats, halo_exchange="ring", halo_cap=used_hcap,
             merge_rounds=int(m_rounds), merge_converged=True,
         )
         labels, core = np.asarray(labels), np.asarray(core)
@@ -818,6 +801,176 @@ def sharded_dbscan(
     )
     labels, core = np.asarray(labels), np.asarray(core)
     return _canonicalize_roots(labels, core), core, stats
+
+
+def _ring_ladder(
+    args, *, eps, min_samples, metric, block, mesh, axis, n_points,
+    precision, backend, hcap, pair_budget, merge_rounds, cap,
+):
+    """hcap doubling around the shared pair/rounds ladder for ring-halo
+    execution.  ``args``: (owned, mask, gid, exp_lo, exp_hi), already
+    placed with the partition-axis sharding.  Returns ``(labels, core,
+    merge_rounds_used, hcap_used)``.
+    """
+    explicit = hcap is not None
+    this_hcap = (
+        round_up(int(hcap), block) if explicit
+        else round_up(max(block, cap // 2), block)
+    )
+    hcap_attempts = 1 if explicit else 4
+    while True:
+        # hcap changes the tile count, so it keys the hint too.
+        hint_key = _sharded_hint_key(
+            args[0].shape, this_hcap, block, precision, eps, metric
+        )
+
+        def run_step(pb, mr, hc=this_hcap):
+            labels, core, overflow, pstats, m_rounds, converged = (
+                _with_kernel_fallback(
+                    lambda be: sharded_step_ring(
+                        *args,
+                        eps=float(eps),
+                        min_samples=int(min_samples),
+                        metric=metric,
+                        block=block,
+                        mesh=mesh,
+                        axis=axis,
+                        n_points=n_points,
+                        precision=precision,
+                        backend=be,
+                        hcap=hc,
+                        pair_budget=pb,
+                        merge_rounds=mr,
+                    ),
+                    backend,
+                )
+            )
+            # Halo capacity is checked FIRST: with dropped in-box
+            # points the pair stats and merge result are moot.
+            if int(np.asarray(overflow).sum()) != 0:
+                raise _HaloOverflow()
+            return (labels, core, m_rounds), pstats, converged
+
+        try:
+            labels, core, m_rounds = run_ladders(
+                run_step, hint_key, pair_budget, merge_rounds
+            )
+        except _HaloOverflow:
+            hcap_attempts -= 1
+            if hcap_attempts <= 0:
+                raise RuntimeError(
+                    f"ring halo buffer overflow at hcap={this_hcap}; "
+                    f"pass a larger hcap"
+                    if explicit
+                    else f"ring halo buffer overflow persisted up to "
+                    f"hcap={this_hcap}"
+                ) from None
+            this_hcap *= 2
+            continue
+        return labels, core, m_rounds, this_hcap
+
+
+def sharded_dbscan_device(
+    points,
+    eps: float,
+    min_samples: int,
+    metric="euclidean",
+    block: int = 1024,
+    mesh: Optional[Mesh] = None,
+    precision: str = "high",
+    backend: str = "auto",
+    hcap: Optional[int] = None,
+    pair_budget: Optional[int] = None,
+    merge_rounds: int = 32,
+    max_partitions: Optional[int] = None,
+    split_method: str = "min_var",
+    sample_size: int = 262_144,
+    seed: int = 0,
+):
+    """Cluster a DEVICE-RESIDENT ``jax.Array`` over the mesh without a
+    host round trip of the dataset.
+
+    The TPU analogue of the reference's ``train(rdd)`` on
+    already-distributed data (``/root/reference/dbscan/dbscan.py:104``):
+    KD split boundaries come from a small host subsample; routing, the
+    Morton slab layout, per-partition boxes, the ring halo exchange,
+    clustering, and the in-graph merge all run on device
+    (:mod:`pypardis_tpu.parallel.device_input`).  Host traffic is the
+    subsample, the (P,) partition counts, and the (N,) label/core
+    results — never the (N, k) coordinates.
+
+    Returns ``(labels, core, stats, partitioner, pid)`` — ``pid`` is the
+    device (N,) partition assignment (fetch it for the parity ``result``
+    surface; it is ints, not the dataset), ``partitioner`` the
+    subsample-built KDPartitioner whose tree routed the points.
+    """
+    from ..ops.distances import _norm_metric
+    from ..partition import KDPartitioner
+    from .device_input import (
+        device_owned_layout,
+        device_partition_counts,
+        device_route,
+        tree_arrays,
+    )
+    from .mesh import default_mesh
+
+    metric = _norm_metric(metric)
+    if mesh is None:
+        mesh = default_mesh()
+    n_shards = mesh.devices.size
+    axis = mesh.axis_names[0]
+    n, k = points.shape
+
+    # KD boundaries from a host subsample — the statistically identical
+    # move KDPartitioner's own sample_size makes host-side.
+    rng = np.random.default_rng(seed)
+    if n > sample_size:
+        sel = np.sort(rng.choice(n, size=sample_size, replace=False))
+        sample = np.asarray(points[jnp.asarray(sel)])
+    else:
+        sample = np.asarray(points)
+    part = KDPartitioner(
+        sample,
+        max_partitions=(n_shards if max_partitions is None
+                        else int(max_partitions)),
+        split_method=split_method,
+        sample_size=None,
+    )
+    p_total = round_up(max(part.n_partitions, n_shards), n_shards)
+
+    pid = device_route(points, *map(jnp.asarray, tree_arrays(part.tree)))
+    counts_dev = device_partition_counts(pid, p_total=p_total)
+    max_count = int(np.asarray(counts_dev).max())
+    block = clamp_block(block, max_count)
+    cap = round_up(max(max_count, 1), block)
+
+    owned, msk, gid, lo, hi = device_owned_layout(
+        points, pid, counts_dev, p_total=p_total, cap=cap
+    )
+    two_eps = jnp.float32(2 * eps)
+    sharding = NamedSharding(mesh, P(axis))
+    args = tuple(
+        jax.device_put(a, sharding)
+        for a in (owned, msk, gid, lo - two_eps, hi + two_eps)
+    )
+    labels, core, m_rounds, used_hcap = _ring_ladder(
+        args, eps=eps, min_samples=min_samples, metric=metric, block=block,
+        mesh=mesh, axis=axis, n_points=n, precision=precision,
+        backend=backend, hcap=hcap, pair_budget=pair_budget,
+        merge_rounds=merge_rounds, cap=cap,
+    )
+    stats = {
+        "owned_cap": cap,
+        "n_shard_partitions": p_total,
+        "pad_waste": float(p_total * cap) / max(n, 1) - 1.0,
+        "input": "device",
+        "halo_exchange": "ring",
+        "halo_cap": used_hcap,
+        "merge_rounds": int(m_rounds),
+        "merge_converged": True,
+    }
+    labels, core = np.asarray(labels), np.asarray(core)
+    return _canonicalize_roots(labels, core), core, stats, part, pid
 
 
 def _canonicalize_roots(labels: np.ndarray, core: np.ndarray) -> np.ndarray:

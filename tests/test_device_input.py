@@ -1,0 +1,152 @@
+"""Device-resident sharded input: no host round trip of the dataset.
+
+Round-3 review, Missing #2 / Next #4: the sharded path must accept a
+device-resident ``jax.Array`` the way the reference's ``train(rdd)``
+accepts already-distributed data — KD-split from a host subsample,
+route/gather on device — without bouncing the (N, k) coordinates
+through ``np.asarray``.
+"""
+
+import jax
+import numpy as np
+import pytest
+from sklearn.datasets import make_blobs
+from sklearn.metrics import adjusted_rand_score
+
+from pypardis_tpu import DBSCAN
+from pypardis_tpu.parallel import (
+    default_mesh,
+    sharded_dbscan,
+    sharded_dbscan_device,
+)
+from pypardis_tpu.partition import KDPartitioner
+
+
+def _blobs(n=4000, k=3, seed=5):
+    X, _ = make_blobs(
+        n_samples=n, centers=10, n_features=k, cluster_std=0.3,
+        random_state=seed,
+    )
+    return X.astype(np.float32)
+
+
+def test_device_resident_input_matches_host(monkeypatch):
+    """The device route produces the same clustering as the host route,
+    and never fetches the (N, k) coordinate array to the host."""
+    X = _blobs()
+    n, k = X.shape
+    mesh = default_mesh(8)
+    part = KDPartitioner(X, max_partitions=8)
+    ref, ref_core, _ = sharded_dbscan(
+        X, part, eps=0.4, min_samples=5, block=64, mesh=mesh
+    )
+
+    fetched = []
+    orig_asarray = np.asarray
+
+    def spy(a, *args, **kwargs):
+        if isinstance(a, jax.Array) and getattr(a, "shape", None) == (n, k):
+            fetched.append(a.shape)
+        return orig_asarray(a, *args, **kwargs)
+
+    monkeypatch.setattr(np, "asarray", spy)
+    Xd = jax.device_put(X)
+    labels, core, stats, _part, pid = sharded_dbscan_device(
+        Xd, eps=0.4, min_samples=5, block=64, mesh=mesh,
+        sample_size=1000,  # < n: the subsample fetch must not be (n, k)
+    )
+    monkeypatch.setattr(np, "asarray", orig_asarray)
+
+    assert fetched == [], "the (N, k) coordinates were fetched to host"
+    assert stats["input"] == "device"
+    np.testing.assert_array_equal(core, ref_core)
+    # Identical clustering: canonicalized labels are partition-agnostic
+    # on core points; border points reachable from several clusters are
+    # legitimately assignment-ambiguous (reference README.md:28-33), so
+    # compare those by ARI.
+    np.testing.assert_array_equal(labels[ref_core], ref[ref_core])
+    np.testing.assert_array_equal(labels == -1, ref == -1)
+    assert adjusted_rand_score(labels, ref) >= 0.999
+    # The routed assignment covers all points across the mesh's
+    # partition count.
+    pid_np = np.asarray(pid)
+    assert pid_np.shape == (n,) and len(np.unique(pid_np)) == 8
+
+
+def test_dbscan_api_device_resident_sharded():
+    """DBSCAN.fit on a jax.Array takes the device route end to end and
+    keeps the parity attribute surface."""
+    X = _blobs(n=2000)
+    ref = DBSCAN(eps=0.4, min_samples=5, block=64).fit_predict(X)
+    m = DBSCAN(eps=0.4, min_samples=5, block=64)
+    labels = m.fit_predict(jax.device_put(X))
+    assert adjusted_rand_score(labels, ref) >= 0.999
+    assert m.metrics_.get("input") == "device"
+    assert m.metrics_["n_partitions"] >= 2
+    assert set(m.neighbors) == set(m.bounding_boxes) & set(m.neighbors)
+    assert m.cluster_dict and all(
+        ":" in key for key in m.cluster_dict
+    )
+    # result stays key-sorted (the reference's sortByKey contract)
+    keys = [key for key, _ in m.result]
+    assert keys == sorted(keys)
+
+
+def test_device_route_matches_host_route():
+    from pypardis_tpu.parallel.device_input import device_route, tree_arrays
+
+    X = _blobs(n=1500, k=4)
+    part = KDPartitioner(X, max_partitions=8)
+    host = part.route(X)
+    dev = np.asarray(
+        device_route(jax.device_put(X), *map(jax.numpy.asarray,
+                                             tree_arrays(part.tree)))
+    )
+    np.testing.assert_array_equal(host, dev)
+
+
+def test_device_route_single_partition():
+    from pypardis_tpu.parallel.device_input import device_route, tree_arrays
+
+    X = _blobs(n=64)
+    part = KDPartitioner(X, max_partitions=1)
+    dev = np.asarray(
+        device_route(jax.device_put(X), *map(jax.numpy.asarray,
+                                             tree_arrays(part.tree)))
+    )
+    assert (dev == 0).all()
+
+
+def test_device_input_merge_host_honored():
+    """merge='host' on a device-resident input must not be silently
+    replaced by the device merge — it fetches and takes the host path."""
+    X = _blobs(n=2000)
+    m = DBSCAN(eps=0.4, min_samples=5, block=64, merge="host")
+    labels = m.fit_predict(jax.device_put(X))
+    assert m.metrics_.get("merge") == "host"
+    assert m.metrics_.get("input") != "device"
+    ref = DBSCAN(eps=0.4, min_samples=5, block=64).fit_predict(X)
+    assert adjusted_rand_score(labels, ref) >= 0.999
+
+
+def test_device_boxes_contain_routed_points():
+    """The device path's parity boxes replay the split planes from an
+    all-space root, so every routed point is inside its box — including
+    full-data extremes absent from the subsample."""
+    X = _blobs(n=4000)
+    m = DBSCAN(eps=0.4, min_samples=5, block=64)
+    m.fit(jax.device_put(X))
+    for label, idx in m.neighbors.items():
+        box = m.bounding_boxes[label]
+        assert box.contains_points(X[idx]).all()
+
+
+def test_sharded_device_rejects_nothing_small():
+    """Tiny inputs still work through the device route."""
+    X = _blobs(n=64, k=2)
+    labels, core, stats, _p, _pid = sharded_dbscan_device(
+        jax.device_put(X), eps=0.4, min_samples=5, block=64,
+        mesh=default_mesh(8),
+    )
+    assert labels.shape == (64,)
+    assert labels.max() >= 0
