@@ -157,7 +157,7 @@ def load_model(path: str):
             for l, b in model.bounding_boxes.items()
         }
         model.metrics_ = json.loads(str(z["metrics"]))
-        model.result = list(
-            zip(model._keys.tolist(), model.labels_.tolist())
-        )
+        # ``result`` builds lazily from the restored keys/labels (the
+        # property key-sorts; an eager unsorted build here violated the
+        # sortByKey contract for non-arange keys).
     return model

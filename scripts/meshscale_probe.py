@@ -1,0 +1,136 @@
+"""One mesh-scale configuration per process, on the 8-device CPU mesh.
+
+Round-4 scale proof for the distributed path (round-3 review, Next #1):
+the sharded code had never executed past 4,000 points.  Each invocation
+runs ONE (n, mode, max_partitions) configuration through the public
+sharded driver on the virtual 8-device mesh and prints ONE JSON line
+with wall times, layout stats (halo_factor / pad_waste / caps), merge
+convergence, the shard-build host-memory high-water (VmHWM delta), and
+a sha1 of the densified labels so the assembler can assert all modes
+agree at scale.  Collected into MESHSCALE_r04.json.
+
+Fresh process per configuration: compile-cache reuse makes later
+processes effectively warm, and process isolation keeps one config's
+allocator state out of the next one's memory measurement.
+
+Usage: python scripts/meshscale_probe.py N MODE [MAX_PARTITIONS]
+  MODE: device | host | ring | auto_host
+  auto_host lowers MERGE_HOST_AUTO so merge='auto' actually crosses
+  the host-merge switchover at this size (never exercised in r3).
+"""
+
+import hashlib
+import json
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+
+def reset_hwm():
+    try:
+        with open("/proc/self/clear_refs", "w") as f:
+            f.write("5")
+    except OSError:
+        pass
+
+
+def hwm_gb():
+    for line in open("/proc/self/status"):
+        if line.startswith("VmHWM"):
+            return int(line.split()[1]) / 1e6
+    return 0.0
+
+
+def make_data(n, k=4, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.uniform(-10, 10, size=(64, k)).astype(np.float32)
+    out = centers[rng.integers(0, 64, size=n)]
+    chunk = 1 << 20
+    for s in range(0, n, chunk):
+        e = min(s + chunk, n)
+        out[s:e] += rng.normal(scale=0.1, size=(e - s, k)).astype(np.float32)
+    return out
+
+
+def main():
+    n = int(sys.argv[1])
+    mode = sys.argv[2]
+    max_partitions = int(sys.argv[3]) if len(sys.argv) > 3 else 8
+
+    import pypardis_tpu.parallel.sharded as sm
+    from pypardis_tpu.ops import densify_labels
+    from pypardis_tpu.parallel import default_mesh, sharded_dbscan
+    from pypardis_tpu.partition import KDPartitioner
+
+    kwargs = {
+        "device": dict(merge="device"),
+        "host": dict(merge="host"),
+        "ring": dict(halo="ring"),
+        "auto_host": dict(merge="auto"),
+    }[mode]
+    if mode == "auto_host":
+        sm.MERGE_HOST_AUTO = min(sm.MERGE_HOST_AUTO, max(1, n // 2))
+
+    X = make_data(n)
+    mesh = default_mesh(8)
+    t0 = time.perf_counter()
+    part = KDPartitioner(X, max_partitions=max_partitions)
+    t_part = time.perf_counter() - t0
+
+    reset_hwm()
+    pre = hwm_gb()
+    t0 = time.perf_counter()
+    labels, core, stats = sharded_dbscan(
+        X, part, eps=0.3, min_samples=10, block=1024, mesh=mesh, **kwargs
+    )
+    t_fit = time.perf_counter() - t0
+    peak = hwm_gb()
+
+    dense = densify_labels(labels)
+    print(
+        json.dumps(
+            {
+                "n": n,
+                "dim": X.shape[1],
+                "mode": mode,
+                "max_partitions": max_partitions,
+                "eps": 0.3,
+                "partition_s": round(t_part, 2),
+                "fit_s": round(t_fit, 2),
+                "pts_per_sec_total": round(n / t_fit),
+                "build_highwater_gb": round(max(0.0, peak - pre), 3),
+                "dataset_gb": round(X.nbytes / 1e9, 3),
+                "halo_factor": round(stats.get("halo_factor", -1.0), 4),
+                "pad_waste": round(stats.get("pad_waste", -1.0), 4),
+                "owned_cap": stats.get("owned_cap"),
+                "halo_cap": stats.get("halo_cap"),
+                "merge": stats.get("merge", "device-in-graph"),
+                "merge_rounds": stats.get("merge_rounds"),
+                "merge_converged": stats.get("merge_converged"),
+                "clusters": int(dense.max() + 1),
+                "noise": int((dense == -1).sum()),
+                "core_frac": round(float(core.mean()), 4),
+                "labels_sha": hashlib.sha1(
+                    np.ascontiguousarray(dense).tobytes()
+                ).hexdigest()[:16],
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -75,6 +75,37 @@ def test_host_merge_matches_device_merge():
     np.testing.assert_array_equal(l_dev, l_host)
 
 
+def test_merge_auto_switchover(monkeypatch):
+    """merge='auto' switches to the host merge past MERGE_HOST_AUTO —
+    forced low here so the switchover path actually executes in CI
+    (round-3 review: the threshold had never been crossed anywhere)."""
+    from sklearn.datasets import make_blobs
+
+    import pypardis_tpu.parallel.sharded as sm
+    from pypardis_tpu.parallel import default_mesh, sharded_dbscan
+    from pypardis_tpu.partition import KDPartitioner
+
+    X, _ = make_blobs(
+        n_samples=3000, centers=8, n_features=3, cluster_std=0.35,
+        random_state=7,
+    )
+    mesh = default_mesh(8)
+    part = KDPartitioner(X, max_partitions=8)
+    l_dev, _, s_dev = sharded_dbscan(
+        X, part, eps=0.5, min_samples=5, block=128, mesh=mesh,
+        merge="auto",
+    )
+    assert s_dev.get("merge") == "device"  # below threshold: in-graph
+
+    monkeypatch.setattr(sm, "MERGE_HOST_AUTO", 1000)
+    l_host, _, s_host = sharded_dbscan(
+        X, part, eps=0.5, min_samples=5, block=128, mesh=mesh,
+        merge="auto",
+    )
+    assert s_host.get("merge") == "host"  # threshold crossed
+    np.testing.assert_array_equal(l_dev, l_host)
+
+
 def test_host_merge_rejects_ring_halo():
     import pytest
 
