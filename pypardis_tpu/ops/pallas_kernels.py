@@ -207,11 +207,51 @@ def _tiles_t(points, block, layout):
     return points.astype(jnp.float32).reshape(d, nt, block).transpose(1, 0, 2)
 
 
+# Tile-axis chunk for _masked_bounds: bounds the two full-grid where()
+# temps the masked reduce materializes — at 50M x 16-D (cap2 ~100M
+# after segment-break padding) the unchunked form needed 2 x 5.96GB of
+# HLO temps and the prepare program compile-failed at 12.29GB on the
+# 16GB chip.
+_BOUNDS_CHUNK_ELEMS = 1 << 26
+
+
 def _masked_bounds(tiles, mask_t):
     """(nt, d) lower/upper bounds over masked points; empty tiles get
-    inverted (+BIG, -BIG) boxes so they always prune."""
-    lo = jnp.min(jnp.where(mask_t, tiles, BIG), axis=2)
-    hi = jnp.max(jnp.where(mask_t, tiles, -BIG), axis=2)
+    inverted (+BIG, -BIG) boxes so they always prune.
+
+    Chunked over the tile axis: the masked reduce's where() temps stay
+    O(chunk) instead of O(full grid); the last chunk overlaps its
+    predecessor (clamped start) and rewrites identical values.
+    """
+    nt, d, b = tiles.shape
+
+    def direct(tc, mc):
+        lo = jnp.min(jnp.where(mc, tc, BIG), axis=2)
+        hi = jnp.max(jnp.where(mc, tc, -BIG), axis=2)
+        return lo, hi
+
+    chunk = max(1, _BOUNDS_CHUNK_ELEMS // max(d * b, 1))
+    if nt <= chunk:
+        return direct(tiles, mask_t)
+
+    nc = -(-nt // chunk)
+
+    def body(carry, c):
+        lo_all, hi_all = carry
+        s = jnp.minimum(c * chunk, nt - chunk)
+        tc = jax.lax.dynamic_slice_in_dim(tiles, s, chunk, axis=0)
+        mc = jax.lax.dynamic_slice_in_dim(mask_t, s, chunk, axis=0)
+        lo, hi = direct(tc, mc)
+        return (
+            jax.lax.dynamic_update_slice(lo_all, lo, (s, 0)),
+            jax.lax.dynamic_update_slice(hi_all, hi, (s, 0)),
+        ), None
+
+    init = (
+        jnp.zeros((nt, d), jnp.float32),
+        jnp.zeros((nt, d), jnp.float32),
+    )
+    (lo, hi), _ = jax.lax.scan(body, init, jnp.arange(nc))
     return lo, hi
 
 

@@ -13,10 +13,13 @@ Fresh process per configuration: compile-cache reuse makes later
 processes effectively warm, and process isolation keeps one config's
 allocator state out of the next one's memory measurement.
 
-Usage: python scripts/meshscale_probe.py N MODE [MAX_PARTITIONS]
+Usage: python scripts/meshscale_probe.py N MODE [MAX_PARTITIONS] [EPS]
   MODE: device | host | ring | auto_host
   auto_host lowers MERGE_HOST_AUTO so merge='auto' actually crosses
   the host-merge switchover at this size (never exercised in r3).
+  EPS (default 0.3) sweeps the halo-duplication factor (r3 review,
+  Weak #6: halo_factor vs partition count and eps was unpinned at
+  sizes where duplication dominates memory).
 """
 
 import hashlib
@@ -25,18 +28,28 @@ import os
 import sys
 import time
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# PYPARDIS_PROBE_PLATFORM=native leaves the ambient platform alone (the
+# real TPU through axon): a 1-device mesh with 8 partitions exercises
+# the identical sharded machinery — multi-partition layout, halos, the
+# merge loop — at sizes and speeds the virtual CPU mesh cannot reach
+# (its collective rendezvous overhead makes 2M+ runs take most of an
+# hour).  The CPU mesh remains the CROSS-DEVICE collective proof at
+# smaller N; the native runs are the SCALE proof.
+_N_DEV = int(os.environ.get("PYPARDIS_PROBE_DEVICES", "8"))
+if os.environ.get("PYPARDIS_PROBE_PLATFORM") != "native":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={_N_DEV}"
+        ).strip()
 
 import numpy as np  # noqa: E402
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+if os.environ.get("PYPARDIS_PROBE_PLATFORM") != "native":
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", _N_DEV)
 
 
 def reset_hwm():
@@ -69,6 +82,7 @@ def main():
     n = int(sys.argv[1])
     mode = sys.argv[2]
     max_partitions = int(sys.argv[3]) if len(sys.argv) > 3 else 8
+    eps = float(sys.argv[4]) if len(sys.argv) > 4 else 0.3
 
     import pypardis_tpu.parallel.sharded as sm
     from pypardis_tpu.ops import densify_labels
@@ -85,7 +99,8 @@ def main():
         sm.MERGE_HOST_AUTO = min(sm.MERGE_HOST_AUTO, max(1, n // 2))
 
     X = make_data(n)
-    mesh = default_mesh(8)
+    n_dev = min(_N_DEV, jax.device_count())
+    mesh = default_mesh(n_dev)
     t0 = time.perf_counter()
     part = KDPartitioner(X, max_partitions=max_partitions)
     t_part = time.perf_counter() - t0
@@ -94,7 +109,7 @@ def main():
     pre = hwm_gb()
     t0 = time.perf_counter()
     labels, core, stats = sharded_dbscan(
-        X, part, eps=0.3, min_samples=10, block=1024, mesh=mesh, **kwargs
+        X, part, eps=eps, min_samples=10, block=1024, mesh=mesh, **kwargs
     )
     t_fit = time.perf_counter() - t0
     peak = hwm_gb()
@@ -106,8 +121,10 @@ def main():
                 "n": n,
                 "dim": X.shape[1],
                 "mode": mode,
+                "mesh_devices": n_dev,
+                "platform": jax.default_backend(),
                 "max_partitions": max_partitions,
-                "eps": 0.3,
+                "eps": eps,
                 "partition_s": round(t_part, 2),
                 "fit_s": round(t_fit, 2),
                 "pts_per_sec_total": round(n / t_fit),

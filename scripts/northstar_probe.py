@@ -1,0 +1,97 @@
+"""North-star shard-size probe: one BIG single-chip fit on the real TPU.
+
+BASELINE.md's north star is 100M x 16-D on a v5e-8 — 12.5M points per
+chip as a mesh shard, but the reference's scaling claim is about the
+dataset exceeding one worker, so this probe pins what ONE chip can
+actually hold and sustain (round-3 review, Next #2: run >= 50M x 16-D
+single-chip and pin the per-chip memory ceiling from a measured row,
+not an extrapolation).
+
+Prints ONE JSON line (scale_probe schema + HBM fields).  Run it in a
+fresh process per size (axon session quirks) with the chip otherwise
+idle.  The fit takes the host-stepped propagation path automatically
+past PYPARDIS_STEP_THRESHOLD, keeping each device execution short.
+
+Usage: PYTHONPATH=/root/repo:/root/.axon_site python scripts/northstar_probe.py N [DIM]
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def make_data(n, dim, pts_per_center=6250, seed=0):
+    rng = np.random.default_rng(seed)
+    n_centers = max(32, n // pts_per_center)
+    centers = rng.uniform(-10, 10, size=(n_centers, dim)).astype(np.float32)
+    assign = rng.integers(0, n_centers, size=n)
+    out = centers[assign]
+    del assign
+    chunk = 1 << 20
+    for s in range(0, n, chunk):
+        e = min(s + chunk, n)
+        out[s:e] += rng.normal(scale=0.4, size=(e - s, dim)).astype(
+            np.float32
+        )
+    return out
+
+
+def hbm_stats():
+    import jax
+
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+        return {
+            "hbm_in_use_gb": round(stats.get("bytes_in_use", 0) / 1e9, 2),
+            "hbm_peak_gb": round(stats.get("peak_bytes_in_use", 0) / 1e9, 2),
+            "hbm_limit_gb": round(stats.get("bytes_limit", 0) / 1e9, 2),
+        }
+    except Exception:  # noqa: BLE001 — stats are best-effort diagnostics
+        return {}
+
+
+def main():
+    n = int(sys.argv[1])
+    dim = int(sys.argv[2]) if len(sys.argv) > 2 else 16
+    eps = 2.4
+    X = make_data(n, dim)
+
+    from pypardis_tpu import DBSCAN
+
+    t0 = time.perf_counter()
+    model = DBSCAN(eps=eps, min_samples=10, block=2048)
+    labels = model.fit_predict(X)
+    t_cold = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    labels = model.fit_predict(X)
+    t_warm = time.perf_counter() - t0
+
+    phases = {
+        f"phase_{k}": round(v, 2)
+        for k, v in model.metrics_.items()
+        if isinstance(v, float) and k.endswith("_s")
+    }
+    print(
+        json.dumps(
+            {
+                "n": n,
+                "dim": dim,
+                "eps": eps,
+                "cold_s": round(t_cold, 2),
+                "warm_s": round(t_warm, 2),
+                "warm_pps": round(n / t_warm),
+                "clusters": int(labels.max() + 1),
+                "noise": int((labels == -1).sum()),
+                **phases,
+                **hbm_stats(),
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -104,3 +104,39 @@ def test_live_tile_pairs_chunk_boundary():
     got = {(int(r), int(c)) for r, c in zip(np.asarray(rows), np.asarray(cols))
            if int(r) < nt}
     assert got == {(i, i) for i in range(nt)}
+
+
+def test_morton_words_chunked_matches_direct(monkeypatch):
+    """The chunked Morton-word path (HBM-bounded interleave for big
+    caps) must produce bit-identical words to the direct computation,
+    including the clamped-overlap last chunk."""
+    import jax.numpy as jnp
+
+    import pypardis_tpu.ops.pipeline as pl
+
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(5, 1000)).astype(np.float32))
+    mask = jnp.asarray(rng.random(1000) < 0.9)
+    direct = [np.asarray(w) for w in pl._device_morton_words(x, mask)]
+    monkeypatch.setattr(pl, "_MORTON_CHUNK", 192)  # 1000 % 192 != 0
+    chunked = [np.asarray(w) for w in pl._device_morton_words(x, mask)]
+    assert len(direct) == len(chunked)
+    for d, c in zip(direct, chunked):
+        np.testing.assert_array_equal(d, c)
+
+
+def test_masked_bounds_chunked_matches_direct(monkeypatch):
+    """Chunked tile-bounds (HBM-bounded masked reduce) must equal the
+    direct computation, including the clamped-overlap last chunk."""
+    import jax.numpy as jnp
+
+    import pypardis_tpu.ops.pallas_kernels as pk
+
+    rng = np.random.default_rng(4)
+    tiles = jnp.asarray(rng.normal(size=(13, 3, 32)).astype(np.float32))
+    mask_t = jnp.asarray(rng.random((13, 1, 32)) < 0.8)
+    lo0, hi0 = pk._masked_bounds(tiles, mask_t)
+    monkeypatch.setattr(pk, "_BOUNDS_CHUNK_ELEMS", 5 * 3 * 32)  # chunk=5
+    lo1, hi1 = pk._masked_bounds(tiles, mask_t)
+    np.testing.assert_array_equal(np.asarray(lo0), np.asarray(lo1))
+    np.testing.assert_array_equal(np.asarray(hi0), np.asarray(hi1))
