@@ -270,26 +270,80 @@ def dbscan_fixed_size(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("min_samples", "block", "precision", "layout",
-                     "pair_budget"),
+    static_argnames=("block", "precision", "layout", "pair_budget"),
 )
-def dbscan_prepare_pallas(
-    points, eps, min_samples, mask, *, block, precision, layout,
-    pair_budget=None,
-):
-    """Pair extraction + counts pass + initial propagation state."""
-    from .pallas_kernels import kernel_pair_list, neighbor_counts_pallas
+def _prepare_extract(points, eps, mask, *, block, precision, layout,
+                     pair_budget=None):
+    from .pallas_kernels import kernel_pair_list
 
-    n = points.shape[0] if layout == "nd" else points.shape[1]
-    pairs, pair_stats = kernel_pair_list(
+    return kernel_pair_list(
         points, eps, mask, block, precision, layout, budget=pair_budget
     )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("min_samples", "block", "precision", "layout"),
+)
+def _prepare_counts(points, eps, min_samples, mask, pairs, *, block,
+                    precision, layout):
+    from .pallas_kernels import neighbor_counts_pallas
+
+    n = points.shape[0] if layout == "nd" else points.shape[1]
     counts = neighbor_counts_pallas(
         points, eps, mask, block=block, precision=precision, layout=layout,
         pairs=pairs,
     )
     core = (counts >= min_samples) & mask
     f0 = jnp.where(core, jnp.arange(n, dtype=jnp.int32), _INT_INF)
+    return core, f0
+
+
+_compiled_prepare_keys: set = set()
+
+
+def dbscan_prepare_pallas(
+    points, eps, min_samples, mask, *, block, precision, layout,
+    pair_budget=None,
+):
+    """Pair extraction + counts pass + initial propagation state.
+
+    TWO chained device programs, not one jit: the extraction's
+    two-level scan machinery plus the counts kernel in a single module
+    made the axon compile helper die outright (exit 1, no diagnostics)
+    at 50M-point capacities — each half compiles fine alone.
+
+    This function OWNS the first-call compile discipline for both
+    programs (compiling while the device executes poisons the tunneled
+    worker — same rule as the pipeline's staged layout): on the first
+    call for a configuration it syncs the extraction before the counts
+    program compiles, and syncs the counts output before returning so
+    the CALLER's next program (the propagation round) also compiles
+    against an idle device.  The key covers every static that retraces
+    either program — shape, dtype, min_samples, block, precision,
+    layout, pair_budget.  1-element fetches, not block_until_ready
+    (which can return early on tunneled deployments).
+    """
+    import numpy as _np
+
+    key = (
+        points.shape, str(points.dtype), int(min_samples), block,
+        precision, layout, pair_budget,
+    )
+    first = key not in _compiled_prepare_keys
+    pairs, pair_stats = _prepare_extract(
+        points, eps, mask, block=block, precision=precision, layout=layout,
+        pair_budget=pair_budget,
+    )
+    if first:
+        _np.asarray(pair_stats)
+    core, f0 = _prepare_counts(
+        points, eps, min_samples, mask, pairs, block=block,
+        precision=precision, layout=layout,
+    )
+    if first:
+        _np.asarray(core[:1])
+        _compiled_prepare_keys.add(key)
     return pairs, pair_stats, core, f0
 
 

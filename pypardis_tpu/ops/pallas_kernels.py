@@ -28,10 +28,15 @@ primitives — eps-neighbor counting and min-label-over-neighbors — as
   consumed immediately by the compare-and-reduce in registers, so the
   N x N interaction never touches HBM.
 
-Layout: coordinates travel **transposed** as ``(nt, d, block)`` — the
-big point axis minor, dense in HBM for any d; per-point scalars
-(labels) and outputs travel as ``(nt, 1, block)`` rows.  Labels ride as
-int32 (sentinel INT32_MAX), so any shard size up to HBM capacity is
+Layout: coordinates stay in the drivers' ``(d, N)`` transposed layout —
+the big point axis minor, dense in HBM for any d — and kernel BlockSpecs
+index (d, block) column blocks out of it DIRECTLY.  No tile-transposed
+copy, no masked copy, and no dump-block concat ever materializes
+(together those were ~12-18GB of HLO temps at 50M x 16-D — the round-4
+single-chip ceiling); padding pairs clamp their index maps to a real
+block and skip compute.  Per-point scalars (labels, validity) and
+outputs travel as ``(nt, 1, block)`` rows.  Labels ride as int32
+(sentinel INT32_MAX), so any shard size up to HBM capacity is
 supported.
 
 Numerics:
@@ -52,10 +57,13 @@ Numerics:
 * ``precision="highest"`` uses native HIGHEST; ``"default"`` a single
   bf16 pass (fast, ~2^-8-relative — opt-in only).
 
-Masking convention: invalid points get coordinates ``BIG`` (squared
-distance overflows past any eps) before entering the kernel; no boolean
-mask ever does.  Padding entries of the pair list carry row ``nt`` —
-a dump output row sliced off by the caller.
+Masking convention: coordinates enter the kernels UNMASKED.  Column
+validity applies inside the count kernel from tiny per-tile int32 mask
+blocks; the minlab kernel's source restriction and validity ride
+entirely on the label sentinel (a non-source or invalid point's
+INT32_MAX never wins a min); invalid ROW outputs are garbage the
+callers mask.  Padding entries of the pair list carry row ``nt`` — a
+dump output row sliced off by the caller.
 
 Only the Euclidean metric goes through Pallas (cityblock has no matmul
 decomposition and stays on the XLA path).
@@ -71,10 +79,9 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _INT_INF = jnp.iinfo(jnp.int32).max
-# Masked-out points get these coordinates: BIG^2 = 4e38 overflows fp32
-# (max ~3.4e38) to inf, so a valid-vs-masked pair has d2 = inf and a
-# masked-vs-masked pair d2 = inf - inf = NaN — either way the <= eps^2
-# adjacency test is False.
+# Sentinel for empty-tile bounding boxes (_bounds_dn): inverted
+# (+BIG, -BIG) boxes put their gap to anything astronomically past any
+# eps, so empty tiles always prune.
 BIG = jnp.float32(2e19)
 
 _PRECISION_MODES = ("default", "high", "highest")
@@ -145,7 +152,7 @@ def _first_visit(rows_ref):
 
 
 def _count_pairs_kernel(rows_ref, cols_ref, eps2_ref, c_ref, x_ref, y_ref,
-                        out_ref, *, mode, nt):
+                        m_ref, out_ref, *, mode, nt):
     eps2 = eps2_ref[0]
     # Recentre the pair on the output tile's box center: operand
     # magnitudes become tile-local, keeping the matmul expansion's
@@ -164,12 +171,19 @@ def _count_pairs_kernel(rows_ref, cols_ref, eps2_ref, c_ref, x_ref, y_ref,
         out_ref[0] = jnp.zeros_like(out_ref[0])
 
     # Padding pairs carry row == nt: skip their (block x block) matmul
-    # entirely (their index maps dump, but the FLOPs would be real —
+    # entirely (their index maps clamp, but the FLOPs would be real —
     # at small N padding dominates the budget).
     @pl.when(real)
     def _():
-        d2 = _dot_t(_aug_src(y_ref[0], c), _aug_out(x_ref[0], c), mode)
-        adj = (d2 <= eps2).astype(jnp.int32)
+        # x/y are (d, block) blocks indexed straight out of the (d, N)
+        # operand — no tile-transposed copy exists anywhere.
+        d2 = _dot_t(_aug_src(y_ref[...], c), _aug_out(x_ref[...], c), mode)
+        # Column validity rides as a tiny int32 block applied HERE, in
+        # VMEM, instead of as a full-size masked copy of the
+        # coordinates in HBM (the r4 50M compile-OOM).  Invalid ROW
+        # points produce garbage counts; callers mask rows anyway.
+        valid_col = jnp.transpose(m_ref[0], (1, 0)) > 0
+        adj = ((d2 <= eps2) & valid_col).astype(jnp.int32)
         out_ref[0] += jnp.sum(adj, axis=0, keepdims=True)
 
 
@@ -186,7 +200,7 @@ def _minlab_pairs_kernel(rows_ref, cols_ref, eps2_ref, c_ref, x_ref, y_ref,
 
     @pl.when(real)
     def _():
-        d2 = _dot_t(_aug_src(y_ref[0], c), _aug_out(x_ref[0], c), mode)
+        d2 = _dot_t(_aug_src(y_ref[...], c), _aug_out(x_ref[...], c), mode)
         lab_col = jnp.transpose(lab_ref[0], (1, 0))
         cand = jnp.where(d2 <= eps2, lab_col, _INT_INF)
         out_ref[0] = jnp.minimum(
@@ -194,54 +208,58 @@ def _minlab_pairs_kernel(rows_ref, cols_ref, eps2_ref, c_ref, x_ref, y_ref,
         )
 
 
-def _tiles_t(points, block, layout):
-    """Transposed tiles (nt, d, block) from (N, d) or (d, N) input."""
+def _points_dn(points, layout):
+    """The kernels' canonical (d, N) float32 operand layout.
+
+    For ``layout="dn"`` float32 input this is the identity — the
+    kernels' BlockSpecs index tile columns of this array DIRECTLY, so
+    no (nt, d, block) tile copy ever materializes (that copy was a
+    5.96GB HLO temp in every kernel-calling program at 50M x 16-D,
+    the round-4 HBM ceiling).  ``layout="nd"`` callers pay one
+    transpose — they are the small paths.
+    """
     if layout == "nd":
-        n, d = points.shape
-        nt = n // block
-        return points.astype(jnp.float32).reshape(nt, block, d).transpose(
-            0, 2, 1
-        )
-    d, n = points.shape
-    nt = n // block
-    return points.astype(jnp.float32).reshape(d, nt, block).transpose(1, 0, 2)
+        return points.astype(jnp.float32).T
+    return points.astype(jnp.float32)
 
 
-# Tile-axis chunk for _masked_bounds: bounds the two full-grid where()
-# temps the masked reduce materializes — at 50M x 16-D (cap2 ~100M
-# after segment-break padding) the unchunked form needed 2 x 5.96GB of
-# HLO temps and the prepare program compile-failed at 12.29GB on the
-# 16GB chip.
+# Tile-axis chunk for _bounds_dn: keeps the masked reduce's where()
+# temps at O(chunk) instead of O(dataset) — at 50M x 16-D (cap2 ~100M
+# after segment-break padding) an unchunked masked reduce needed
+# 2 x 5.96GB of HLO temps and compile-failed on the 16GB chip.
 _BOUNDS_CHUNK_ELEMS = 1 << 26
 
 
-def _masked_bounds(tiles, mask_t):
-    """(nt, d) lower/upper bounds over masked points; empty tiles get
-    inverted (+BIG, -BIG) boxes so they always prune.
+def _bounds_dn(pts_dn, mask, nt, block):
+    """(nt, d) masked per-tile bounds straight off the (d, N) layout.
 
-    Chunked over the tile axis: the masked reduce's where() temps stay
-    O(chunk) instead of O(full grid); the last chunk overlaps its
-    predecessor (clamped start) and rewrites identical values.
+    Empty tiles get inverted (+BIG, -BIG) boxes so they always prune.
+    Chunked over tiles; the last chunk overlaps its predecessor
+    (clamped start) and rewrites identical values.
     """
-    nt, d, b = tiles.shape
+    d, n = pts_dn.shape
 
-    def direct(tc, mc):
-        lo = jnp.min(jnp.where(mc, tc, BIG), axis=2)
-        hi = jnp.max(jnp.where(mc, tc, -BIG), axis=2)
-        return lo, hi
+    def direct(start_col, width):
+        seg = jax.lax.dynamic_slice(
+            pts_dn, (0, start_col), (d, width * block)
+        ).reshape(d, width, block)
+        msk = jax.lax.dynamic_slice(
+            mask, (start_col,), (width * block,)
+        ).reshape(1, width, block)
+        lo = jnp.min(jnp.where(msk, seg, BIG), axis=2).T
+        hi = jnp.max(jnp.where(msk, seg, -BIG), axis=2).T
+        return lo, hi  # (width, d)
 
-    chunk = max(1, _BOUNDS_CHUNK_ELEMS // max(d * b, 1))
+    chunk = max(1, _BOUNDS_CHUNK_ELEMS // max(d * block, 1))
     if nt <= chunk:
-        return direct(tiles, mask_t)
+        return direct(0, nt)
 
     nc = -(-nt // chunk)
 
     def body(carry, c):
         lo_all, hi_all = carry
         s = jnp.minimum(c * chunk, nt - chunk)
-        tc = jax.lax.dynamic_slice_in_dim(tiles, s, chunk, axis=0)
-        mc = jax.lax.dynamic_slice_in_dim(mask_t, s, chunk, axis=0)
-        lo, hi = direct(tc, mc)
+        lo, hi = direct(s * block, chunk)
         return (
             jax.lax.dynamic_update_slice(lo_all, lo, (s, 0)),
             jax.lax.dynamic_update_slice(hi_all, hi, (s, 0)),
@@ -253,6 +271,14 @@ def _masked_bounds(tiles, mask_t):
     )
     (lo, hi), _ = jax.lax.scan(body, init, jnp.arange(nc))
     return lo, hi
+
+
+def _centers_dn(pts_dn, mask, nt, block):
+    """Per-tile recentring points: box centers of valid coords,
+    (nt, d, 1).  Empty tiles carry inverted bounds whose midpoint is
+    0 — recentring is a no-op there."""
+    lo, hi = _bounds_dn(pts_dn, mask, nt, block)
+    return (0.5 * (lo + hi))[:, :, None]
 
 
 def _pallas_block(block: int, n: int, d: int, mode: str = "high") -> int:
@@ -321,38 +347,48 @@ def _pair_call(kernel, nt, d, block, n_extra_in, interpret, identity,
     """
 
     def specs(n_pairs):
-        row_keyed = pl.BlockSpec(
+        # INPUT index maps CLAMP the tile index: padding pairs carry
+        # row == nt, and fetching a real (skipped) block beats giving
+        # every input a concatenated dump block — at 50M x 16-D the
+        # dump-block concat plus the masked coordinate copy were
+        # 2 x 5.96GB of HLO temps, an outright compile-OOM.  The
+        # kernels' `real` guard skips all compute for padding pairs;
+        # only the OUTPUT keeps a dump row (it is (nt+1, 1, block)
+        # int32 — small).
+        def rclamp(p, r, c, e):
+            return (jnp.minimum(r[p], nt - 1), 0, 0)
+
+        def cclamp(p, r, c, e):
+            return (jnp.minimum(c[p], nt - 1), 0, 0)
+
+        # Coordinate blocks index the (d, N) operand directly: block
+        # (d, block) at column-block min(idx, nt-1).
+        def rclamp2(p, r, c, e):
+            return (0, jnp.minimum(r[p], nt - 1))
+
+        def cclamp2(p, r, c, e):
+            return (0, jnp.minimum(c[p], nt - 1))
+
+        row_keyed_out = pl.BlockSpec(
             (1, 1, block), lambda p, r, c, e: (r[p], 0, 0),
             memory_space=pltpu.VMEM,
         )
         in_specs = [
             # per-row-tile recentring center, (nt, d, 1)
-            pl.BlockSpec(
-                (1, d, 1), lambda p, r, c, e: (r[p], 0, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            # output-side coordinate tile (rows)
-            pl.BlockSpec(
-                (1, d, block), lambda p, r, c, e: (r[p], 0, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            # source-side coordinate tile (cols)
-            pl.BlockSpec(
-                (1, d, block), lambda p, r, c, e: (c[p], 0, 0),
-                memory_space=pltpu.VMEM,
-            ),
+            pl.BlockSpec((1, d, 1), rclamp, memory_space=pltpu.VMEM),
+            # output-side coordinate tile (rows), from the (d, N) array
+            pl.BlockSpec((d, block), rclamp2, memory_space=pltpu.VMEM),
+            # source-side coordinate tile (cols), from the (d, N) array
+            pl.BlockSpec((d, block), cclamp2, memory_space=pltpu.VMEM),
         ] + [
-            # per-point int32 rows keyed by the col tile (labels)
-            pl.BlockSpec(
-                (1, 1, block), lambda p, r, c, e: (c[p], 0, 0),
-                memory_space=pltpu.VMEM,
-            )
+            # per-point int32 rows keyed by the col tile (labels/masks)
+            pl.BlockSpec((1, 1, block), cclamp, memory_space=pltpu.VMEM)
         ] * n_extra_in
         return pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=3,
             grid=(n_pairs,),
             in_specs=in_specs,
-            out_specs=row_keyed,
+            out_specs=row_keyed_out,
         )
 
     def one_call(rows, cols, eps2, arrays):
@@ -396,25 +432,6 @@ def _pair_call(kernel, nt, d, block, n_extra_in, interpret, identity,
     return call
 
 
-def _with_dump_block(a):
-    """Append one zero block along the tile axis: the dump target for
-    padding pairs (row == nt).  Index maps must stay in bounds — an OOB
-    block index is an HBM fault, not a clamp."""
-    return jnp.concatenate(
-        [a, jnp.zeros((1,) + a.shape[1:], a.dtype)], axis=0
-    )
-
-
-def _centers(tiles, mask_t):
-    """Per-tile recentring points: box centers of valid coords, (nt, d, 1).
-
-    Empty tiles carry inverted (+BIG, -BIG) bounds whose midpoint is 0 —
-    recentring is a no-op there.
-    """
-    lo, hi = _masked_bounds(tiles, mask_t)
-    return (0.5 * (lo + hi))[:, :, None]
-
-
 def kernel_pair_list(
     points, eps, mask, block: int, precision, layout: str,
     budget: int | None = None, src_mask=None,
@@ -422,7 +439,7 @@ def kernel_pair_list(
     """Live tile-pair list sized to the kernels' OWN tile grid.
 
     The single place that knows how the Pallas kernels tile their input
-    (``_pallas_block`` + ``_tiles_t`` + ``_masked_bounds``): callers
+    (``_pallas_block`` + ``_points_dn`` + ``_bounds_dn``): callers
     running several passes over one point set extract here once and
     hand ``pairs`` to every kernel call, guaranteed consistent with the
     grid the kernels build from the same arguments.  ``src_mask``
@@ -436,13 +453,12 @@ def kernel_pair_list(
     n, d = _shape_nd(points, layout)
     pb = _pallas_block(block, n, d, _norm_precision_mode(precision))
     nt = n // pb
-    tiles = _tiles_t(points, pb, layout)
-    mask_t = mask.reshape(nt, 1, pb)
-    lo, hi = _masked_bounds(tiles, mask_t)
+    pts_dn = _points_dn(points, layout)
+    lo, hi = _bounds_dn(pts_dn, mask, nt, pb)
     if src_mask is None:
         lo_col, hi_col = None, None
     else:
-        lo_col, hi_col = _masked_bounds(tiles, src_mask.reshape(nt, 1, pb))
+        lo_col, hi_col = _bounds_dn(pts_dn, src_mask, nt, pb)
     if budget is None:
         budget = default_pair_budget(nt)
     budget = min(budget, nt * nt)
@@ -482,10 +498,9 @@ def neighbor_counts_pallas(
     block = _pallas_block(block, n, d, mode)
     assert n % block == 0, (n, block)
     nt = n // block
-    tiles = _tiles_t(points, block, layout)
+    pts_dn = _points_dn(points, layout)
     mask_t = mask.reshape(nt, 1, block)
-    ycols = jnp.where(mask_t, tiles, BIG)
-    centers = _centers(tiles, mask_t)
+    centers = _centers_dn(pts_dn, mask, nt, block)
     poison = None
     if pairs is None:
         pairs, stats = kernel_pair_list(
@@ -494,14 +509,17 @@ def neighbor_counts_pallas(
         poison = stats[0] > stats[1]
     rows, cols = pairs
     eps2 = jnp.asarray(eps, jnp.float32).reshape(1) ** 2
-    # Padding pairs carry row == nt: every row-keyed input needs a real
-    # block there (an OOB index map is an HBM fault, not a clamp).
-    ycols_x = _with_dump_block(ycols)
+    # Coordinates go in UNMASKED and UNTILED — the kernel blocks index
+    # the (d, N) layout directly (column validity applies inside the
+    # kernel from the tiny int32 mask blocks; padding pairs fetch
+    # clamped real blocks and skip compute).  No dump-block concats,
+    # no masked copy, no tile-transposed copy: the kernel program
+    # carries NO dataset-sized temps at all.
     counts = _pair_call(
         functools.partial(_count_pairs_kernel, mode=mode, nt=nt),
-        nt, d, block, 0, interpret,
+        nt, d, block, 1, interpret,
         identity=0, combine=jnp.add,
-    )(rows, cols, eps2, _with_dump_block(centers), ycols_x, ycols_x)
+    )(rows, cols, eps2, centers, pts_dn, pts_dn, mask_t.astype(jnp.int32))
     counts = jnp.where(mask, counts[:nt].reshape(-1), 0)
     if poison is not None:
         counts = jnp.where(poison, -1, counts)
@@ -542,18 +560,12 @@ def min_neighbor_label_pallas(
     block = _pallas_block(block, n, d, mode)
     assert n % block == 0, (n, block)
     nt = n // block
-    tiles = _tiles_t(points, block, layout)
+    pts_dn = _points_dn(points, layout)
     if row_mask is None:
         rm_flat = jnp.ones(n, bool)
     else:
         rm_flat = row_mask
-    rm = rm_flat.reshape(nt, 1, block)
-    # The same array is row and source operand; keep coordinates real
-    # wherever EITHER mask holds so a source outside row_mask is never
-    # silently lost (its label sentinel alone governs participation).
-    src_t = src_mask.reshape(nt, 1, block)
-    ycols = jnp.where(rm | src_t, tiles, BIG)
-    centers = _centers(tiles, rm)
+    centers = _centers_dn(pts_dn, rm_flat, nt, block)
     poison = None
     if pairs is None:
         pairs, stats = kernel_pair_list(
@@ -564,15 +576,17 @@ def min_neighbor_label_pallas(
     rows, cols = pairs
     labi = jnp.where(src_mask, labels, _INT_INF).reshape(nt, 1, block)
     eps2 = jnp.asarray(eps, jnp.float32).reshape(1) ** 2
-    ycols_x = _with_dump_block(ycols)
+    # Unmasked coordinates: source restriction and validity both ride
+    # on the label sentinel (labi above — a non-source or invalid
+    # point's INT32_MAX never wins a min), and rows outside row_mask
+    # return garbage callers mask anyway.  No masked coordinate copy,
+    # no dump-block concats (clamped index maps) — see
+    # neighbor_counts_pallas.
     best = _pair_call(
         functools.partial(_minlab_pairs_kernel, mode=mode, nt=nt),
         nt, d, block, 1, interpret,
         identity=_INT_INF, combine=jnp.minimum,
-    )(
-        rows, cols, eps2, _with_dump_block(centers), ycols_x,
-        ycols_x, _with_dump_block(labi),
-    )
+    )(rows, cols, eps2, centers, pts_dn, pts_dn, labi)
     best = best[:nt].reshape(-1)
     if poison is not None:
         best = jnp.where(poison, jnp.iinfo(jnp.int32).min, best)

@@ -43,11 +43,11 @@ import numpy as np
 
 from .labels import dbscan_fixed_size
 
-# Shapes/configs whose stage-2 / stepped-round programs have already
-# been compiled — see dbscan_device_pipeline for why the first call
-# must sync between stages on this deployment.
+# Shapes/configs whose stage-2 programs have already been compiled —
+# see dbscan_device_pipeline for why the first call must sync between
+# stages on this deployment.  (The stepped path's equivalent discipline
+# lives inside labels.dbscan_prepare_pallas.)
 _compiled_pipeline_keys: set = set()
-_compiled_step_keys: set = set()
 
 # Point-axis chunk for the Morton word interleave (see
 # _device_morton_words): bounds XLA's live temps at big caps.
@@ -454,25 +454,20 @@ def _cluster_stepped(
     )
 
     kw = dict(block=block, precision=precision, layout="dn")
-    step_key = (xs.shape, block, precision, pair_budget)
-    first = step_key not in _compiled_step_keys
 
     def run_prepare():
-        out = dbscan_prepare_pallas(
+        # The compile/sync discipline for the two prepare programs AND
+        # for the round program's first compile lives inside
+        # dbscan_prepare_pallas (it syncs its outputs on the first call
+        # for a configuration, so the device is idle when the round
+        # program's compile starts here).
+        return dbscan_prepare_pallas(
             xs, eps, min_samples, mask_k, pair_budget=pair_budget, **kw
         )
-        if first:
-            # Device must be idle before the round program's first
-            # compile — a compile concurrent with device execution
-            # poisons the worker on this deployment (later executions
-            # fail INVALID_ARGUMENT or the worker dies outright).
-            np.asarray(out[1])
-        return out
 
     (rows, cols), pair_stats, core, f = _transient_retry(
         "prepare", run_prepare
     )
-    _compiled_step_keys.add(step_key)
     g = None
     converged = False
     for _ in range(MAX_ROUNDS):

@@ -125,18 +125,24 @@ def test_morton_words_chunked_matches_direct(monkeypatch):
         np.testing.assert_array_equal(d, c)
 
 
-def test_masked_bounds_chunked_matches_direct(monkeypatch):
-    """Chunked tile-bounds (HBM-bounded masked reduce) must equal the
-    direct computation, including the clamped-overlap last chunk."""
+def test_bounds_dn_chunked_matches_direct(monkeypatch):
+    """Chunked tile-bounds (HBM-bounded masked reduce off the (d, N)
+    layout) must equal the direct computation, including the
+    clamped-overlap last chunk, and must match a numpy oracle."""
     import jax.numpy as jnp
 
     import pypardis_tpu.ops.pallas_kernels as pk
 
     rng = np.random.default_rng(4)
-    tiles = jnp.asarray(rng.normal(size=(13, 3, 32)).astype(np.float32))
-    mask_t = jnp.asarray(rng.random((13, 1, 32)) < 0.8)
-    lo0, hi0 = pk._masked_bounds(tiles, mask_t)
-    monkeypatch.setattr(pk, "_BOUNDS_CHUNK_ELEMS", 5 * 3 * 32)  # chunk=5
-    lo1, hi1 = pk._masked_bounds(tiles, mask_t)
+    nt, d, block = 13, 3, 32
+    pts = rng.normal(size=(d, nt * block)).astype(np.float32)
+    mask = rng.random(nt * block) < 0.8
+    lo0, hi0 = pk._bounds_dn(jnp.asarray(pts), jnp.asarray(mask), nt, block)
+    monkeypatch.setattr(pk, "_BOUNDS_CHUNK_ELEMS", 5 * d * block)  # chunk=5
+    lo1, hi1 = pk._bounds_dn(jnp.asarray(pts), jnp.asarray(mask), nt, block)
     np.testing.assert_array_equal(np.asarray(lo0), np.asarray(lo1))
     np.testing.assert_array_equal(np.asarray(hi0), np.asarray(hi1))
+    # numpy oracle on a non-empty tile
+    seg = pts[:, :block][:, mask[:block]]
+    np.testing.assert_allclose(np.asarray(lo0)[0], seg.min(axis=1))
+    np.testing.assert_allclose(np.asarray(hi0)[0], seg.max(axis=1))
