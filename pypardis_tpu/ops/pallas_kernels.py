@@ -545,15 +545,18 @@ def min_neighbor_label_pallas(
     """Pallas analogue of
     :func:`pypardis_tpu.ops.distances.min_neighbor_label` (Euclidean).
 
-    Labels travel as int32 with sentinel INT32_MAX.  The coordinate
-    operand is masked by ``row_mask`` (validity); source restriction to
-    ``src_mask`` rides on the label sentinel — a non-source's INT32_MAX
-    never wins a min — so rows and columns share one array.  Rows
-    outside ``row_mask`` may return INT32_MAX; callers mask them.  The
-    default (``None``) covers ALL rows.  ``pairs`` as in
-    :func:`neighbor_counts_pallas` (a pair list covering validity boxes
-    is a superset of any src subset, so sharing one list is sound); a
-    truncated self-extracted list poisons every row to INT32_MIN.
+    Labels travel as int32 with sentinel INT32_MAX.  Coordinates enter
+    UNMASKED; both validity and source restriction to ``src_mask`` ride
+    on the label sentinel (a non-source or invalid point's INT32_MAX
+    never wins a min), so rows and columns share one array.  Rows
+    outside ``row_mask`` return ARBITRARY values (their leftover
+    coordinates may sit within eps of real points) — callers MUST mask
+    them out, never test against the sentinel alone.  ``row_mask`` only
+    tightens the per-tile pruning boxes; the default (``None``) covers
+    ALL rows.  ``pairs`` as in :func:`neighbor_counts_pallas` (a pair
+    list covering validity boxes is a superset of any src subset, so
+    sharing one list is sound); a truncated self-extracted list poisons
+    every row to INT32_MIN.
     """
     n, d = _shape_nd(points, layout)
     mode = _norm_precision_mode(precision)

@@ -399,6 +399,11 @@ STEP_THRESHOLD = int(
     __import__("os").environ.get("PYPARDIS_STEP_THRESHOLD", 1 << 25)
 )
 MAX_ROUNDS = 64
+# Propagation rounds fused per stepped device call (see
+# _cluster_stepped): divides the per-call sync latency by the batch.
+ROUND_BATCH = int(
+    __import__("os").environ.get("PYPARDIS_ROUND_BATCH", 8)
+)
 
 
 def _default_transient(e: BaseException) -> bool:
@@ -450,7 +455,7 @@ def _cluster_stepped(
     from .labels import (
         dbscan_border_pallas,
         dbscan_prepare_pallas,
-        dbscan_round_pallas,
+        dbscan_rounds_pallas,
     )
 
     kw = dict(block=block, precision=precision, layout="dn")
@@ -470,17 +475,39 @@ def _cluster_stepped(
     )
     g = None
     converged = False
-    for _ in range(MAX_ROUNDS):
-        def one_round(f=f):
-            out = dbscan_round_pallas(
-                xs, f, eps, core, mask_k, rows, cols, **kw
+    # ROUND_BATCH propagation rounds per device call: the per-call
+    # convergence-flag sync costs ~0.2-2s of tunnel latency, which at
+    # 50M points dominated the whole fit when paid per round.  Each
+    # call still runs only seconds (bounded by the batch), far below
+    # the worker watchdog that motivates host stepping.
+    import time as _time
+
+    # Watchdog ceiling: a single degraded round at ~100M capacity can
+    # run the better part of a minute, and a full 8-round batch at that
+    # size crashed the worker outright (round-4 measurement) — scale
+    # the batch down with capacity so one call stays safely short.
+    batch_k = max(1, min(ROUND_BATCH, (1 << 27) // max(xs.shape[1], 1)))
+    batches = 0
+    t_rounds = _time.perf_counter()
+    for _ in range(-(-MAX_ROUNDS // batch_k)):
+        def some_rounds(f=f):
+            out = dbscan_rounds_pallas(
+                xs, f, eps, core, mask_k, rows, cols,
+                k_rounds=batch_k, **kw
             )
             return out + (bool(out[2]),)  # sync inside the retry scope
 
-        f, g, _, changed = _transient_retry("round", one_round)
-        if not changed:  # the sync also bounds per-call length
+        f, g, _, changed = _transient_retry("round", some_rounds)
+        batches += 1
+        if not changed:  # the last executed round was a fixpoint
             converged = True
             break
+    from ..utils.log import log_phase
+
+    log_phase(
+        "stepped_rounds", batches=batches, batch_size=batch_k,
+        converged=converged, seconds=round(_time.perf_counter() - t_rounds, 2),
+    )
     if not converged:
         g = _transient_retry(
             "border",
