@@ -284,6 +284,16 @@ def _pad_and_run(
     return roots[:n], core[:n]
 
 
+def _expanded_neighbors(tree, points, eps) -> Dict:
+    """{partition label -> point indices in its 2*eps-expanded box} —
+    the single constructor of the ``neighbors`` parity surface for BOTH
+    sharded routes (host eager, device lazy-on-access)."""
+    from .partition import expanded_members
+
+    members = expanded_members(tree, np.asarray(points), 2 * eps)
+    return {l: members[l][0] for l in sorted(members)}
+
+
 def _partition_cluster_dict(parts: np.ndarray, labels: np.ndarray) -> Dict:
     """{"partition:cluster" -> global id} parity codes (reference
     ``cluster_dict``, dbscan.py:99-102): the global dense label doubles
@@ -455,6 +465,36 @@ class DBSCAN:
         return self.fit(X).labels_
 
     @property
+    def neighbors(self):
+        """{partition label -> indices of the points in its
+        2*eps-expanded box} — the reference's per-label neighborhood
+        RDDs (dbscan.py:141-151) as index arrays, with ONE meaning on
+        every route.  The device-resident sharded route computes it
+        lazily on first access (its halos live on device as tight-box
+        slabs; the parity surface replays the split tree host-side,
+        which requires fetching the coordinates once — an opt-in
+        O(N*k) transfer, never paid by fit itself).  Derives from
+        ``self.data``/``self.partitioner_`` rather than pinning a
+        second reference to the device array: clearing ``model.data``
+        releases the HBM and simply disables this surface."""
+        if self._neighbors is None and self._neighbors_lazy:
+            if self.data is None or self.partitioner_ is None:
+                raise RuntimeError(
+                    "neighbors needs the training data; model.data was "
+                    "cleared after a device-resident fit"
+                )
+            self._neighbors = _expanded_neighbors(
+                self.partitioner_.tree, self.data, self.eps
+            )
+            self._neighbors_lazy = False
+        return self._neighbors
+
+    @neighbors.setter
+    def neighbors(self, value):
+        self._neighbors = value
+        self._neighbors_lazy = False
+
+    @property
     def result(self):
         """Key-sorted [(key, global label)] — the reference's cached
         ``sortByKey()`` product (dbscan.py:162-165), built on first
@@ -595,10 +635,7 @@ class DBSCAN:
         # {"partition:cluster" -> global id}; the sharded path has no
         # partition-local ids after the in-graph merge, so the global
         # dense label doubles as the per-partition cluster id.
-        from .partition import expanded_members
-
-        members = expanded_members(part.tree, points, 2 * self.eps)
-        self.neighbors = {l: members[l][0] for l in sorted(members)}
+        self.neighbors = _expanded_neighbors(part.tree, points, self.eps)
         self.cluster_dict = _partition_cluster_dict(
             part.result, self.labels_
         )
@@ -662,10 +699,13 @@ class DBSCAN:
         self.expanded_boxes = {
             l: b.expand(2 * self.eps) for l, b in boxes.items()
         }
-        # The device path never materializes expanded membership
-        # host-side (tight-box halos live only on device), so
-        # ``neighbors`` lists each partition's OWNED points.
-        self.neighbors = dict(part.partitions)
+        # ``neighbors`` keeps the expanded-membership meaning of every
+        # other route (round-4 advisor: the attribute silently changed
+        # meaning with input residency) — computed lazily on first
+        # access, because it needs the host coordinates the device fit
+        # deliberately never fetches.
+        self.neighbors = None
+        self._neighbors_lazy = True
         self.cluster_dict = _partition_cluster_dict(pid_np, self.labels_)
 
     def save(self, path: str) -> None:
@@ -695,16 +735,31 @@ class DBSCAN:
         partition 0 otherwise), so the aggregator's ``fwd``/``rev``
         reflect the actual partition structure rather than a fabricated
         single-partition view (round-2 review, Weak #8).
+
+        Vectorized (round-4 review, Weak #7: the per-point ``agg +
+        (key, [label])`` loop took minutes after a 10M-point fit):
+        every point carries exactly ONE core label here, so the
+        aggregator never merges — each distinct "partition:cluster"
+        pair simply receives the next fresh global id in first-seen
+        point order.  One ``np.unique`` reproduces that state exactly;
+        a regression test pins it against the loop.
         """
         agg = ClusterAggregator()
         if self.labels_ is not None:
             parts = (
-                self.partitioner_.result
+                np.asarray(self.partitioner_.result)
                 if self.partitioner_ is not None
                 else np.zeros(len(self.labels_), np.int32)
             )
-            for key, part, label in zip(self._keys, parts, self.labels_):
-                if label >= 0:
-                    agg + (key, [f"{int(part)}:{label}"])
+            labels = np.asarray(self.labels_)
+            sel = labels >= 0
+            codes = (
+                parts[sel].astype(np.int64) << 32
+                | labels[sel].astype(np.int64)
+            )
+            uniq, first = np.unique(codes, return_index=True)
+            for gid, c in enumerate(uniq[np.argsort(first, kind="stable")]):
+                agg[f"{int(c) >> 32}:{int(c) & 0xFFFFFFFF}"] = gid
+            agg.next_global_id = len(uniq)
         self.cluster_dict = dict(agg.fwd)
         return agg

@@ -30,7 +30,8 @@ _INT_INF = jnp.iinfo(jnp.int32).max
 
 
 def resolve_backend(
-    backend: str, metric: str, n: int = 0, block: int = 1
+    backend: str, metric: str, n: int = 0, block: int = 1,
+    d: int = 2, precision: str = "high",
 ) -> str:
     """Resolve "auto" to "pallas" on TPU (Euclidean only) else "xla".
 
@@ -39,19 +40,27 @@ def resolve_backend(
     the pure-XLA tiled path with identical semantics.  Problems smaller
     than a few tiles also stay on XLA: a hand-scheduled kernel buys
     nothing there, and sub-millisecond XLA programs sidestep launch
-    overhead entirely.
+    overhead entirely.  Configs whose effective tile Mosaic cannot lower
+    (trailing block dim not a multiple of 128 — e.g. user block=64, or
+    an n with no 128-multiple divisor) also resolve to "xla"
+    deliberately, instead of paying a lowering-failure/fallback cycle.
     """
     from .distances import _norm_metric
 
     metric = _norm_metric(metric)
     if backend == "auto":
-        return (
-            "pallas"
-            if metric == "euclidean"
+        if (
+            metric == "euclidean"
             and jax.default_backend() == "tpu"
             and n >= 4 * block
-            else "xla"
-        )
+        ):
+            from .pallas_kernels import _norm_precision_mode, effective_tile
+
+            if effective_tile(
+                block, n, d, _norm_precision_mode(precision)
+            ) is not None:
+                return "pallas"
+        return "xla"
     if backend not in ("xla", "pallas"):
         raise ValueError(f"backend must be auto|xla|pallas, got {backend!r}")
     if backend == "pallas" and metric != "euclidean":
@@ -155,11 +164,23 @@ def dbscan_fixed_size(
     if layout not in ("nd", "dn"):
         raise ValueError(f"layout must be 'nd' or 'dn', got {layout!r}")
     n = points.shape[0] if layout == "nd" else points.shape[1]
-    if resolve_backend(backend, metric, n, block) == "pallas":
+    d = points.shape[1] if layout == "nd" else points.shape[0]
+    if resolve_backend(backend, metric, n, block, d, precision) == "pallas":
         from .pallas_kernels import (
+            _check_mosaic_tile,
+            _norm_precision_mode,
+            _pallas_block,
             kernel_pair_list,
             min_neighbor_label_pallas,
             neighbor_counts_pallas,
+        )
+
+        # Fail an explicitly-forced illegal tile BEFORE the pair-list
+        # extraction runs (the most expensive pre-pass); 'auto' never
+        # gets here (resolve_backend routes illegal tiles to XLA).
+        _check_mosaic_tile(
+            _pallas_block(block, n, d, _norm_precision_mode(precision)),
+            n, interpret=False,
         )
 
         # Extract the live tile-pair list ONCE; every pass shares it.
@@ -191,13 +212,21 @@ def dbscan_fixed_size(
         # pairs) — drivers treat 0 as "cannot overflow".  With an
         # explicit pair_budget the stats mirror the Pallas overflow
         # contract, which is what lets the drivers' rerun ladder (and
-        # CI, where Mosaic is absent) exercise off-hardware.
+        # CI, where Mosaic is absent) exercise off-hardware.  The count
+        # runs on the SAME effective tile the Pallas extraction would
+        # use (when one exists): the drivers' hint cache keys budgets by
+        # config, not backend, so a hint seeded by one backend must not
+        # over/undershoot the other's grid after a kernel fallback.
         from .distances import count_live_tile_pairs
+        from .pallas_kernels import _norm_precision_mode, effective_tile
 
+        count_block = effective_tile(
+            block, n, d, _norm_precision_mode(precision)
+        ) or block
         pair_stats = jnp.stack(
             [
                 count_live_tile_pairs(
-                    points, mask, eps, metric=metric, block=block,
+                    points, mask, eps, metric=metric, block=count_block,
                     layout=layout,
                 ),
                 jnp.int32(0 if pair_budget is None else pair_budget),

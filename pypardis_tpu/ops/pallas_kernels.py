@@ -309,6 +309,45 @@ def _pallas_block(block: int, n: int, d: int, mode: str = "high") -> int:
     return b
 
 
+def _check_mosaic_tile(block: int, n: int, interpret: bool) -> None:
+    """Fail a Mosaic-illegal tile with a readable error, up front.
+
+    ``backend='auto'`` never reaches here (``resolve_backend`` consults
+    :func:`effective_tile`); an EXPLICIT ``backend='pallas'`` with e.g.
+    block=64 would otherwise surface Mosaic lowering internals.
+    Interpret mode (CPU tests) has no tiling constraint.
+    """
+    if n % block != 0:
+        raise ValueError(f"pallas tile {block} does not divide n={n}")
+    if not interpret and block % 128 != 0:
+        raise ValueError(
+            f"pallas kernels require a tile that is a multiple of 128 "
+            f"(Mosaic constraint on the trailing block dim of the (d, N) "
+            f"layout); effective tile {block} from block/n={n}. "
+            f"Use backend='auto' or 'xla' for this configuration."
+        )
+
+
+def effective_tile(block: int, n: int, d: int, mode: str = "high"):
+    """The tile the Pallas kernels would actually run, or ``None`` when
+    no Mosaic-legal tile exists for this (block, n).
+
+    The kernels BlockSpec-index ``(d, tile)`` column blocks straight off
+    the canonical ``(d, N)`` array, so Mosaic requires the trailing
+    block dim to be a multiple of 128 (the first dim is the full array
+    dim ``d`` and is unconstrained).  ``_pallas_block`` can return a
+    sub-128 or non-dividing tile (user block < 128, or n with no
+    128-multiple divisor, e.g. n=4000): those configs must run the XLA
+    path — :func:`pypardis_tpu.ops.labels.resolve_backend` consults this
+    so ``backend='auto'`` routes them there without a
+    lowering-failure/fallback cycle.
+    """
+    b = _pallas_block(block, n, d, mode)
+    if b % 128 == 0 and n % b == 0:
+        return b
+    return None
+
+
 def _shape_nd(points, layout):
     if layout not in ("nd", "dn"):
         raise ValueError(f"layout must be 'nd' or 'dn', got {layout!r}")
@@ -496,7 +535,7 @@ def neighbor_counts_pallas(
     n, d = _shape_nd(points, layout)
     mode = _norm_precision_mode(precision)
     block = _pallas_block(block, n, d, mode)
-    assert n % block == 0, (n, block)
+    _check_mosaic_tile(block, n, interpret)
     nt = n // block
     pts_dn = _points_dn(points, layout)
     mask_t = mask.reshape(nt, 1, block)
@@ -561,7 +600,7 @@ def min_neighbor_label_pallas(
     n, d = _shape_nd(points, layout)
     mode = _norm_precision_mode(precision)
     block = _pallas_block(block, n, d, mode)
-    assert n % block == 0, (n, block)
+    _check_mosaic_tile(block, n, interpret)
     nt = n // block
     pts_dn = _points_dn(points, layout)
     if row_mask is None:

@@ -580,7 +580,9 @@ def dbscan_device_pipeline(
     capk = xs.shape[1]
     stepped = (
         capk >= STEP_THRESHOLD
-        and resolve_backend(backend, metric, capk, block) == "pallas"
+        and resolve_backend(
+            backend, metric, capk, block, xs.shape[0], precision
+        ) == "pallas"
     )
     if stepped:
         return _cluster_stepped(

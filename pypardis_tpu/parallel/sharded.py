@@ -243,7 +243,9 @@ def _cluster_local_partitions(
     if pts.shape[0] == 1:
         l1, c1, pair_stats = one_part(pts[0], msk[0], backend)
         return l1[None], c1[None], pair_stats
-    if resolve_backend(backend, metric, pts.shape[1], block) == "pallas":
+    if resolve_backend(
+        backend, metric, pts.shape[1], block, pts.shape[2], precision
+    ) == "pallas":
         outs = [
             one_part(pts[i], msk[i], backend) for i in range(pts.shape[0])
         ]
@@ -948,10 +950,23 @@ def sharded_dbscan_device(
         points, pid, counts_dev, p_total=p_total, cap=cap
     )
     two_eps = jnp.float32(2 * eps)
+    # 4-ULP widening matches the host path's _expanded_frame_meta
+    # boundary-tolerance discipline: a plain f32 `lo - 2*eps` can round
+    # the expanded boundary INWARD by 1 ULP, dropping a halo point
+    # sitting exactly on the 2*eps shell that the host route keeps
+    # (borderline core-status divergence between the two routes).
+    exp_lo = lo - two_eps
+    exp_hi = hi + two_eps
+    exp_lo = exp_lo - 4 * (
+        jnp.nextafter(jnp.abs(exp_lo), jnp.float32(jnp.inf)) - jnp.abs(exp_lo)
+    )
+    exp_hi = exp_hi + 4 * (
+        jnp.nextafter(jnp.abs(exp_hi), jnp.float32(jnp.inf)) - jnp.abs(exp_hi)
+    )
     sharding = NamedSharding(mesh, P(axis))
     args = tuple(
         jax.device_put(a, sharding)
-        for a in (owned, msk, gid, lo - two_eps, hi + two_eps)
+        for a in (owned, msk, gid, exp_lo, exp_hi)
     )
     labels, core, m_rounds, used_hcap = _ring_ladder(
         args, eps=eps, min_samples=min_samples, metric=metric, block=block,

@@ -136,9 +136,31 @@ def test_device_boxes_contain_routed_points():
     X = _blobs(n=4000)
     m = DBSCAN(eps=0.4, min_samples=5, block=64)
     m.fit(jax.device_put(X))
-    for label, idx in m.neighbors.items():
+    for label, idx in m.partitioner_.partitions.items():
         box = m.bounding_boxes[label]
         assert box.contains_points(X[idx]).all()
+
+
+def test_device_route_neighbors_expanded_membership():
+    """``neighbors`` means 2*eps-expanded membership on EVERY route
+    (round-4 advisor: the device route used to return owned points) —
+    computed lazily from the split tree on first access."""
+    from pypardis_tpu.partition import expanded_members
+
+    X = _blobs(n=4000)
+    m = DBSCAN(eps=0.4, min_samples=5, block=64)
+    m.fit(jax.device_put(X))
+    assert m._neighbors is None  # fit itself never materialized it
+    members = expanded_members(m.partitioner_.tree, X, 2 * m.eps)
+    assert set(m.neighbors) == set(members)
+    for label, idx in m.neighbors.items():
+        np.testing.assert_array_equal(np.sort(idx),
+                                      np.sort(members[label][0]))
+        # expanded membership is a superset of the owned points
+        owned = m.partitioner_.partitions.get(label, np.empty(0, int))
+        assert np.isin(owned, idx).all()
+        # and everything in it sits inside the expanded parity box
+        assert m.expanded_boxes[label].contains_points(X[idx]).all()
 
 
 def test_sharded_device_rejects_nothing_small():
