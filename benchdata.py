@@ -1,0 +1,95 @@
+"""Shared benchmark data generation WITH ground truth.
+
+Round-4 review, Missing #2: every scale artifact validated by expected
+cluster count and cross-mode label SHAs only — the generator's
+assignment was computed and thrown away.  This module is the single
+generator for ``bench.py`` and every ``scripts/*_probe.py``: it returns
+``(X, truth)`` so each artifact row can carry ``ari_vs_truth`` (free at
+any N), and it owns the SKEWED variant (round-4 Missing #3: log-normal
+cluster populations spanning ~100x with mixed stds — an honest
+stand-in for the GeoLife/KDD density skew of BASELINE configs 3/5,
+which uniform constant-density blobs never exercised).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_CHUNK = 1 << 20
+
+
+def make_blob_data(
+    n: int,
+    dim: int,
+    *,
+    n_centers: int | None = None,
+    pts_per_center: int = 6250,
+    seed: int = 0,
+    spread: float = 10.0,
+    std: float = 0.4,
+    skew: str | None = None,
+):
+    """Gaussian blobs, uniform or density-skewed; returns ``(X, truth)``.
+
+    ``skew=None``: equal-probability center assignment, one ``std`` —
+    the constant-density data every previous round benchmarked.
+
+    ``skew='lognormal'``: cluster POPULATIONS drawn log-normal
+    (sigma=1.15 → ~100x span across 64 centers) and per-cluster stds
+    uniform in [0.65*std, 1.4*std] (a >2x per-axis density ratio, which
+    at 16-D is an astronomically larger volumetric skew).  This stresses
+    exactly what uniform data cannot: partition imbalance (pad_waste),
+    halo factors around dense cores, pair-budget pressure in crowded
+    tiles, and merge depth across population cliffs.  The std range is
+    chosen so every cluster stays well above the DBSCAN core threshold
+    at the benchmark eps — the generating assignment remains a valid
+    oracle (ARI >= 0.99 expected, noise excepted).
+
+    ``truth`` is the (n,) int32 generating assignment.  Memory: X plus
+    one int32 row per point; generation is chunked (no n x dim float64
+    temps), safe at 50M x 16-D.
+    """
+    rng = np.random.default_rng(seed)
+    if n_centers is None:
+        n_centers = max(32, n // pts_per_center)
+    centers = rng.uniform(-spread, spread, size=(n_centers, dim)).astype(
+        np.float32
+    )
+    if skew is None:
+        assign = rng.integers(0, n_centers, size=n, dtype=np.int32)
+        stds = np.full(n_centers, std, np.float32)
+    elif skew == "lognormal":
+        w = rng.lognormal(mean=0.0, sigma=1.15, size=n_centers)
+        p = (w / w.sum()).astype(np.float64)
+        # Chunked inverse-CDF sampling: rng.choice materializes int64
+        # and is slow at 10M+.
+        cdf = np.cumsum(p)
+        cdf[-1] = 1.0
+        assign = np.empty(n, np.int32)
+        for s in range(0, n, _CHUNK):
+            e = min(s + _CHUNK, n)
+            assign[s:e] = np.searchsorted(
+                cdf, rng.random(e - s), side="right"
+            ).astype(np.int32)
+        stds = rng.uniform(0.65 * std, 1.4 * std, size=n_centers).astype(
+            np.float32
+        )
+    else:
+        raise ValueError(f"skew must be None or 'lognormal', got {skew!r}")
+
+    out = centers[assign]
+    for s in range(0, n, _CHUNK):
+        e = min(s + _CHUNK, n)
+        out[s:e] += (
+            rng.normal(size=(e - s, dim)) * stds[assign[s:e], None]
+        ).astype(np.float32)
+    return out, assign
+
+
+def ari_vs_truth(labels, truth) -> float:
+    """Adjusted Rand index of predicted labels vs the generating
+    assignment — the oracle field every benchmark row carries (noise
+    points count as their own ARI class, penalizing spurious noise)."""
+    from sklearn.metrics import adjusted_rand_score
+
+    return float(adjusted_rand_score(truth, labels))

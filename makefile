@@ -19,7 +19,8 @@ native:
 		pypardis_tpu/_native/unionfind.cpp
 
 test:
-	$(PY) -m pytest tests/ -q
+	$(PY) -m pytest tests/ -q -m "not slow"
+	$(PY) -m pytest tests/ -q -m slow
 
 # Hardware validation: compiles + runs the Pallas kernels through Mosaic
 # on the real chip (tests skip themselves off-TPU). Run before shipping

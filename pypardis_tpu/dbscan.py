@@ -568,23 +568,15 @@ class DBSCAN:
         from .parallel.sharded import sharded_dbscan
 
         if _is_device_array(points):
-            if self.merge == "host":
-                # The device route runs ring halo + in-graph merge; an
-                # explicit host merge is honored by fetching the data
-                # and taking the host path (loudly, not silently).
-                get_logger().info(
-                    "merge='host' requested for a device-resident "
-                    "input: fetching the dataset and using the host "
-                    "sharded path"
-                )
-                points = np.asarray(points)
-            else:
-                # Device-resident input never round-trips the
-                # coordinates through the host (the analogue of
-                # train(rdd) on already-distributed data, reference
-                # dbscan.py:104).
-                self._train_sharded_device(points, timer)
-                return
+            # Device-resident input never round-trips the coordinates
+            # through the host (the analogue of train(rdd) on
+            # already-distributed data, reference dbscan.py:104).
+            # merge='host' is honored ON the device route: only the
+            # compact occurrence tables come back for the union-find
+            # (round-4 review, Next #6 — previously this fetched the
+            # whole dataset and bounced to the host path).
+            self._train_sharded_device(points, timer)
+            return
 
         with timer.phase("partition"):
             # max_partitions is a user-facing MAX (reference
@@ -664,6 +656,7 @@ class DBSCAN:
                 backend=self.kernel_backend,
                 max_partitions=self.max_partitions,
                 split_method=self.split_method,
+                merge=self.merge,
             )
         with timer.phase("densify"):
             self.labels_ = densify_labels(labels)

@@ -629,6 +629,26 @@ class _HaloOverflow(Exception):
     """Ring halo buffer dropped in-box points; the hcap ladder retries."""
 
 
+def _host_merge_finish(n, og, own_glab, own_core, halo_gid, halo_glab):
+    """Host-side finish shared by both halo paths under ``merge='host'``:
+    rebuild (N,) home labels/core from the owned tables, then union the
+    halo occurrence tables (:func:`merge.merge_occurrences`)."""
+    from .merge import merge_occurrences
+
+    own_glab = np.asarray(own_glab).reshape(-1)
+    own_core = np.asarray(own_core).reshape(-1)
+    og_flat = np.asarray(og).reshape(-1)
+    sel = og_flat < n
+    home_label = np.full(n, -1, np.int32)
+    home_label[og_flat[sel]] = own_glab[sel]
+    core = np.zeros(n, bool)
+    core[og_flat[sel]] = own_core[sel]
+    labels, _mapping = merge_occurrences(
+        home_label, core, np.asarray(halo_gid), np.asarray(halo_glab)
+    )
+    return labels, core
+
+
 def sharded_dbscan(
     points,
     partitioner,
@@ -665,9 +685,10 @@ def sharded_dbscan(
     merges on the host (:mod:`pypardis_tpu.parallel.merge` — the
     memory-safe path when N-sized replicated arrays stop fitting,
     ~20 bytes/point/device); ``"auto"`` switches to host past
-    ``MERGE_HOST_AUTO`` points.  ``merge="host"`` requires
-    ``halo="host"`` (the ring exchange never materializes halo tables
-    off-device).
+    ``MERGE_HOST_AUTO`` points on EITHER halo path.  Under
+    ``halo="ring"`` the host merge still exchanges halos device-side;
+    only the compact occurrence tables (gid + label per halo slot,
+    ~8 bytes/occurrence) come to the host — never coordinates.
 
     ``pair_budget``: static live tile-pair capacity for the kernels'
     pair extraction; ``None`` consults the shared hint cache
@@ -684,16 +705,11 @@ def sharded_dbscan(
     if merge not in ("auto", "device", "host"):
         raise ValueError(f"merge must be auto|device|host, got {merge!r}")
     if merge == "auto":
-        merge = (
-            "host"
-            if halo != "ring" and len(points) >= MERGE_HOST_AUTO
-            else "device"
-        )
-    if merge == "host" and halo == "ring":
-        raise ValueError(
-            "merge='host' requires halo='host': the ring exchange never "
-            "materializes halo occurrence tables off-device"
-        )
+        # Both halo paths can spill the merge to the host (round-4
+        # review, Next #6: the ring route used to pin merge='device',
+        # so a 100M device-resident fit would replicate ~5 (N+1)-arrays
+        # per device in-graph).
+        merge = "host" if len(points) >= MERGE_HOST_AUTO else "device"
     if mesh is None:
         mesh = default_mesh()
     n_shards = mesh.devices.size
@@ -713,13 +729,26 @@ def sharded_dbscan(
             jax.device_put(a, sharding)
             for a in (*arrays, exp_lo, exp_hi)
         )
-        labels, core, m_rounds, used_hcap = _ring_ladder(
+        out = _ring_ladder(
             args, eps=eps, min_samples=min_samples, metric=metric,
             block=block, mesh=mesh, axis=axis, n_points=len(points),
             precision=precision, backend=backend, hcap=hcap,
             pair_budget=pair_budget, merge_rounds=merge_rounds,
-            cap=int(stats["owned_cap"]),
+            cap=int(stats["owned_cap"]), merge=merge,
         )
+        if merge == "host":
+            tables, _zero, used_hcap = out
+            own_glab, own_core, halo_glab, halo_gid = tables
+            labels, core = _host_merge_finish(
+                len(points), args[2], own_glab, own_core, halo_gid,
+                halo_glab,
+            )
+            stats = dict(
+                stats, halo_exchange="ring", halo_cap=used_hcap,
+                merge="host",
+            )
+            return _canonicalize_roots(labels, core), core, stats
+        labels, core, m_rounds, used_hcap = out
         stats = dict(
             stats, halo_exchange="ring", halo_cap=used_hcap,
             merge_rounds=int(m_rounds), merge_converged=True,
@@ -733,7 +762,6 @@ def sharded_dbscan(
     )
 
     if merge == "host":
-        from .merge import merge_occurrences
 
         def run_step(pb, _mr):
             out = _with_kernel_fallback(
@@ -757,19 +785,10 @@ def sharded_dbscan(
         own_glab, own_core, halo_glab = run_ladders(
             run_step, hint_key, pair_budget, merge_rounds
         )
-        n = len(points)
-        og = arrays[2]  # (P, cap) owned gids; padding slots carry n
-        hg = arrays[5]  # (P, hcap) halo gids
-        own_glab = np.asarray(own_glab).reshape(-1)
-        own_core = np.asarray(own_core).reshape(-1)
-        og_flat = np.asarray(og).reshape(-1)
-        sel = og_flat < n
-        home_label = np.full(n, -1, np.int32)
-        home_label[og_flat[sel]] = own_glab[sel]
-        core = np.zeros(n, bool)
-        core[og_flat[sel]] = own_core[sel]
-        labels, _mapping = merge_occurrences(
-            home_label, core, np.asarray(hg), np.asarray(halo_glab)
+        # arrays[2]: (P, cap) owned gids; arrays[5]: (P, hcap) halo gids
+        labels, core = _host_merge_finish(
+            len(points), arrays[2], own_glab, own_core, arrays[5],
+            halo_glab,
         )
         stats = dict(stats, merge="host")
         return _canonicalize_roots(labels, core), core, stats
@@ -808,11 +827,21 @@ def sharded_dbscan(
 def _ring_ladder(
     args, *, eps, min_samples, metric, block, mesh, axis, n_points,
     precision, backend, hcap, pair_budget, merge_rounds, cap,
+    merge="device",
 ):
     """hcap doubling around the shared pair/rounds ladder for ring-halo
     execution.  ``args``: (owned, mask, gid, exp_lo, exp_hi), already
-    placed with the partition-axis sharding.  Returns ``(labels, core,
-    merge_rounds_used, hcap_used)``.
+    placed with the partition-axis sharding.
+
+    ``merge="device"`` runs the fused ring+cluster+in-graph-merge
+    program and returns ``(labels, core, merge_rounds_used, hcap)``.
+    ``merge="host"`` SPILLS to the host merge (round-4 review, Next #6:
+    past ~32M points the in-graph merge replicates five (N+1)-arrays
+    per device): the ring exchange still runs device-side, the cluster
+    step is :func:`sharded_step_local` (no replicated N-state at all),
+    and the return is the compact occurrence tables ``((own_glab,
+    own_core, halo_glab, halo_gid), 0, hcap)`` for
+    :func:`pypardis_tpu.parallel.merge.merge_occurrences`.
     """
     explicit = hcap is not None
     this_hcap = (
@@ -827,6 +856,40 @@ def _ring_ladder(
         )
 
         def run_step(pb, mr, hc=this_hcap):
+            if merge == "host":
+                halo, halo_mask, halo_gid, overflow = ring_exchange_step(
+                    *args, mesh=mesh, axis=axis, hcap=hc
+                )
+                # The cluster program dispatches WITHOUT waiting on the
+                # overflow fetch — the two device programs chain
+                # asynchronously (the point of the ring split), and a
+                # host sync here would cost ~0.2s of tunnel latency on
+                # every fit.  On the rare overflow the clustered result
+                # is discarded and the hcap ladder retries.
+                own_glab, own_core, halo_glab, pstats = (
+                    _with_kernel_fallback(
+                        lambda be: sharded_step_local(
+                            args[0], args[1], args[2],
+                            halo, halo_mask, halo_gid,
+                            eps=float(eps),
+                            min_samples=int(min_samples),
+                            metric=metric,
+                            block=block,
+                            mesh=mesh,
+                            axis=axis,
+                            precision=precision,
+                            backend=be,
+                            pair_budget=pb,
+                        ),
+                        backend,
+                    )
+                )
+                if int(np.asarray(overflow).sum()) != 0:
+                    raise _HaloOverflow()
+                # The host union-find merge is exact — no rounds ladder.
+                return (
+                    (own_glab, own_core, halo_glab, halo_gid), 0
+                ), pstats, True
             labels, core, overflow, pstats, m_rounds, converged = (
                 _with_kernel_fallback(
                     lambda be: sharded_step_ring(
@@ -854,7 +917,7 @@ def _ring_ladder(
             return (labels, core, m_rounds), pstats, converged
 
         try:
-            labels, core, m_rounds = run_ladders(
+            out = run_ladders(
                 run_step, hint_key, pair_budget, merge_rounds
             )
         except _HaloOverflow:
@@ -869,7 +932,7 @@ def _ring_ladder(
                 ) from None
             this_hcap *= 2
             continue
-        return labels, core, m_rounds, this_hcap
+        return (*out, this_hcap)
 
 
 def sharded_dbscan_device(
@@ -888,9 +951,17 @@ def sharded_dbscan_device(
     split_method: str = "min_var",
     sample_size: int = 262_144,
     seed: int = 0,
+    merge: str = "auto",
 ):
     """Cluster a DEVICE-RESIDENT ``jax.Array`` over the mesh without a
     host round trip of the dataset.
+
+    ``merge``: as in :func:`sharded_dbscan` — ``"auto"`` spills the
+    label merge to the host past ``MERGE_HOST_AUTO`` points (the
+    in-graph merge replicates ~5 (N+1)-arrays per device); the spill
+    fetches only the compact occurrence tables (per-slot gid + label
+    ints), never the coordinates, so the no-dataset-fetch contract of
+    this route holds at every N.
 
     The TPU analogue of the reference's ``train(rdd)`` on
     already-distributed data (``/root/reference/dbscan/dbscan.py:104``):
@@ -968,11 +1039,15 @@ def sharded_dbscan_device(
         jax.device_put(a, sharding)
         for a in (owned, msk, gid, exp_lo, exp_hi)
     )
-    labels, core, m_rounds, used_hcap = _ring_ladder(
+    if merge not in ("auto", "device", "host"):
+        raise ValueError(f"merge must be auto|device|host, got {merge!r}")
+    if merge == "auto":
+        merge = "host" if n >= MERGE_HOST_AUTO else "device"
+    out = _ring_ladder(
         args, eps=eps, min_samples=min_samples, metric=metric, block=block,
         mesh=mesh, axis=axis, n_points=n, precision=precision,
         backend=backend, hcap=hcap, pair_budget=pair_budget,
-        merge_rounds=merge_rounds, cap=cap,
+        merge_rounds=merge_rounds, cap=cap, merge=merge,
     )
     stats = {
         "owned_cap": cap,
@@ -980,10 +1055,20 @@ def sharded_dbscan_device(
         "pad_waste": float(p_total * cap) / max(n, 1) - 1.0,
         "input": "device",
         "halo_exchange": "ring",
-        "halo_cap": used_hcap,
-        "merge_rounds": int(m_rounds),
-        "merge_converged": True,
     }
+    if merge == "host":
+        tables, _zero, used_hcap = out
+        own_glab, own_core, halo_glab, halo_gid = tables
+        labels, core = _host_merge_finish(
+            n, args[2], own_glab, own_core, halo_gid, halo_glab
+        )
+        stats.update(halo_cap=used_hcap, merge="host")
+        return _canonicalize_roots(labels, core), core, stats, part, pid
+    labels, core, m_rounds, used_hcap = out
+    stats.update(
+        halo_cap=used_hcap, merge_rounds=int(m_rounds),
+        merge_converged=True,
+    )
     labels, core = np.asarray(labels), np.asarray(core)
     return _canonicalize_roots(labels, core), core, stats, part, pid
 

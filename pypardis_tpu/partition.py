@@ -188,7 +188,7 @@ def expanded_members(tree, points: np.ndarray, margin: float):
     """
     points = np.asarray(points)
     n = len(points)
-    state = {0: (np.arange(n), np.ones(n, dtype=bool))}
+    state = {0: (np.arange(n, dtype=np.int32), np.ones(n, dtype=bool))}
     for parent, axis, boundary, _left, right in tree:
         arr, own = state.pop(int(parent))
         c = points[arr, int(axis)].astype(np.float64, copy=False)
@@ -348,7 +348,10 @@ class KDPartitioner:
         left child keeps the parent label, right child takes the next
         fresh label (partition.py:173-176).
         """
-        all_idx = np.arange(len(self.points))
+        # int32 indices: the partition lists total one row per point and
+        # ride through the whole shard build — int64 doubled the build's
+        # host high-water for nothing below 2^31 points.
+        all_idx = np.arange(len(self.points), dtype=np.int32)
         self.partitions = {0: all_idx}
         self.bounding_boxes = {0: root_box}
         next_label = 1

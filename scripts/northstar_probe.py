@@ -16,26 +16,14 @@ Usage: PYTHONPATH=/root/repo:/root/.axon_site python scripts/northstar_probe.py 
 """
 
 import json
+import os
 import sys
 import time
 
-import numpy as np
-
-
-def make_data(n, dim, pts_per_center=6250, seed=0):
-    rng = np.random.default_rng(seed)
-    n_centers = max(32, n // pts_per_center)
-    centers = rng.uniform(-10, 10, size=(n_centers, dim)).astype(np.float32)
-    assign = rng.integers(0, n_centers, size=n)
-    out = centers[assign]
-    del assign
-    chunk = 1 << 20
-    for s in range(0, n, chunk):
-        e = min(s + chunk, n)
-        out[s:e] += rng.normal(scale=0.4, size=(e - s, dim)).astype(
-            np.float32
-        )
-    return out
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+from benchdata import ari_vs_truth, make_blob_data  # noqa: E402
 
 
 def hbm_stats():
@@ -56,7 +44,7 @@ def main():
     n = int(sys.argv[1])
     dim = int(sys.argv[2]) if len(sys.argv) > 2 else 16
     eps = 2.4
-    X = make_data(n, dim)
+    X, truth = make_blob_data(n, dim)
 
     from pypardis_tpu import DBSCAN
 
@@ -83,6 +71,7 @@ def main():
                 "cold_s": round(t_cold, 2),
                 "warm_s": round(t_warm, 2),
                 "warm_pps": round(n / t_warm),
+                "ari_vs_truth": round(ari_vs_truth(labels, truth), 4),
                 "clusters": int(labels.max() + 1),
                 "noise": int((labels == -1).sum()),
                 **phases,

@@ -1,46 +1,48 @@
-"""Scale probe: constant-density blobs at increasing N on one chip.
+"""Scale probe: blobs at increasing N on one chip (uniform or skewed).
 
 Prints one JSON line per run with both timings the driver cares about:
 ``device_pps`` (fit on device-resident data — the engine rate) and
 ``host_pps`` (end-to-end from host numpy, including the tunnel
-transfer).  Collected into BENCH_SCALE_r*.json artifacts.
+transfer), plus ``ari_vs_truth`` against the generator's assignment
+(round-4 review: scale rows carried no oracle).  Collected into
+BENCH_SCALE_r*.json artifacts.
+
+Usage: python scripts/scale_probe.py N [DIM] [EPS] [SPREAD]
+                                     [--skew lognormal]
 """
+import argparse
 import json
+import os
 import sys
 import time
 
-import numpy as np
-
-
-def make_data(n, dim, pts_per_center=6250, seed=0, spread=10.0):
-    rng = np.random.default_rng(seed)
-    n_centers = max(32, n // pts_per_center)
-    centers = rng.uniform(
-        -spread, spread, size=(n_centers, dim)
-    ).astype(np.float32)
-    assign = rng.integers(0, n_centers, size=n)
-    out = centers[assign]
-    del assign
-    chunk = 1 << 20
-    for s in range(0, n, chunk):
-        e = min(s + chunk, n)
-        out[s:e] += rng.normal(scale=0.4, size=(e - s, dim)).astype(np.float32)
-    return out
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+from benchdata import ari_vs_truth, make_blob_data  # noqa: E402
 
 
 def main():
-    n = int(sys.argv[1])
-    dim = int(sys.argv[2]) if len(sys.argv) > 2 else 16
-    eps = float(sys.argv[3]) if len(sys.argv) > 3 else 2.4
-    spread = float(sys.argv[4]) if len(sys.argv) > 4 else 10.0
-    X = make_data(n, dim, spread=spread)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("n", type=int)
+    ap.add_argument("dim", type=int, nargs="?", default=16)
+    ap.add_argument("eps", type=float, nargs="?", default=2.4)
+    ap.add_argument("spread", type=float, nargs="?", default=10.0)
+    ap.add_argument("--skew", default=None)
+    args = ap.parse_args()
+    n = args.n
+    X, truth = make_blob_data(
+        n, args.dim, spread=args.spread, std=0.4, skew=args.skew
+    )
 
     import jax
 
     from pypardis_tpu import DBSCAN
 
     def run(data):
-        return DBSCAN(eps=eps, min_samples=10, block=2048).fit_predict(data)
+        return DBSCAN(
+            eps=args.eps, min_samples=10, block=2048
+        ).fit_predict(data)
 
     t0 = time.perf_counter()
     labels = run(X)
@@ -61,13 +63,15 @@ def main():
         json.dumps(
             {
                 "n": n,
-                "dim": dim,
-                "eps": eps,
+                "dim": args.dim,
+                "eps": args.eps,
+                "skew": args.skew,
                 "compile_plus_run_s": round(tc, 2),
                 "host_e2e_s": round(host_dt, 2),
                 "host_pps": round(n / host_dt),
                 "device_s": round(dev_dt, 2),
                 "device_pps": round(n / dev_dt),
+                "ari_vs_truth": round(ari_vs_truth(labels, truth), 4),
                 "clusters": int(labels.max() + 1),
                 "noise": int((labels == -1).sum()),
             }

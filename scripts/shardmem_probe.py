@@ -1,0 +1,86 @@
+"""Layout-only shard-build memory probe (round-4 review, Next #4).
+
+Round 3 restructured ``build_shards`` to per-partition chunked gathers
+with no dataset-sized recentred temp (``sharded.py:84-132``), targeting
+a build high-water <= 1.5x dataset — but no recorded row could show it:
+TPU rows included compile-helper RSS and CPU rows used datasets small
+enough that fixed overhead swamped the ratio.  This probe runs the
+layout ALONE — no fit, no jit, no device — at a probative size and
+reports the VmHWM delta over the resident baseline (dataset + truth +
+partitioner state), which is exactly the build's own footprint: the
+output slabs (owned + halo + masks/gids, ~(1 + pad_waste + halo_factor)
+x dataset) plus any temps.
+
+Usage: python scripts/shardmem_probe.py N [DIM] [MAX_PARTITIONS] [EPS]
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")  # never touch the chip
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+from benchdata import make_blob_data  # noqa: E402
+
+
+def reset_hwm():
+    try:
+        with open("/proc/self/clear_refs", "w") as f:
+            f.write("5")
+    except OSError:
+        pass
+
+
+def hwm_gb():
+    for line in open("/proc/self/status"):
+        if line.startswith("VmHWM"):
+            return int(line.split()[1]) / 1e6
+    return 0.0
+
+
+def main():
+    n = int(sys.argv[1])
+    dim = int(sys.argv[2]) if len(sys.argv) > 2 else 16
+    max_partitions = int(sys.argv[3]) if len(sys.argv) > 3 else 8
+    eps = float(sys.argv[4]) if len(sys.argv) > 4 else 2.4
+
+    from pypardis_tpu.parallel.sharded import build_shards
+    from pypardis_tpu.partition import KDPartitioner
+
+    X, truth = make_blob_data(n, dim)
+    del truth
+    part = KDPartitioner(X, max_partitions=max_partitions)
+
+    reset_hwm()
+    pre = hwm_gb()
+    arrays, stats = build_shards(X, part, eps, 8, 2048)
+    peak = hwm_gb()
+
+    slabs_gb = sum(a.nbytes for a in arrays) / 1e9
+    build_gb = max(0.0, peak - pre)
+    print(
+        json.dumps(
+            {
+                "n": n,
+                "dim": dim,
+                "max_partitions": max_partitions,
+                "eps": eps,
+                "dataset_gb": round(X.nbytes / 1e9, 3),
+                "build_highwater_gb": round(build_gb, 3),
+                "build_vs_dataset": round(build_gb / (X.nbytes / 1e9), 2),
+                "output_slabs_gb": round(slabs_gb, 3),
+                "pad_waste": round(stats["pad_waste"], 4),
+                "halo_factor": round(stats["halo_factor"], 4),
+                "owned_cap": stats["owned_cap"],
+                "halo_cap": stats["halo_cap"],
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

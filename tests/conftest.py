@@ -36,6 +36,14 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: scale-ish tests (~100k points on the CPU mesh); "
+        "deselect with -m 'not slow'",
+    )
+
+
 @pytest.fixture(scope="session", autouse=True)
 def _assert_eight_devices():
     if not _NATIVE:

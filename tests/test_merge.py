@@ -106,17 +106,22 @@ def test_merge_auto_switchover(monkeypatch):
     np.testing.assert_array_equal(l_dev, l_host)
 
 
-def test_host_merge_rejects_ring_halo():
-    import pytest
-
+def test_ring_halo_host_merge_supported():
+    """ring + merge='host' is the >MERGE_HOST_AUTO spill path (round-4
+    review, Next #6) — it must run and agree with the device merge."""
     from pypardis_tpu.parallel import default_mesh, sharded_dbscan
     from pypardis_tpu.partition import KDPartitioner
 
     rng = np.random.default_rng(0)
     X = rng.normal(size=(512, 2))
     part = KDPartitioner(X, max_partitions=8)
-    with pytest.raises(ValueError, match="halo='host'"):
-        sharded_dbscan(
-            X, part, eps=0.3, min_samples=5, block=64,
-            mesh=default_mesh(8), halo="ring", merge="host",
-        )
+    ref, _c, _s = sharded_dbscan(
+        X, part, eps=0.3, min_samples=5, block=64,
+        mesh=default_mesh(8), halo="ring", merge="device",
+    )
+    labels, _core, stats = sharded_dbscan(
+        X, part, eps=0.3, min_samples=5, block=64,
+        mesh=default_mesh(8), halo="ring", merge="host",
+    )
+    assert stats.get("merge") == "host"
+    np.testing.assert_array_equal(labels, ref)
