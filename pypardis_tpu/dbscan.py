@@ -80,6 +80,14 @@ def _as_float(data) -> np.ndarray:
     Round 1 forced float64 here, which silently doubled host memory for
     float32 datasets — the common dtype at the target scale.
     """
+    # A float np.memmap passes through UNTOUCHED (np.asarray would
+    # strip the subclass and break downstream streaming detection; the
+    # view's memory would still be file-backed, but the driver could
+    # no longer tell).
+    if isinstance(data, np.memmap) and data.dtype in (
+        np.float32, np.float64
+    ):
+        return data
     pts = np.asarray(data)
     if pts.dtype not in (np.float32, np.float64):
         pts = pts.astype(np.float64)
@@ -458,8 +466,12 @@ class DBSCAN:
 
     def fit(self, X) -> "DBSCAN":
         # A device-resident jax.Array flows through without a host
-        # round trip (the TPU analogue of an already-distributed RDD).
-        return self.train(X if _is_device_array(X) else np.asarray(X))
+        # round trip (the TPU analogue of an already-distributed RDD);
+        # a disk-backed np.memmap stays a memmap so the sharded path
+        # can stream it device-by-device.
+        if _is_device_array(X) or isinstance(X, np.memmap):
+            return self.train(X)
+        return self.train(np.asarray(X))
 
     def fit_predict(self, X) -> np.ndarray:
         return self.fit(X).labels_
@@ -602,7 +614,12 @@ class DBSCAN:
 
         with timer.phase("cluster"):
             # sharded_dbscan returns numpy labels — device work is
-            # materialized inside the phase.
+            # materialized inside the phase.  A disk-backed memmap
+            # takes the ring halo path so the streaming per-device
+            # shard build engages (host RAM never holds the dataset as
+            # anonymous memory — the reference's larger-than-one-worker
+            # premise, README.md:60).
+            halo = "ring" if isinstance(points, np.memmap) else "host"
             labels, core, stats = sharded_dbscan(
                 points,
                 part,
@@ -614,6 +631,7 @@ class DBSCAN:
                 precision=self.precision,
                 backend=self.kernel_backend,
                 merge=self.merge,
+                halo=halo,
             )
         with timer.phase("densify"):
             self.labels_ = densify_labels(labels)

@@ -1,0 +1,29 @@
+#!/bin/bash
+# Round-5 native-chip probe sequence (run AFTER the mode=device row).
+# Chip runs are serialized; each is a fresh process (axon poison
+# discipline).  Exact pair budgets avoid the overflow-rerun compile.
+set -x
+cd /root/repo
+ENV="PYTHONPATH=/root/repo:/root/.axon_site PYPARDIS_PROBE_PLATFORM=native"
+
+# steady-state engine rate: device-resident input, ring halo, device merge
+timeout 3600 env $ENV python scripts/meshscale_probe.py 10000000 device_input 8 2.4 \
+  --dim 16 --std 0.4 --block 2048 --n-centers 0 \
+  >> /tmp/chip_rows.jsonl 2>/tmp/chip_device_input.log
+
+# ring halo from host input
+timeout 3600 env $ENV python scripts/meshscale_probe.py 10000000 ring 8 2.4 \
+  --dim 16 --std 0.4 --block 2048 --n-centers 0 --pair-budget 331776 \
+  >> /tmp/chip_rows.jsonl 2>/tmp/chip_ring.log
+
+# skewed density through the single-shard fused path at 10M
+timeout 3600 env PYTHONPATH=/root/repo:/root/.axon_site \
+  python scripts/scale_probe.py 10000000 16 2.4 --skew lognormal \
+  >> /tmp/chip_rows.jsonl 2>/tmp/chip_skew_fused.log
+
+# uniform fused 10M for the same-session comparison row
+timeout 3600 env PYTHONPATH=/root/repo:/root/.axon_site \
+  python scripts/scale_probe.py 10000000 16 2.4 \
+  >> /tmp/chip_rows.jsonl 2>/tmp/chip_uniform_fused.log
+
+echo ALL-CHIP-ROWS-DONE

@@ -1,0 +1,27 @@
+#!/bin/bash
+# Round-5 CPU-mesh probe sequence (run with the chip idle — this host
+# has ONE core and the rows' warm timings matter).
+set -x
+cd /root/repo
+
+# 16-partition cliff (r4 review, Next #5): 500k x 4-D, warm/cold split,
+# max_partitions in {8, 16, 32} on the 8-device CPU mesh.
+for mp in 8 16 32; do
+  timeout 5400 python scripts/meshscale_probe.py 500000 device $mp 0.3 \
+    >> /tmp/cpu_rows.jsonl 2>/tmp/cpu_cliff_$mp.log
+done
+
+# Skewed density through the mesh at 2M x 4-D (r4 review, Next #3).
+timeout 7200 python scripts/meshscale_probe.py 2000000 device 8 0.3 --skew lognormal \
+  >> /tmp/cpu_rows.jsonl 2>/tmp/cpu_skew_2m.log
+timeout 7200 python scripts/meshscale_probe.py 2000000 ring 8 0.3 --skew lognormal \
+  >> /tmp/cpu_rows.jsonl 2>/tmp/cpu_skew_2m_ring.log
+
+# Cross-mode agreement at 1M uniform (device/ring/ring_host), carrying
+# the new oracle + warm/cold columns.
+for mode in device ring ring_host; do
+  timeout 7200 python scripts/meshscale_probe.py 1000000 $mode 8 0.3 \
+    >> /tmp/cpu_rows.jsonl 2>/tmp/cpu_1m_$mode.log
+done
+
+echo ALL-CPU-ROWS-DONE

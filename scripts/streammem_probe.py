@@ -1,0 +1,191 @@
+"""Anonymous-memory probe for the streaming (memmap) shard build.
+
+Compares peak ANONYMOUS host memory (RssAnon, sampled) of
+
+* (a) the streaming ring fit of a DISK-BACKED memmap
+  (``build_owned_shards_streaming``: per-device slab assembly), vs
+* (b) the ordinary in-RAM host-halo fit of the same data,
+
+on the 8-device CPU mesh.  RssAnon (not VmHWM) is the honest metric:
+memmap pages are file-backed and evictable, and with free RAM the
+kernel keeps them resident, which would inflate a VmHWM reading with
+memory that never pressures the host.
+
+Caveat stated in the artifact: on the CPU mesh the "device" slabs are
+themselves anonymous host memory, so (a)'s floor is ~1x dataset of
+device buffers.  On real TPU hardware those live in HBM — the host
+anon peak of the streaming build is one device's slab + the int32
+index lists (~1/n_devices of the dataset + 4 bytes/point).
+
+Usage: python scripts/streammem_probe.py N [DIM] [EPS] [MODE]
+  MODE: stream | inram | both (default) — full fits; or
+        build — LAYOUT ONLY (streaming vs host build + device_put,
+        no kernels), which isolates the build-memory story at sizes
+        where a CPU-mesh fit would take hours
+"""
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+_N_DEV = int(os.environ.get("PYPARDIS_PROBE_DEVICES", "8"))
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={_N_DEV}"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", _N_DEV)
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+from benchdata import ari_vs_truth, make_blob_data  # noqa: E402
+
+
+def rss_anon_gb():
+    for line in open("/proc/self/status"):
+        if line.startswith("RssAnon"):
+            return int(line.split()[1]) / 1e6
+    return 0.0
+
+
+class AnonSampler:
+    def __init__(self, period=0.05):
+        self.peak = 0.0
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._run, args=(period,),
+                                   daemon=True)
+
+    def _run(self, period):
+        while not self._stop.is_set():
+            self.peak = max(self.peak, rss_anon_gb())
+            time.sleep(period)
+
+    def __enter__(self):
+        self._t.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self._t.join()
+        self.peak = max(self.peak, rss_anon_gb())
+
+
+def main():
+    n = int(sys.argv[1])
+    dim = int(sys.argv[2]) if len(sys.argv) > 2 else 16
+    eps = float(sys.argv[3]) if len(sys.argv) > 3 else 2.4
+    mode = sys.argv[4] if len(sys.argv) > 4 else "both"
+
+    from pypardis_tpu.parallel import default_mesh, sharded_dbscan
+    from pypardis_tpu.partition import KDPartitioner
+
+    mesh = default_mesh(min(_N_DEV, jax.device_count()))
+    out = {
+        "n": n, "dim": dim, "eps": eps,
+        "mesh_devices": mesh.devices.size,
+        "dataset_gb": round(n * dim * 4 / 1e9, 3),
+    }
+
+    X, truth = make_blob_data(n, dim)
+    with tempfile.NamedTemporaryFile(dir="/var/tmp", suffix=".f32") as f:
+        mm = np.memmap(f.name, dtype=np.float32, mode="w+",
+                       shape=X.shape)
+        chunk = 1 << 20
+        for s in range(0, n, chunk):
+            mm[s:min(s + chunk, n)] = X[s:min(s + chunk, n)]
+        mm.flush()
+        if mode == "build":
+            import jax as _jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from pypardis_tpu.parallel.sharded import (
+                build_owned_shards,
+                build_owned_shards_streaming,
+            )
+
+            del X
+            ro = np.memmap(f.name, dtype=np.float32, mode="r",
+                           shape=(n, dim))
+            part = KDPartitioner(ro, max_partitions=mesh.devices.size)
+            base = rss_anon_gb()
+            with AnonSampler() as samp:
+                arrays, _lo, _hi, _lab, stats = (
+                    build_owned_shards_streaming(
+                        ro, part, eps, 1024, mesh
+                    )
+                )
+                _jax.block_until_ready(arrays)
+            out.update(
+                stream_peak_anon_gb=round(samp.peak, 3),
+                stream_build_anon_gb=round(samp.peak - base, 3),
+                stream_pad_waste=round(stats.get("pad_waste", -1), 4),
+            )
+            del arrays
+            X2, _ = make_blob_data(n, dim)
+            part2 = KDPartitioner(X2, max_partitions=mesh.devices.size)
+            sharding = NamedSharding(mesh, P(mesh.axis_names[0]))
+            base = rss_anon_gb()
+            with AnonSampler() as samp:
+                arrs, _lo2, _hi2, _lab2, _st = build_owned_shards(
+                    X2, part2, eps, mesh.devices.size, 1024
+                )
+                dev = tuple(
+                    _jax.device_put(a, sharding) for a in arrs
+                )
+                _jax.block_until_ready(dev)
+            out.update(
+                inram_peak_anon_gb=round(samp.peak, 3),
+                inram_build_anon_gb=round(samp.peak - base, 3),
+            )
+            print(json.dumps(out), flush=True)
+            return
+        if mode in ("stream", "both"):
+            del X  # the streaming run must not lean on an in-RAM copy
+            ro = np.memmap(f.name, dtype=np.float32, mode="r",
+                           shape=(n, dim))
+            part = KDPartitioner(ro, max_partitions=mesh.devices.size)
+            base = rss_anon_gb()
+            with AnonSampler() as samp:
+                labels, core, stats = sharded_dbscan(
+                    ro, part, eps=eps, min_samples=10, block=1024,
+                    mesh=mesh, halo="ring",
+                )
+            out.update(
+                stream_peak_anon_gb=round(samp.peak, 3),
+                stream_base_anon_gb=round(base, 3),
+                stream_build_anon_gb=round(samp.peak - base, 3),
+                stream_input=stats.get("input"),
+                stream_pad_waste=round(stats.get("pad_waste", -1), 4),
+                ari_vs_truth=round(ari_vs_truth(labels, truth), 4),
+            )
+            del ro, part, labels, core
+        if mode in ("inram", "both"):
+            X2, _ = make_blob_data(n, dim)
+            part = KDPartitioner(X2, max_partitions=mesh.devices.size)
+            base = rss_anon_gb()
+            with AnonSampler() as samp:
+                sharded_dbscan(
+                    X2, part, eps=eps, min_samples=10, block=1024,
+                    mesh=mesh, halo="host",
+                )
+            out.update(
+                inram_peak_anon_gb=round(samp.peak, 3),
+                inram_base_anon_gb=round(base, 3),
+                inram_build_anon_gb=round(samp.peak - base, 3),
+            )
+
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -84,3 +84,34 @@ def test_every_point_exactly_one_label(blobs750):
     labels = model.fit_predict(blobs750)
     assert labels.shape == (len(blobs750),)
     assert labels.dtype == np.int32
+
+
+def test_single_device_mesh_chained_matches_mesh8():
+    """A 1-device mesh with L>1 partitions chains per-partition cluster
+    dispatches (watchdog/compile economy on tunneled deployments; the
+    execution granularity of a real L=1-per-device pod) — labels must
+    be byte-identical to the 8-device fused program, on every mode."""
+    from sklearn.datasets import make_blobs
+
+    X, _ = make_blobs(
+        n_samples=4000, centers=10, n_features=3, cluster_std=0.3,
+        random_state=5,
+    )
+    X = X.astype(np.float32)
+    part = KDPartitioner(X, max_partitions=8)
+    ref, ref_core, _ = sharded_dbscan(
+        X, part, eps=0.4, min_samples=5, block=64, mesh=default_mesh(8),
+    )
+    mesh1 = default_mesh(1)
+    for kwargs in (
+        dict(),                      # host halo + device merge
+        dict(halo="ring"),           # ring + device merge
+        dict(merge="host"),          # host halo + host merge
+        dict(halo="ring", merge="host"),  # ring + host-merge spill
+    ):
+        labels, core, _stats = sharded_dbscan(
+            X, part, eps=0.4, min_samples=5, block=64, mesh=mesh1,
+            **kwargs,
+        )
+        np.testing.assert_array_equal(labels, ref, err_msg=str(kwargs))
+        np.testing.assert_array_equal(core, ref_core, err_msg=str(kwargs))
