@@ -318,40 +318,32 @@ def _cluster_local_partitions(
 ):
     """Run per-partition DBSCAN over a device's (L, cap, k) partitions.
 
-    L == 1 calls the kernel directly.  For L > 1 the Pallas backend
-    runs a Python loop over partitions (static L — pallas_call cannot
-    batch under vmap, and the round-2 design simply refused multi-
-    partition Pallas); the XLA backend vmaps.  Returns (labels, core,
-    pair_stats) with the worst-case (max-total) pair stats.
+    L == 1 calls the kernel directly.  For L > 1 BOTH backends run a
+    static Python loop over partitions (unrolled into the program):
+    pallas_call cannot batch under vmap, and vmapping the XLA kernel
+    turns its tile-skip ``lax.cond`` into ``select`` — every pruned
+    column tile computes anyway, which measured as a 5x
+    multi-partition-per-device cliff (500k x 4-D, 16 partitions on the
+    8-device mesh: 904s warm vmapped vs ~1.5x expected from padding).
+    Returns (labels, core, pair_stats) with the worst-case (max-total)
+    pair stats — the static budget is shared, so max(total) is the
+    binding constraint.
     """
-    from ..ops.labels import resolve_backend
 
-    def one_part(p, m, be):
+    def one_part(p, m):
         return dbscan_fixed_size(
             p, eps, min_samples, m, metric=metric, block=block,
-            precision=precision, backend=be, pair_budget=pair_budget,
+            precision=precision, backend=backend, pair_budget=pair_budget,
         )
 
     if pts.shape[0] == 1:
-        l1, c1, pair_stats = one_part(pts[0], msk[0], backend)
+        l1, c1, pair_stats = one_part(pts[0], msk[0])
         return l1[None], c1[None], pair_stats
-    if resolve_backend(
-        backend, metric, pts.shape[1], block, pts.shape[2], precision
-    ) == "pallas":
-        outs = [
-            one_part(pts[i], msk[i], backend) for i in range(pts.shape[0])
-        ]
-        labels = jnp.stack([o[0] for o in outs])
-        core = jnp.stack([o[1] for o in outs])
-        pair_stats = jnp.stack([o[2] for o in outs]).max(axis=0)
-        return labels, core, pair_stats
-    labels, core, ps = jax.vmap(
-        functools.partial(one_part, be="xla")
-    )(pts, msk)
-    # Elementwise max over partitions: the static budget is shared, so
-    # max(total) is the binding constraint (XLA-path totals are real
-    # live-pair counts too — ops.distances.count_live_tile_pairs).
-    return labels, core, ps.max(axis=0)
+    outs = [one_part(pts[i], msk[i]) for i in range(pts.shape[0])]
+    labels = jnp.stack([o[0] for o in outs])
+    core = jnp.stack([o[1] for o in outs])
+    pair_stats = jnp.stack([o[2] for o in outs]).max(axis=0)
+    return labels, core, pair_stats
 
 
 def _merge_loop(lab_map, home_label, core_g, h_gid, h_lab, h_core, axis,
