@@ -42,7 +42,7 @@ def main():
     def run(data):
         model = DBSCAN(eps=eps, min_samples=min_samples, block=2048)
         labels = model.fit_predict(data)
-        return labels
+        return labels, model
 
     run(X)  # compile warm-up (host path)
     # Host end-to-end: includes the host->device transfer, whose
@@ -54,7 +54,7 @@ def main():
     host_dt = float("inf")
     for _ in range(reps):
         t0 = time.perf_counter()
-        labels = run(X)
+        labels, _model = run(X)
         host_dt = min(host_dt, time.perf_counter() - t0)
 
     # Primary metric: fits on device-resident data — the TPU analogue
@@ -69,7 +69,7 @@ def main():
     samples = []
     for _ in range(dev_reps):
         t0 = time.perf_counter()
-        labels = run(Xd)
+        labels, model = run(Xd)
         samples.append(time.perf_counter() - t0)
     dt = min(samples)
     pts_per_sec_chip = n / dt / n_chips
@@ -112,6 +112,14 @@ def main():
                 "device_sample_spread": round(max(samples) / min(samples), 2),
                 "ari_vs_truth": round(ari_truth, 4),
                 "ari_vs_sklearn": ari_sklearn,
+                # The same run_report@1 schema DBSCAN.report() returns:
+                # phase times, per-device partition sizes, halo/pad
+                # overheads, and ladder event counts ride with every
+                # row (the BENCH_*/MESHSCALE_* archives used to
+                # reconstruct these by hand from stderr).  Telemetry of
+                # the LAST warm device-path rep — representative of the
+                # steady state the primary metric reports.
+                "telemetry": model.report(),
             }
         )
     )

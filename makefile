@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: all wheel native test tpu-smoke bench demo clean
+.PHONY: all wheel native test verify tpu-smoke bench bench-smoke demo clean
 
 all: native test
 
@@ -22,6 +22,11 @@ test:
 	$(PY) -m pytest tests/ -q -m "not slow"
 	$(PY) -m pytest tests/ -q -m slow
 
+# The ROADMAP tier-1 gate, verbatim (scripts/verify.sh): the fast suite
+# on the faked 8-device CPU mesh, with the pass-count echo CI scrapes.
+verify:
+	bash scripts/verify.sh
+
 # Hardware validation: compiles + runs the Pallas kernels through Mosaic
 # on the real chip (tests skip themselves off-TPU). Run before shipping
 # any kernel change — CPU CI cannot catch lowering breaks.
@@ -30,6 +35,12 @@ tpu-smoke:
 
 bench:
 	$(PY) bench.py
+
+# Tiny-n benchmark + schema check of the emitted JSON line (the
+# metric/value/unit triple plus the run_report@1 telemetry block).
+bench-smoke:
+	JAX_PLATFORMS=cpu BENCH_N=2000 BENCH_DIM=4 BENCH_REPS=1 \
+	BENCH_DEV_REPS=1 $(PY) bench.py | $(PY) scripts/check_bench_json.py
 
 demo:
 	$(PY) -m pypardis_tpu.demo

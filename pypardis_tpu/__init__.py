@@ -52,6 +52,7 @@ def _enable_compile_cache():
 
 _enable_compile_cache()
 
+from . import obs
 from .geometry import BoundingBox
 from .aggregator import ClusterAggregator, default_value
 from .partition import (
@@ -70,6 +71,7 @@ from .checkpoint import (
 )
 
 __all__ = [
+    "obs",
     "BoundingBox",
     "ClusterAggregator",
     "default_value",

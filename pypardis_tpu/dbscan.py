@@ -401,6 +401,11 @@ class DBSCAN:
         self.core_sample_mask_: Optional[np.ndarray] = None
         self.partitioner_: Optional[KDPartitioner] = None
         self.metrics_: Dict[str, float] = {}
+        # Telemetry of the most recent fit (pypardis_tpu.obs): the
+        # registry/tracer/event-log behind report()/summary()/
+        # export_trace().
+        self._recorder = None
+        self._fit_info: Dict[str, int] = {}
 
     # -- training ---------------------------------------------------------
 
@@ -416,12 +421,19 @@ class DBSCAN:
         """
         import contextlib
 
+        from . import obs
         from .utils.profiling import PhaseTimer, trace
 
         keys, points = _as_keys_points(data)
         self._keys = keys
         self.data = points
         t0 = time.perf_counter()
+        # Fresh telemetry per fit: recorder (registry + tracer + event
+        # log) behind report()/summary()/export_trace(), and a clean
+        # metrics_ so refits never carry a previous run's stats.
+        rec = obs.RunRecorder()
+        self._recorder = rec
+        self.metrics_ = {}
 
         if len(points) == 0:
             self.labels_ = np.empty(0, np.int32)
@@ -430,6 +442,10 @@ class DBSCAN:
             self.neighbors, self.cluster_dict = {}, {}
             self.result = []
             self.metrics_ = {"total_s": 0.0, "points_per_sec": 0.0}
+            self._fit_info = {
+                "n_dims": int(points.shape[1]) if points.ndim == 2 else 0,
+                "n_devices": 1,
+            }
             return self
 
         timer = PhaseTimer()
@@ -439,23 +455,36 @@ class DBSCAN:
             else contextlib.nullcontext()
         )
         n_devices = self._n_devices()
-        with ctx:
-            if n_devices > 1 and len(points) >= 2 * n_devices:
+        sharded = n_devices > 1 and len(points) >= 2 * n_devices
+        with obs.use_recorder(rec), ctx:
+            if sharded:
                 self._train_sharded(points, n_devices, timer)
             else:
                 self._train_single(points, timer)
-        self.metrics_.update(timer.as_dict())
-        self.metrics_["total_s"] = time.perf_counter() - t0
-        self.metrics_["points_per_sec"] = len(points) / max(
-            self.metrics_["total_s"], 1e-9
-        )
-        log_phase(
-            "train",
-            n=len(points),
-            clusters=int(self.labels_.max()) + 1 if len(points) else 0,
-            **{k: round(v, 4) for k, v in self.metrics_.items()
-               if isinstance(v, float)},
-        )
+            self.metrics_.update(timer.as_dict())
+            self.metrics_["total_s"] = time.perf_counter() - t0
+            self.metrics_["points_per_sec"] = len(points) / max(
+                self.metrics_["total_s"], 1e-9
+            )
+            log_phase(
+                "train",
+                n=len(points),
+                clusters=int(self.labels_.max()) + 1 if len(points) else 0,
+                **{k: round(v, 4) for k, v in self.metrics_.items()
+                   if isinstance(v, float)},
+            )
+        self._fit_info = {
+            "n_dims": int(points.shape[1]),
+            "n_devices": int(n_devices if sharded else 1),
+        }
+        # Absorb the scalar metrics into the registry so the registry
+        # dump alone (counters/gauges/timings) is a complete record.
+        for k, v in self.metrics_.items():
+            if k.endswith("_s"):
+                continue
+            if isinstance(v, (bool, int, float, str, np.integer,
+                              np.floating)):
+                rec.metrics.set(f"run.{k}", v)
         # The key-sorted ``result`` list (the reference's final
         # ``sortByKey()``, dbscan.py:164) materializes LAZILY on first
         # access: building N Python tuples costs real wall time at
@@ -528,6 +557,57 @@ class DBSCAN:
         if self.result is None:
             raise RuntimeError("call train() first")
         return self.result
+
+    # -- telemetry --------------------------------------------------------
+
+    def report(self) -> Dict:
+        """The schema'd telemetry dict of the most recent fit.
+
+        One json-serializable dict (``pypardis_tpu/run_report@1``):
+        per-phase wall times, per-device partition sizes, shard-layout
+        overheads (``halo_factor``, ``pad_waste``), restage / pair-budget
+        / halo-capacity / merge-round ladder event counts, and the full
+        metrics-registry dump.  ``bench.py`` embeds the identical
+        structure in its JSON line.
+        """
+        if self.labels_ is None:
+            raise RuntimeError("call fit()/train() first")
+        from .obs import build_run_report
+
+        return build_run_report(
+            self._recorder,
+            params={
+                "eps": self.eps,
+                "min_samples": self.min_samples,
+                "metric": self.metric,
+                "max_partitions": self.max_partitions,
+                "split_method": self.split_method,
+                "block": self.block,
+                "precision": self.precision,
+                "kernel_backend": self.kernel_backend,
+                "merge": self.merge,
+            },
+            n_points=len(self.labels_),
+            n_dims=self._fit_info.get("n_dims", 0),
+            n_devices=self._fit_info.get("n_devices", 1),
+            backend=jax_backend_name(),
+            metrics=self.metrics_,
+        )
+
+    def summary(self) -> str:
+        """One-screen human rendering of :meth:`report`."""
+        from .obs import format_summary
+
+        return format_summary(self.report())
+
+    def export_trace(self, path: str) -> str:
+        """Write the fit's driver spans as Chrome-trace JSON (loads in
+        chrome://tracing / ui.perfetto.dev).  Complements the
+        ``profile_dir`` jax.profiler trace: this one is always recorded
+        and costs microseconds."""
+        if self._recorder is None:
+            raise RuntimeError("call fit()/train() first")
+        return self._recorder.tracer.export_chrome_trace(path)
 
     # -- internals --------------------------------------------------------
 

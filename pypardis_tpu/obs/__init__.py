@@ -1,0 +1,46 @@
+"""Unified observability: metrics registry, span tracing, run reports.
+
+The reference shipped a dead ``LOGGING`` flag and nothing else (reference
+dbscan.py:9, SURVEY §5).  This repro accreted three disconnected
+surfaces — ``PhaseTimer`` wall times, ``log_phase`` lines, and ad-hoc
+``stats`` dicts riding out of the sharded path — none of which shared a
+schema or an export path.  This package is the single replacement:
+
+* :class:`MetricsRegistry` — counters / gauges / timing aggregates
+  under one dotted-key schema (``phase.cluster``, ``sharded.halo_factor``,
+  ``events.retry.restage``, ...);
+* :class:`Tracer` / spans — nestable wall-time spans with the
+  ``sync_on`` device-sync semantics lifted from ``PhaseTimer``,
+  exportable as Chrome-trace / Perfetto JSON (``traceEvents``)
+  alongside the existing ``jax.profiler`` hook;
+* :class:`RunRecorder` — one object per fit holding the registry, the
+  tracer, and the event log (restage / pair-budget / halo-capacity /
+  merge-round ladder triggers with their exceptions); library layers
+  reach the active one via :func:`current` so no signature anywhere
+  threads a telemetry handle;
+* :func:`build_run_report` / :func:`format_summary` — the schema'd
+  ``DBSCAN.report()`` dict and its one-screen human rendering.
+
+Key schema: lowercase dotted segments ``[a-z0-9_]+(.[a-z0-9_]+)*``.
+Reserved prefixes: ``phase.`` (timings, seconds), ``events.`` (counters,
+one per recorded event kind), ``sharded.`` / ``run.`` (gauges from the
+execution paths), ``compile.`` (first-compile markers).
+"""
+
+from .recorder import RunRecorder, current, event, span, use_recorder
+from .registry import MetricsRegistry
+from .report import REPORT_SCHEMA, build_run_report, format_summary
+from .trace import Tracer
+
+__all__ = [
+    "MetricsRegistry",
+    "Tracer",
+    "RunRecorder",
+    "current",
+    "use_recorder",
+    "span",
+    "event",
+    "build_run_report",
+    "format_summary",
+    "REPORT_SCHEMA",
+]

@@ -1,0 +1,94 @@
+"""The per-run telemetry recorder and the ambient-current mechanism.
+
+One :class:`RunRecorder` per fit bundles the registry, the tracer, and
+the event log.  Library layers never take a recorder parameter — they
+call :func:`current` (or the module-level :func:`span` / :func:`event`
+conveniences), which resolves to the innermost active recorder, or to a
+process-wide ambient one when no fit is in flight (so bare calls into
+``parallel.sharded`` etc. still record somewhere harmless).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, List, Optional
+
+from .registry import MetricsRegistry, _py
+from .trace import Tracer
+
+
+class RunRecorder:
+    """Registry + tracer + event log for one run.
+
+    Events are the discrete occurrences the retry/ladder machinery
+    produces — restages, pair-budget overflows, halo-capacity overflows,
+    merge-round escalations, first compiles.  Each event appends a
+    timestamped dict and bumps the ``events.<kind>`` counter, so the
+    report can show counts without replaying the log.
+    """
+
+    def __init__(self):
+        self.metrics = MetricsRegistry()
+        self.tracer = Tracer()
+        self.events: List[Dict] = []
+
+    def span(self, name: str, sync: bool = False, **attrs):
+        return self.tracer.span(name, sync=sync, **attrs)
+
+    # Event-log retention cap (counters keep exact totals past it):
+    # the process-ambient recorder lives forever, so the detail list
+    # must not be a slow leak under sustained traffic.
+    MAX_EVENTS = 16_384
+
+    def event(self, kind: str, **fields) -> None:
+        self.metrics.inc(f"events.{kind}")
+        if len(self.events) < self.MAX_EVENTS:
+            self.events.append(
+                {
+                    "kind": kind,
+                    "t_s": time.perf_counter() - self.tracer.epoch_s,
+                    **{k: _py(v) for k, v in fields.items()},
+                }
+            )
+
+    def event_counts(self) -> Dict[str, int]:
+        """{event kind -> count} from the counters."""
+        pre = "events."
+        return {
+            k[len(pre):]: int(v)
+            for k, v in self.metrics.counters_with_prefix(pre).items()
+        }
+
+
+# Process-wide fallback: telemetry emitted outside any fit lands here
+# instead of being dropped (and instead of every call site null-checking).
+_AMBIENT = RunRecorder()
+_current: Optional[RunRecorder] = None
+
+
+def current() -> RunRecorder:
+    return _current if _current is not None else _AMBIENT
+
+
+@contextlib.contextmanager
+def use_recorder(rec: RunRecorder):
+    """Install ``rec`` as the current recorder for the enclosed block
+    (saved/restored, so nested fits each keep their own)."""
+    global _current
+    prev = _current
+    _current = rec
+    try:
+        yield rec
+    finally:
+        _current = prev
+
+
+def span(name: str, sync: bool = False, **attrs):
+    """Span on whatever recorder is current."""
+    return current().span(name, sync=sync, **attrs)
+
+
+def event(kind: str, **fields) -> None:
+    """Event on whatever recorder is current."""
+    current().event(kind, **fields)

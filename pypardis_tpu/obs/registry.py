@@ -1,0 +1,132 @@
+"""Metrics registry: counters, gauges, timing aggregates, one key schema.
+
+Absorbs what used to live in three places — ``PhaseTimer.as_dict()``,
+the sharded path's ``stats`` dicts, and ``DBSCAN.metrics_`` — so every
+number a run produces is reachable under one dotted key namespace and
+mergeable across runs (bench loops, retries, multi-fit sweeps).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Union
+
+_KEY_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)*$")
+
+Number = Union[int, float]
+
+
+def _py(value):
+    """Coerce numpy scalars (and anything with ``.item()``) to plain
+    Python numbers so every registry dump is json-serializable."""
+    if isinstance(value, (int, float, str, bool)) or value is None:
+        return value
+    item = getattr(value, "item", None)
+    if callable(item):
+        return item()
+    return value
+
+
+def sanitize_segment(s) -> str:
+    """Coerce an arbitrary string into one valid key segment (for call
+    sites that build keys from user-ish names, e.g. phase labels)."""
+    out = re.sub(r"[^a-z0-9_]", "_", str(s).lower())
+    return out or "x"
+
+
+def validate_key(key: str) -> str:
+    if not isinstance(key, str) or not _KEY_RE.match(key):
+        raise ValueError(
+            f"metric key {key!r} violates the schema: lowercase dotted "
+            f"segments of [a-z0-9_]"
+        )
+    return key
+
+
+class MetricsRegistry:
+    """Counters (monotonic adds), gauges (last write wins), and timing
+    aggregates (count / total / min / max seconds).
+
+    >>> reg = MetricsRegistry()
+    >>> reg.inc("events.retry.restage")
+    >>> reg.set("sharded.halo_factor", 0.18)
+    >>> reg.observe("phase.cluster", 1.25)
+    >>> reg.as_dict()["gauges"]["sharded.halo_factor"]
+    0.18
+
+    ``merge`` combines two registries with the natural semantics per
+    type: counters add, gauges take the other's value (it is newer),
+    timing aggregates pool their samples.
+    """
+
+    def __init__(self):
+        self._counters: Dict[str, Number] = {}
+        self._gauges: Dict[str, object] = {}
+        self._timings: Dict[str, Dict[str, float]] = {}
+
+    # -- write surface ----------------------------------------------------
+
+    def inc(self, key: str, value: Number = 1) -> None:
+        validate_key(key)
+        self._counters[key] = self._counters.get(key, 0) + _py(value)
+
+    def set(self, key: str, value) -> None:
+        validate_key(key)
+        self._gauges[key] = _py(value)
+
+    def observe(self, key: str, seconds: float) -> None:
+        validate_key(key)
+        s = float(_py(seconds))
+        t = self._timings.get(key)
+        if t is None:
+            self._timings[key] = {
+                "count": 1, "total_s": s, "min_s": s, "max_s": s,
+            }
+        else:
+            t["count"] += 1
+            t["total_s"] += s
+            t["min_s"] = min(t["min_s"], s)
+            t["max_s"] = max(t["max_s"], s)
+
+    # -- read surface -----------------------------------------------------
+
+    def counter(self, key: str, default: Number = 0) -> Number:
+        return self._counters.get(key, default)
+
+    def gauge(self, key: str, default=None):
+        return self._gauges.get(key, default)
+
+    def counters_with_prefix(self, prefix: str) -> Dict[str, Number]:
+        return {
+            k: v for k, v in self._counters.items() if k.startswith(prefix)
+        }
+
+    def merge(self, other: "MetricsRegistry") -> "MetricsRegistry":
+        """Fold ``other`` into this registry (see class docstring)."""
+        for k, v in other._counters.items():
+            self._counters[k] = self._counters.get(k, 0) + v
+        self._gauges.update(other._gauges)
+        for k, t in other._timings.items():
+            mine = self._timings.get(k)
+            if mine is None:
+                self._timings[k] = dict(t)
+            else:
+                mine["count"] += t["count"]
+                mine["total_s"] += t["total_s"]
+                mine["min_s"] = min(mine["min_s"], t["min_s"])
+                mine["max_s"] = max(mine["max_s"], t["max_s"])
+        return self
+
+    def as_dict(self) -> Dict[str, dict]:
+        """One json-serializable dump: ``{"counters", "gauges",
+        "timings"}`` — timings carry count/total/min/max/mean seconds."""
+        timings = {}
+        for k, t in self._timings.items():
+            d = dict(t)
+            d["mean_s"] = d["total_s"] / max(d["count"], 1)
+            timings[k] = d
+        return {
+            "counters": dict(self._counters),
+            "gauges": dict(self._gauges),
+            "timings": timings,
+        }

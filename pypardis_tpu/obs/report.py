@@ -1,0 +1,194 @@
+"""The schema'd run report and its one-screen human rendering.
+
+``DBSCAN.report()`` returns :func:`build_run_report`'s dict;
+``bench.py`` embeds the same dict in its JSON line, so benchmark rows
+and interactive fits expose identical telemetry (the ``BENCH_*.json`` /
+``MESHSCALE_*.json`` archives used to reconstruct this by hand from
+stderr scrapes).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from .recorder import RunRecorder
+from .registry import _py
+
+REPORT_SCHEMA = "pypardis_tpu/run_report@1"
+
+# metrics_ keys that describe the shard layout / merge machinery rather
+# than timing — they group under report["sharding"].
+_SHARDING_KEYS = (
+    "halo_factor",
+    "pad_waste",
+    "owned_cap",
+    "halo_cap",
+    "n_shard_partitions",
+    "n_partitions",
+    "merge",
+    "merge_rounds",
+    "merge_converged",
+    "halo_exchange",
+    "halo_bytes",
+    "input",
+)
+
+
+def _clean(v):
+    if isinstance(v, dict):
+        return {k: _clean(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_clean(x) for x in v]
+    if getattr(v, "ndim", 0):  # ndarray — scalars fall through to _py
+        return _clean(v.tolist())
+    v = _py(v)
+    if isinstance(v, (int, float, str, bool)) or v is None:
+        return v
+    return str(v)  # callables (metric=...), and anything else exotic
+
+
+def build_run_report(
+    recorder: Optional[RunRecorder],
+    *,
+    params: Dict,
+    n_points: int,
+    n_dims: int,
+    n_devices: int,
+    backend: str,
+    metrics: Dict,
+) -> Dict:
+    """Assemble the stable report dict from a fit's recorder + metrics.
+
+    ``metrics`` is the model's ``metrics_`` (PhaseTimer ``*_s`` keys +
+    the sharded path's stats); the recorder contributes event counts and
+    the registry dump.  Every value is a plain Python scalar/list/dict —
+    the whole report is json-serializable by construction.
+    """
+    metrics = {k: _clean(v) for k, v in metrics.items()}
+
+    phases = {
+        k[:-2]: round(float(v), 6)
+        for k, v in metrics.items()
+        if k.endswith("_s") and k != "total_s"
+        and isinstance(v, (int, float))
+    }
+
+    sharding = {k: metrics[k] for k in _SHARDING_KEYS if k in metrics}
+    sharding.setdefault("halo_factor", 0.0)
+    sharding.setdefault("pad_waste", 0.0)
+    sharding.setdefault("n_partitions", int(metrics.get("n_partitions", 1)))
+
+    psizes = metrics.get("partition_sizes")
+    devices: Dict = {"count": int(n_devices)}
+    if psizes is not None:
+        if n_devices > 0 and len(psizes) % n_devices == 0:
+            per_dev = len(psizes) // n_devices
+            grouped = [
+                psizes[d * per_dev:(d + 1) * per_dev]
+                for d in range(n_devices)
+            ]
+        else:
+            grouped = [psizes]
+        devices["partition_sizes"] = grouped
+        devices["points"] = metrics.get(
+            "device_points", [sum(g) for g in grouped]
+        )
+    else:
+        # Single-shard fit: everything on one device.
+        devices["partition_sizes"] = [[int(n_points)]]
+        devices["points"] = [int(n_points)]
+
+    ev = recorder.event_counts() if recorder is not None else {}
+    events = {
+        "restage": ev.get("retry.restage", 0),
+        "transient_retry": sum(
+            v for k, v in ev.items() if k.startswith("retry.")
+        ),
+        "pair_overflow": ev.get("pair_overflow", 0),
+        "halo_overflow": ev.get("halo_overflow", 0),
+        "merge_unconverged": ev.get("merge_unconverged", 0),
+        "compile": ev.get("compile", 0),
+    }
+
+    report = {
+        "schema": REPORT_SCHEMA,
+        "params": _clean(params),
+        "run": {
+            "n_points": int(n_points),
+            "n_dims": int(n_dims),
+            "n_devices": int(n_devices),
+            "backend": str(backend),
+            "total_s": round(float(metrics.get("total_s", 0.0)), 6),
+            "points_per_sec": round(
+                float(metrics.get("points_per_sec", 0.0)), 1
+            ),
+        },
+        "phases": phases,
+        "sharding": sharding,
+        "devices": devices,
+        "events": events,
+        "metrics": (
+            recorder.metrics.as_dict()
+            if recorder is not None
+            else {"counters": {}, "gauges": {}, "timings": {}}
+        ),
+    }
+    return _clean(report)
+
+
+def _fmt_bytes(n) -> str:
+    n = float(n)
+    for unit in ("B", "KB", "MB", "GB"):
+        if n < 1024 or unit == "GB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return f"{n:.1f}GB"
+
+
+def format_summary(report: Dict) -> str:
+    """Render a report as the one-screen run summary."""
+    run, sh, ev = report["run"], report["sharding"], report["events"]
+    lines = [
+        f"pypardis_tpu run — {run['n_points']:,} pts x {run['n_dims']}D "
+        f"on {run['n_devices']} {run['backend']} device(s)",
+        f"  total {run['total_s']:.3f}s "
+        f"({run['points_per_sec']:,.0f} pts/s)",
+    ]
+    if report["phases"]:
+        lines.append(
+            "  phases: "
+            + " | ".join(
+                f"{k} {v:.3f}s" for k, v in sorted(report["phases"].items())
+            )
+        )
+    parts = sh.get("n_shard_partitions", sh.get("n_partitions", 1))
+    shard_bits = [
+        f"{parts} partition(s)",
+        f"halo_factor {sh['halo_factor']:.3f}",
+        f"pad_waste {sh['pad_waste']:.3f}",
+    ]
+    if "halo_bytes" in sh:
+        shard_bits.append(f"halo {_fmt_bytes(sh['halo_bytes'])}")
+    if "merge" in sh:
+        m = f"merge={sh['merge']}"
+        if "merge_rounds" in sh:
+            m += f" ({sh['merge_rounds']} rounds)"
+        shard_bits.append(m)
+    lines.append("  sharding: " + ", ".join(shard_bits))
+    dev_pts = report["devices"].get("points")
+    if dev_pts and len(dev_pts) > 1:
+        lo, hi = min(dev_pts), max(dev_pts)
+        skew = hi / max(lo, 1)
+        lines.append(
+            f"  devices: {len(dev_pts)} x [{lo:,}..{hi:,}] pts "
+            f"(skew {skew:.2f}x)"
+        )
+    lines.append(
+        "  events: "
+        f"{ev['restage']} restage, {ev['pair_overflow']} pair-overflow, "
+        f"{ev['halo_overflow']} halo-overflow, "
+        f"{ev['merge_unconverged']} merge-retry, "
+        f"{ev['compile']} compile, "
+        f"{ev['transient_retry']} transient-retry"
+    )
+    return "\n".join(lines)

@@ -1,0 +1,153 @@
+"""Span tracing with device-sync semantics and Chrome-trace export.
+
+Spans nest (a stack per tracer), measure wall time, and — the part
+generic tracers get wrong on an async device runtime — can block on the
+phase's actual outputs before closing (``sync_on``, lifted from the old
+``PhaseTimer``), so the recorded duration includes async-dispatched
+device execution rather than just the Python that queued it.
+
+Export is Chrome trace format (the ``traceEvents`` JSON that
+chrome://tracing and Perfetto load), complementing the lower-level
+``jax.profiler`` trace: this one is the *driver's* view — phases,
+ladders, retries — cheap enough to be always on.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from typing import Dict, List, Optional
+
+
+class Span:
+    """One completed (or in-flight) span.  ``dur_s`` is None while
+    open."""
+
+    __slots__ = ("name", "t0_s", "dur_s", "depth", "attrs", "_pending")
+
+    def __init__(self, name: str, t0_s: float, depth: int, attrs: dict):
+        self.name = name
+        self.t0_s = t0_s
+        self.dur_s: Optional[float] = None
+        self.depth = depth
+        self.attrs = attrs
+        self._pending = None
+
+    def sync_on(self, arrays) -> None:
+        """Block on ``arrays`` at span exit so the duration includes the
+        device execution that produced them."""
+        self._pending = arrays
+
+    def set(self, **attrs) -> None:
+        self.attrs.update(attrs)
+
+
+class Tracer:
+    """Collect spans relative to one epoch; export as Chrome trace.
+
+    ``sync=True`` on a span issues a trivial transfer barrier per device
+    at exit (TPU executes in order, so that bounds prior compute there);
+    prefer ``sync_on`` with the phase's real outputs on out-of-order
+    backends — both behaviors are the old ``PhaseTimer``'s, verbatim.
+    """
+
+    # Retention cap: the process-ambient recorder lives forever, so an
+    # unbounded span list would be a slow leak under sustained traffic.
+    # 16k spans ≈ a few MB; beyond it new spans are counted, not kept.
+    MAX_SPANS = 16_384
+
+    def __init__(self):
+        self.epoch_s = time.perf_counter()
+        self.spans: List[Span] = []
+        self.dropped = 0
+        self._stack: List[Span] = []
+
+    @contextlib.contextmanager
+    def span(self, name: str, sync: bool = False, **attrs):
+        sp = Span(name, time.perf_counter(), len(self._stack), attrs)
+        self._stack.append(sp)
+        try:
+            yield sp
+        finally:
+            self._stack.pop()
+            if sp._pending is not None:
+                import jax
+
+                jax.block_until_ready(sp._pending)
+                sp._pending = None
+            elif sync:
+                import jax
+
+                for dev in jax.devices():
+                    jax.device_put(0, dev).block_until_ready()
+            sp.dur_s = time.perf_counter() - sp.t0_s
+            self._keep(sp)
+
+    def _keep(self, sp: Span) -> None:
+        if len(self.spans) < self.MAX_SPANS:
+            self.spans.append(sp)
+        else:
+            self.dropped += 1
+
+    def add_complete(self, name: str, t0_s: float, dur_s: float,
+                     **attrs) -> Span:
+        """Record an already-measured interval (absolute perf_counter
+        start) — the bridge for timers that measured on their own."""
+        sp = Span(name, t0_s, len(self._stack), attrs)
+        sp.dur_s = dur_s
+        self._keep(sp)
+        return sp
+
+    def durations(self) -> Dict[str, float]:
+        """{span name -> total seconds} over completed spans."""
+        out: Dict[str, float] = {}
+        for sp in self.spans:
+            if sp.dur_s is not None:
+                out[sp.name] = out.get(sp.name, 0.0) + sp.dur_s
+        return out
+
+    # -- Chrome trace export ---------------------------------------------
+
+    def to_chrome_trace(self) -> dict:
+        """``{"traceEvents": [...]}`` — complete ("X") events in
+        microseconds relative to the tracer epoch; loads in
+        chrome://tracing and ui.perfetto.dev."""
+        events = [
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": 0,
+                "tid": 0,
+                "args": {"name": "pypardis_tpu driver"},
+            }
+        ]
+        for sp in self.spans:
+            if sp.dur_s is None:
+                continue
+            events.append(
+                {
+                    "name": sp.name,
+                    "ph": "X",
+                    "pid": 0,
+                    "tid": 0,
+                    "ts": (sp.t0_s - self.epoch_s) * 1e6,
+                    "dur": sp.dur_s * 1e6,
+                    "args": {k: _jsonable(v) for k, v in sp.attrs.items()},
+                }
+            )
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def export_chrome_trace(self, path: str) -> str:
+        with open(path, "w") as f:
+            json.dump(self.to_chrome_trace(), f)
+        return path
+
+
+def _jsonable(v):
+    if isinstance(v, (int, float, str, bool)) or v is None:
+        return v
+    item = getattr(v, "item", None)
+    if callable(item):
+        return item()
+    return str(v)

@@ -236,7 +236,12 @@ def dbscan_fixed_size(
             ]
         )
     counts = count_fn(points, eps, mask)
-    core = (counts >= min_samples) & mask
+    # A valid point always counts itself (distance 0 <= eps), but the
+    # f32 |x|^2+|y|^2-2xy expansion can compute the self-pair a few ULP
+    # above 0 and miss it once eps^2 sinks below that noise floor
+    # (eps=1e-6 on unit-scale data) — clamping to 1 restores the exact
+    # property with no false positives.
+    core = (jnp.maximum(counts, 1) >= min_samples) & mask
 
     idx = jnp.arange(n, dtype=jnp.int32)
     f0 = jnp.where(core, idx, _INT_INF)
@@ -326,7 +331,9 @@ def _prepare_counts(points, eps, min_samples, mask, pairs, *, block,
         points, eps, mask, block=block, precision=precision, layout=layout,
         pairs=pairs,
     )
-    core = (counts >= min_samples) & mask
+    # Same self-count clamp as dbscan_fixed_size (a valid point is
+    # always within eps of itself, whatever the f32 expansion says).
+    core = (jnp.maximum(counts, 1) >= min_samples) & mask
     f0 = jnp.where(core, jnp.arange(n, dtype=jnp.int32), _INT_INF)
     return core, f0
 

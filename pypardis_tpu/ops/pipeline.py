@@ -431,8 +431,17 @@ def _transient_retry(stage, fn, retryable=_default_transient):
     last = None
     for wait in (0, 10, 75):
         if wait:
+            from ..obs import event as obs_event
+            from ..obs.registry import sanitize_segment
             from ..utils.log import get_logger
 
+            # Every retry is a telemetry event (events.retry.<stage>):
+            # the restage/ladder machinery is inspectable from
+            # DBSCAN.report() without scraping warning logs.
+            obs_event(
+                f"retry.{sanitize_segment(stage)}",
+                wait_s=wait, error=str(last)[:160],
+            )
             get_logger().warning(
                 "transient TPU runtime error in %s; retrying in %ds: %s",
                 stage, wait, str(last)[:160],
@@ -555,6 +564,7 @@ def dbscan_device_pipeline(
     (reproduced repeatedly at 25M points; every compile-idle staged
     run succeeded).
     """
+    from ..obs import event as obs_event, span as obs_span
     from .labels import resolve_backend
 
     cap = points_t.shape[1]
@@ -568,6 +578,7 @@ def dbscan_device_pipeline(
             points_t, eps, n, block=block, sort=sort, precision=precision
         )
         if key not in _compiled_pipeline_keys:
+            obs_event("compile", stage="pipeline")
             # First time for this shape: let stage 1 finish on device
             # before stage 2's compile starts (block_until_ready can
             # return early on tunneled deployments; a 1-element
@@ -576,7 +587,8 @@ def dbscan_device_pipeline(
             _compiled_pipeline_keys.add(key)
         return out
 
-    xs, mask_k, owner = _transient_retry("layout", run_layout)
+    with obs_span("pipeline.layout", sort=bool(sort)):
+        xs, mask_k, owner = _transient_retry("layout", run_layout)
     capk = xs.shape[1]
     stepped = (
         capk >= STEP_THRESHOLD
@@ -585,11 +597,14 @@ def dbscan_device_pipeline(
         ) == "pallas"
     )
     if stepped:
-        return _cluster_stepped(
-            xs, mask_k, owner, eps,
-            cap=cap, min_samples=min_samples, block=block,
-            precision=precision, pair_budget=pair_budget,
-        )
+        with obs_span("pipeline.cluster", mode="stepped") as sp:
+            out = _cluster_stepped(
+                xs, mask_k, owner, eps,
+                cap=cap, min_samples=min_samples, block=block,
+                precision=precision, pair_budget=pair_budget,
+            )
+            sp.set(capacity=int(xs.shape[1]))
+            return out
 
     def run_cluster():
         out = _pipeline_cluster(
@@ -604,4 +619,5 @@ def dbscan_device_pipeline(
         # load — per fit).
         return np.array(out)
 
-    return _transient_retry("cluster", run_cluster)
+    with obs_span("pipeline.cluster", mode="fused"):
+        return _transient_retry("cluster", run_cluster)

@@ -70,7 +70,13 @@ def ring_halo_exchange_multi(
     points — so any ``L = n_partitions / n_devices`` works, not just
     one partition per device (round-2 restriction).
     """
-    n_dev = jax.lax.axis_size(axis)
+    # jax.lax.axis_size only exists on newer jax; psum(1) over the axis
+    # is the portable spelling of the same quantity.
+    n_dev = (
+        jax.lax.axis_size(axis)
+        if hasattr(jax.lax, "axis_size")
+        else jax.lax.psum(1, axis)
+    )
     L, cap, k = owned.shape
     halo = jnp.zeros((L, hcap, k), owned.dtype)
     hmask = jnp.zeros((L, hcap), bool)

@@ -1,9 +1,29 @@
-"""Mesh construction helpers."""
+"""Mesh construction helpers + shard_map version compat."""
 
 from __future__ import annotations
 
 import jax
 from jax.sharding import Mesh
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
+    """``jax.shard_map`` across jax versions.
+
+    jax >= 0.6 exposes it at the top level with ``check_vma``; 0.4.x
+    only has ``jax.experimental.shard_map.shard_map`` with the older
+    ``check_rep`` spelling of the same flag.
+    """
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=bool(check_vma),
+    )
 
 
 def default_mesh(n_devices: int | None = None, axis_name: str = "p") -> Mesh:

@@ -40,10 +40,12 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..geometry import BoxStack
+from ..obs import event as obs_event, span as obs_span
 from ..ops.labels import dbscan_fixed_size
 from ..partition import spatial_order
 from ..utils import clamp_block, round_up
 from ..utils.budget import run_ladders
+from .mesh import shard_map
 
 _INT_INF = jnp.iinfo(jnp.int32).max
 
@@ -125,6 +127,14 @@ def _layout_geometry(partitioner, labels, n_shards, block):
     return p_real, p_total, part_idx, cap
 
 
+def _partition_sizes(part_idx, p_total):
+    """Per-shard-slot point counts, padding slots as zeros — the
+    telemetry behind the report's per-device partition sizes (slot j
+    lives on device ``j // (p_total / n_devices)``)."""
+    sizes = [int(len(i)) for i in part_idx]
+    return sizes + [0] * (p_total - len(sizes))
+
+
 def _pad_inverted_boxes(exp_lo, exp_hi, p_total):
     """Pad expanded-box stacks to ``p_total`` with inverted (lo > hi)
     boxes: padding partitions' ring filters match nothing."""
@@ -167,7 +177,7 @@ def build_owned_shards(points, partitioner, eps, n_shards, block):
     center, exp_lo, exp_hi, labels = _expanded_frame_meta(
         points, partitioner, eps
     )
-    _, arrays, cap, p_total = _owned_layout(
+    owned_idx, arrays, cap, p_total = _owned_layout(
         points, center, partitioner, labels, n_shards, block
     )
     exp_lo, exp_hi = _pad_inverted_boxes(exp_lo, exp_hi, p_total)
@@ -175,6 +185,7 @@ def build_owned_shards(points, partitioner, eps, n_shards, block):
         "owned_cap": cap,
         "n_shard_partitions": p_total,
         "pad_waste": float(p_total * cap) / max(len(points), 1) - 1.0,
+        "partition_sizes": _partition_sizes(owned_idx, p_total),
     }
     return arrays, exp_lo, exp_hi, labels, stats
 
@@ -251,6 +262,7 @@ def build_owned_shards_streaming(points, partitioner, eps, block, mesh):
         "owned_cap": cap,
         "n_shard_partitions": p_total,
         "pad_waste": float(p_total * cap) / max(n, 1) - 1.0,
+        "partition_sizes": _partition_sizes(part_idx, p_total),
         "input": "stream",
     }
     return (owned, mask, gid), exp_lo, exp_hi, labels, stats
@@ -303,6 +315,9 @@ def build_shards(points, partitioner, eps, n_shards, block):
         "halo_cap": hcap,
         "n_shard_partitions": p_total,
         "pad_waste": float(p_total * cap) / max(n, 1) - 1.0,
+        "partition_sizes": _partition_sizes(owned_idx, p_total),
+        # Actual duplicated coordinate bytes (f32) the halo build ships.
+        "halo_bytes": int(n_halo) * k * 4,
     }
     return (owned, owned_mask, owned_gid, halo, halo_mask, halo_gid), stats
 
@@ -485,7 +500,7 @@ def _sharded_step_fused(
 
     spec = P("p", None, None)
     spec2 = P("p", None)
-    return jax.shard_map(
+    return shard_map(
         per_device,
         mesh=mesh,
         in_specs=(spec, spec2, spec2, spec, spec2, spec2),
@@ -528,11 +543,12 @@ def _sharded_step_1dev_chained(
     mkey = ("merge", own_glab.shape, halo_glab.shape, n_points,
             merge_rounds)
     if mkey not in _chained_compiled:
+        obs_event("compile", stage="chained_merge")
         # Idle-device barrier before the merge program's first compile
         # (the cluster dispatches above may still be executing).
         np.asarray(own_glab[:1, :1])
     spec2 = P("p", None)
-    final, core_g, rounds, converged = jax.shard_map(
+    final, core_g, rounds, converged = shard_map(
         per_device,
         mesh=mesh,
         in_specs=(spec2, spec2, spec2, spec2, spec2),
@@ -567,6 +583,7 @@ def _cluster_tables_1dev_chained(
     )
     first = key not in _chained_compiled
     if first:
+        obs_event("compile", stage="chained_cluster")
         # Idle-device barrier BEFORE the cluster program's first
         # compile/load: the upstream halo-exchange program may still be
         # executing, and on tunneled deployments bringing a new large
@@ -776,7 +793,7 @@ def _sharded_step_local_fused(
 
     spec = P("p", None, None)
     spec2 = P("p", None)
-    return jax.shard_map(
+    return shard_map(
         per_device,
         mesh=mesh,
         in_specs=(spec, spec2, spec2, spec, spec2, spec2),
@@ -809,7 +826,7 @@ def ring_exchange_step(
 
     spec = P("p", None, None)
     spec2 = P("p", None)
-    return jax.shard_map(
+    return shard_map(
         per_device,
         mesh=mesh,
         in_specs=(spec, spec2, spec2, spec2, spec2),
@@ -875,6 +892,19 @@ def _with_kernel_fallback(fn, backend):
         return fn("xla")
 
 
+# Shard-layout/config keys whose fused step program has already been
+# traced in this process — telemetry only (events.compile separates
+# cold fits from warm ones in DBSCAN.report(); the chained 1-device
+# paths have their own _chained_compiled bookkeeping).
+_fused_compiled: set = set()
+
+
+def _note_first_compile(stage: str, key) -> None:
+    if key not in _fused_compiled:
+        _fused_compiled.add(key)
+        obs_event("compile", stage=stage)
+
+
 # Above this point count, merge='auto' reconciles labels on the host:
 # the in-graph merge replicates five (N+1,)-sized int32/bool arrays per
 # device (~20 bytes/point/device, ~2GB at 100M) which eventually stops
@@ -898,6 +928,13 @@ def _sharded_hint_key(owned_shape, halo_cap, block, precision, eps, metric):
 
 class _HaloOverflow(Exception):
     """Ring halo buffer dropped in-box points; the hcap ladder retries."""
+
+
+def _ring_halo_bytes(stats, hcap, k):
+    """Ring-path halo traffic telemetry: the f32 halo-buffer capacity
+    bytes each fit ships over the interconnect (the ring exchange fills
+    fixed-size buffers, so capacity — not occupancy — is what moves)."""
+    return int(stats["n_shard_partitions"]) * int(hcap) * int(k) * 4
 
 
 def _host_merge_finish(n, og, own_glab, own_core, halo_gid, halo_glab):
@@ -1007,34 +1044,42 @@ def sharded_dbscan(
         )
     sharding = NamedSharding(mesh, P(axis))
     if halo == "ring":
-        if stream:
-            arrays, exp_lo, exp_hi, _labels_sorted, stats = (
-                build_owned_shards_streaming(
-                    points, partitioner, eps, block, mesh
+        with obs_span("sharded.build_shards", halo="ring",
+                      stream=bool(stream)):
+            if stream:
+                arrays, exp_lo, exp_hi, _labels_sorted, stats = (
+                    build_owned_shards_streaming(
+                        points, partitioner, eps, block, mesh
+                    )
                 )
-            )
-            args = (
-                *arrays,
-                jax.device_put(exp_lo, sharding),
-                jax.device_put(exp_hi, sharding),
-            )
-        else:
-            arrays, exp_lo, exp_hi, _labels_sorted, stats = (
-                build_owned_shards(
-                    points, partitioner, eps, n_shards, block
+                args = (
+                    *arrays,
+                    jax.device_put(exp_lo, sharding),
+                    jax.device_put(exp_hi, sharding),
                 )
-            )
-            args = tuple(
-                jax.device_put(a, sharding)
-                for a in (*arrays, exp_lo, exp_hi)
-            )
-        out = _ring_ladder(
-            args, eps=eps, min_samples=min_samples, metric=metric,
-            block=block, mesh=mesh, axis=axis, n_points=len(points),
-            precision=precision, backend=backend, hcap=hcap,
-            pair_budget=pair_budget, merge_rounds=merge_rounds,
-            cap=int(stats["owned_cap"]), merge=merge,
+            else:
+                arrays, exp_lo, exp_hi, _labels_sorted, stats = (
+                    build_owned_shards(
+                        points, partitioner, eps, n_shards, block
+                    )
+                )
+                args = tuple(
+                    jax.device_put(a, sharding)
+                    for a in (*arrays, exp_lo, exp_hi)
+                )
+        _note_first_compile(
+            "sharded_ring",
+            (args[0].shape, block, precision, backend, merge, hcap),
         )
+        with obs_span("sharded.execute", halo="ring", merge=merge):
+            out = _ring_ladder(
+                args, eps=eps, min_samples=min_samples, metric=metric,
+                block=block, mesh=mesh, axis=axis, n_points=len(points),
+                precision=precision, backend=backend, hcap=hcap,
+                pair_budget=pair_budget, merge_rounds=merge_rounds,
+                cap=int(stats["owned_cap"]), merge=merge,
+            )
+        k = points.shape[1]
         if merge == "host":
             tables, _zero, used_hcap = out
             own_glab, own_core, halo_glab, halo_gid = tables
@@ -1045,19 +1090,29 @@ def sharded_dbscan(
             stats = dict(
                 stats, halo_exchange="ring", halo_cap=used_hcap,
                 merge="host",
+                halo_bytes=_ring_halo_bytes(stats, used_hcap, k),
             )
             return _canonicalize_roots(labels, core), core, stats
         labels, core, m_rounds, used_hcap = out
         stats = dict(
             stats, halo_exchange="ring", halo_cap=used_hcap,
             merge_rounds=int(m_rounds), merge_converged=True,
+            halo_bytes=_ring_halo_bytes(stats, used_hcap, k),
         )
         labels, core = np.asarray(labels), np.asarray(core)
         return _canonicalize_roots(labels, core), core, stats
-    arrays, stats = build_shards(points, partitioner, eps, n_shards, block)
-    arrays = tuple(jax.device_put(a, sharding) for a in arrays)
+    with obs_span("sharded.build_shards", halo="host"):
+        arrays, stats = build_shards(
+            points, partitioner, eps, n_shards, block
+        )
+        arrays = tuple(jax.device_put(a, sharding) for a in arrays)
     hint_key = _sharded_hint_key(
         arrays[0].shape, arrays[3].shape[1], block, precision, eps, metric
+    )
+    _note_first_compile(
+        "sharded_step",
+        (arrays[0].shape, arrays[3].shape, block, precision, backend,
+         merge),
     )
 
     if merge == "host":
@@ -1081,14 +1136,16 @@ def sharded_dbscan(
             # The host union-find merge is exact — no rounds ladder.
             return out[:3], out[3], True
 
-        own_glab, own_core, halo_glab = run_ladders(
-            run_step, hint_key, pair_budget, merge_rounds
-        )
-        # arrays[2]: (P, cap) owned gids; arrays[5]: (P, hcap) halo gids
-        labels, core = _host_merge_finish(
-            len(points), arrays[2], own_glab, own_core, arrays[5],
-            halo_glab,
-        )
+        with obs_span("sharded.execute", halo="host", merge="host"):
+            own_glab, own_core, halo_glab = run_ladders(
+                run_step, hint_key, pair_budget, merge_rounds
+            )
+        with obs_span("sharded.merge_host"):
+            # arrays[2]: (P, cap) owned gids; arrays[5]: halo gids
+            labels, core = _host_merge_finish(
+                len(points), arrays[2], own_glab, own_core, arrays[5],
+                halo_glab,
+            )
         stats = dict(stats, merge="host")
         return _canonicalize_roots(labels, core), core, stats
 
@@ -1112,9 +1169,10 @@ def sharded_dbscan(
         )
         return (labels, core, m_rounds), pstats, converged
 
-    labels, core, m_rounds = run_ladders(
-        run_step, hint_key, pair_budget, merge_rounds
-    )
+    with obs_span("sharded.execute", halo="host", merge="device"):
+        labels, core, m_rounds = run_ladders(
+            run_step, hint_key, pair_budget, merge_rounds
+        )
     stats = dict(
         stats, merge="device", merge_rounds=int(m_rounds),
         merge_converged=True,
@@ -1220,6 +1278,10 @@ def _ring_ladder(
                 run_step, hint_key, pair_budget, merge_rounds
             )
         except _HaloOverflow:
+            obs_event(
+                "halo_overflow", hcap=this_hcap,
+                retry=hcap_attempts > 1,
+            )
             hcap_attempts -= 1
             if hcap_attempts <= 0:
                 raise RuntimeError(
@@ -1342,16 +1404,24 @@ def sharded_dbscan_device(
         raise ValueError(f"merge must be auto|device|host, got {merge!r}")
     if merge == "auto":
         merge = "host" if n >= MERGE_HOST_AUTO else "device"
-    out = _ring_ladder(
-        args, eps=eps, min_samples=min_samples, metric=metric, block=block,
-        mesh=mesh, axis=axis, n_points=n, precision=precision,
-        backend=backend, hcap=hcap, pair_budget=pair_budget,
-        merge_rounds=merge_rounds, cap=cap, merge=merge,
+    _note_first_compile(
+        "sharded_ring",
+        (args[0].shape, block, precision, backend, merge, hcap),
     )
+    with obs_span("sharded.execute", halo="ring", merge=merge,
+                  input="device"):
+        out = _ring_ladder(
+            args, eps=eps, min_samples=min_samples, metric=metric,
+            block=block, mesh=mesh, axis=axis, n_points=n,
+            precision=precision, backend=backend, hcap=hcap,
+            pair_budget=pair_budget, merge_rounds=merge_rounds, cap=cap,
+            merge=merge,
+        )
     stats = {
         "owned_cap": cap,
         "n_shard_partitions": p_total,
         "pad_waste": float(p_total * cap) / max(n, 1) - 1.0,
+        "partition_sizes": [int(c) for c in np.asarray(counts_dev)],
         "input": "device",
         "halo_exchange": "ring",
     }
@@ -1361,12 +1431,16 @@ def sharded_dbscan_device(
         labels, core = _host_merge_finish(
             n, args[2], own_glab, own_core, halo_gid, halo_glab
         )
-        stats.update(halo_cap=used_hcap, merge="host")
+        stats.update(
+            halo_cap=used_hcap, merge="host",
+            halo_bytes=_ring_halo_bytes(stats, used_hcap, k),
+        )
         return _canonicalize_roots(labels, core), core, stats, part, pid
     labels, core, m_rounds, used_hcap = out
     stats.update(
         halo_cap=used_hcap, merge_rounds=int(m_rounds),
         merge_converged=True,
+        halo_bytes=_ring_halo_bytes(stats, used_hcap, k),
     )
     labels, core = np.asarray(labels), np.asarray(core)
     return _canonicalize_roots(labels, core), core, stats, part, pid

@@ -31,8 +31,10 @@ def pair_overflow(pstats) -> int:
     ps = np.asarray(pstats).reshape(-1, 2)
     total, budget = int(ps[:, 0].max()), int(ps[:, 1].max())
     if budget and total > budget:
+        from ..obs import event as obs_event
         from .log import get_logger
 
+        obs_event("pair_overflow", total=total, budget=budget)
         get_logger().warning(
             "live tile-pair budget overflow (%d > %d); rerunning with "
             "an exact budget", total, budget,
@@ -98,6 +100,9 @@ def run_ladders(run_step, hint_key, pair_budget, merge_rounds):
             if rounds_attempts <= 0:
                 raise unconverged_error(this_rounds)
             nxt = max(1, 4 * this_rounds)
+            from ..obs import event as obs_event
+
+            obs_event("merge_unconverged", rounds=this_rounds, next=nxt)
             get_logger().warning(
                 "label merge unconverged after %d rounds; retrying with "
                 "%d", this_rounds, nxt,

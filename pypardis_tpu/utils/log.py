@@ -35,11 +35,26 @@ def enable(level: int = logging.INFO) -> None:
 
 
 def log_phase(phase: str, **fields) -> None:
-    """One structured line per pipeline phase (no-op unless enabled)."""
+    """One structured line per pipeline phase.
+
+    Always recorded as an ``events.log.<phase>`` entry in the current
+    telemetry recorder (:mod:`pypardis_tpu.obs`) — the log stream and
+    the run report can never disagree.  The logging emission is gated on
+    ``_logger.isEnabledFor`` ALONE: the old ``LOGGING or ...``
+    short-circuit meant a user configuring standard ``logging`` at INFO
+    through root handlers fired only by luck of the effective level,
+    while ``LOGGING=True`` force-emitted records the logger's own level
+    would then drop — the flag's job is done by ``enable()`` attaching
+    the handler, not by bypassing the level check.
+    """
+    from ..obs import current
+    from ..obs.registry import sanitize_segment
+
+    current().event(f"log.{sanitize_segment(phase)}", **fields)
     if LOGGING and not _logger.handlers:
         # The flag was set directly (without enable()) — honor it anyway;
         # the reference's sin was a flag nothing ever read.
         enable()
-    if LOGGING or _logger.isEnabledFor(logging.INFO):
+    if _logger.isEnabledFor(logging.INFO):
         kv = " ".join(f"{k}={v}" for k, v in fields.items())
         _logger.info("%s %s", phase, kv)

@@ -1,18 +1,23 @@
-"""Per-phase timing + device tracing.
+"""Per-phase timing + device tracing (now backed by :mod:`..obs`).
 
 The reference has no instrumentation at all — its only observability was
 the Spark web UI and a dead ``LOGGING`` flag (reference dbscan.py:9,
-SURVEY §5).  Here the driver phases (partition / shard / cluster / merge)
-report wall time through :class:`PhaseTimer`, and :func:`trace` wraps
-``jax.profiler`` so a device trace of the whole pipeline is one context
-manager away (view in TensorBoard / Perfetto).
+SURVEY §5).  :class:`PhaseTimer` keeps its original API (the drivers and
+tests use it), but every phase now also lands in the unified telemetry
+layer: a span in the current :class:`~pypardis_tpu.obs.RunRecorder`'s
+tracer (Chrome-trace exportable) and a ``phase.<name>`` timing in its
+metrics registry.  :func:`trace` still wraps ``jax.profiler`` so a
+device-level trace of the whole pipeline is one context manager away
+(view in TensorBoard / Perfetto) — the obs tracer is the cheap,
+always-on driver's-eye complement.
 """
 
 from __future__ import annotations
 
 import contextlib
-import time
 from typing import Dict
+
+from ..obs import MetricsRegistry, RunRecorder, Tracer  # noqa: F401 — re-export
 
 
 class PhaseTimer:
@@ -42,21 +47,23 @@ class PhaseTimer:
 
     @contextlib.contextmanager
     def phase(self, name: str):
-        t0 = time.perf_counter()
-        try:
-            yield self
-        finally:
-            import jax
+        from ..obs import current
 
-            if self._pending is not None:
-                jax.block_until_ready(self._pending)
-                self._pending = None
-            elif self._sync:
-                for dev in jax.devices():
-                    jax.device_put(0, dev).block_until_ready()
-            self.phases[f"{name}_s"] = self.phases.get(
-                f"{name}_s", 0.0
-            ) + (time.perf_counter() - t0)
+        rec = current()
+        with rec.span(name, sync=self._sync) as sp:
+            try:
+                yield self
+            finally:
+                if self._pending is not None:
+                    sp.sync_on(self._pending)
+                    self._pending = None
+        # sp.dur_s is set once the span context closed (after any sync).
+        self.phases[f"{name}_s"] = (
+            self.phases.get(f"{name}_s", 0.0) + sp.dur_s
+        )
+        from ..obs.registry import sanitize_segment
+
+        rec.metrics.observe(f"phase.{sanitize_segment(name)}", sp.dur_s)
 
     def as_dict(self) -> Dict[str, float]:
         return dict(self.phases)

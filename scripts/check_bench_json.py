@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""Validate bench.py's one-line JSON output (``make bench-smoke``).
+
+Reads stdin (or a file given as argv[1]), finds the last JSON object
+line, and checks the benchmark row schema: the classic
+``metric``/``value``/``unit`` triple plus the ``telemetry`` block
+(``pypardis_tpu/run_report@1`` — the same dict ``DBSCAN.report()``
+returns).  Exits nonzero with a reason on any violation, so CI catches
+schema drift before a BENCH_*.json archive does.
+"""
+
+import json
+import sys
+
+
+def fail(msg: str) -> None:
+    print(f"bench JSON check FAILED: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main() -> None:
+    if len(sys.argv) > 1:
+        data = open(sys.argv[1]).read()
+    else:
+        data = sys.stdin.read()
+    lines = [
+        ln for ln in data.strip().splitlines()
+        if ln.lstrip().startswith("{")
+    ]
+    if not lines:
+        fail("no JSON line found on stdout")
+    try:
+        row = json.loads(lines[-1])
+    except json.JSONDecodeError as e:
+        fail(f"last JSON-looking line does not parse: {e}")
+
+    for key in ("metric", "value", "unit"):
+        if key not in row:
+            fail(f"missing top-level key {key!r}")
+    if not isinstance(row["value"], (int, float)):
+        fail(f"value is {type(row['value']).__name__}, expected number")
+
+    tel = row.get("telemetry")
+    if not isinstance(tel, dict):
+        fail("missing/invalid 'telemetry' block")
+    if tel.get("schema") != "pypardis_tpu/run_report@1":
+        fail(f"telemetry schema is {tel.get('schema')!r}")
+    for key in ("run", "phases", "sharding", "devices", "events",
+                "metrics"):
+        if key not in tel:
+            fail(f"telemetry missing section {key!r}")
+    for key in ("halo_factor", "pad_waste"):
+        if key not in tel["sharding"]:
+            fail(f"telemetry.sharding missing {key!r}")
+    for key in ("restage", "pair_overflow", "halo_overflow",
+                "merge_unconverged", "compile"):
+        if key not in tel["events"]:
+            fail(f"telemetry.events missing {key!r}")
+    if not tel["phases"]:
+        fail("telemetry.phases is empty")
+    if "points" not in tel["devices"]:
+        fail("telemetry.devices missing per-device point counts")
+
+    print(
+        f"bench JSON OK: {row['metric']} = {row['value']} {row['unit']} "
+        f"(events: {tel['events']})"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -30,7 +30,12 @@ import jax
 
 if not _NATIVE:
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    # jax >= 0.5 splits the host platform via this option; on older
+    # versions (0.4.x) it doesn't exist and the XLA_FLAGS
+    # --xla_force_host_platform_device_count path above already covers
+    # the 8-device mesh.
+    if "jax_num_cpu_devices" in jax.config._value_holders:
+        jax.config.update("jax_num_cpu_devices", 8)
 
 import numpy as np
 import pytest

@@ -1,0 +1,290 @@
+"""Unified telemetry layer (pypardis_tpu.obs).
+
+Unit: registry schema/merge, span nesting + sync_on, Chrome-trace
+export round-trip, recorder events, the log_phase -> registry bridge.
+Integration: ``DBSCAN.fit().report()`` on the faked 8-device CPU mesh
+carries phase times, per-device partition sizes, halo_factor,
+pad_waste, and ladder event counts — and the exported trace JSON loads
+with a valid ``traceEvents`` list.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from pypardis_tpu import DBSCAN
+from pypardis_tpu.obs import (
+    MetricsRegistry,
+    RunRecorder,
+    Tracer,
+    build_run_report,
+    format_summary,
+    use_recorder,
+)
+
+
+# ---------------------------------------------------------------------------
+# MetricsRegistry
+# ---------------------------------------------------------------------------
+
+
+def test_registry_counter_gauge_timing():
+    reg = MetricsRegistry()
+    reg.inc("events.retry.restage")
+    reg.inc("events.retry.restage", 2)
+    reg.set("sharded.halo_factor", 0.25)
+    reg.observe("phase.cluster", 1.0)
+    reg.observe("phase.cluster", 3.0)
+    d = reg.as_dict()
+    assert d["counters"]["events.retry.restage"] == 3
+    assert d["gauges"]["sharded.halo_factor"] == 0.25
+    t = d["timings"]["phase.cluster"]
+    assert t["count"] == 2
+    assert t["total_s"] == pytest.approx(4.0)
+    assert t["min_s"] == 1.0 and t["max_s"] == 3.0
+    assert t["mean_s"] == pytest.approx(2.0)
+
+
+def test_registry_rejects_bad_keys():
+    reg = MetricsRegistry()
+    for bad in ("Upper.case", "spa ce", "", "trailing.", ".leading",
+                "dash-key"):
+        with pytest.raises(ValueError):
+            reg.inc(bad)
+
+
+def test_registry_numpy_values_become_python():
+    reg = MetricsRegistry()
+    reg.set("run.n_partitions", np.int32(8))
+    reg.inc("events.compile", np.int64(1))
+    reg.observe("phase.x", np.float32(0.5))
+    json.dumps(reg.as_dict())  # must not raise
+    assert isinstance(reg.as_dict()["gauges"]["run.n_partitions"], int)
+
+
+def test_registry_merge_semantics():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.inc("events.compile", 1)
+    b.inc("events.compile", 2)
+    a.set("run.n_partitions", 4)
+    b.set("run.n_partitions", 8)  # newer wins
+    a.observe("phase.cluster", 1.0)
+    b.observe("phase.cluster", 3.0)
+    a.merge(b)
+    d = a.as_dict()
+    assert d["counters"]["events.compile"] == 3
+    assert d["gauges"]["run.n_partitions"] == 8
+    assert d["timings"]["phase.cluster"]["count"] == 2
+    assert d["timings"]["phase.cluster"]["max_s"] == 3.0
+
+
+# ---------------------------------------------------------------------------
+# Tracer / spans
+# ---------------------------------------------------------------------------
+
+
+def test_span_nesting_depths_and_durations():
+    tr = Tracer()
+    with tr.span("outer"):
+        with tr.span("inner", stage=1):
+            pass
+    # inner closes first
+    assert [s.name for s in tr.spans] == ["inner", "outer"]
+    inner, outer = tr.spans
+    assert inner.depth == 1 and outer.depth == 0
+    assert inner.attrs == {"stage": 1}
+    assert 0 <= inner.dur_s <= outer.dur_s
+    # containment: inner lies within outer's interval
+    assert outer.t0_s <= inner.t0_s
+    assert inner.t0_s + inner.dur_s <= outer.t0_s + outer.dur_s + 1e-6
+    assert tr.durations()["outer"] >= tr.durations()["inner"]
+
+
+def test_span_sync_on_blocks_on_device_work():
+    import jax.numpy as jnp
+
+    tr = Tracer()
+    with tr.span("compute") as sp:
+        y = jnp.arange(1024) * 2
+        sp.sync_on(y)
+    assert tr.spans[0].dur_s is not None
+    # after the span, the pending handle is consumed
+    assert tr.spans[0]._pending is None
+
+
+def test_chrome_trace_export_round_trip(tmp_path):
+    tr = Tracer()
+    with tr.span("fit", n=100):
+        with tr.span("cluster"):
+            pass
+    path = tr.export_chrome_trace(str(tmp_path / "trace.json"))
+    doc = json.load(open(path))
+    events = doc["traceEvents"]
+    assert isinstance(events, list)
+    x_events = [e for e in events if e.get("ph") == "X"]
+    assert {e["name"] for e in x_events} == {"fit", "cluster"}
+    for e in x_events:
+        assert e["ts"] >= 0 and e["dur"] >= 0  # microseconds
+    assert x_events[-1]["args"] == {"n": 100}
+
+
+# ---------------------------------------------------------------------------
+# RunRecorder / events / log bridge
+# ---------------------------------------------------------------------------
+
+
+def test_recorder_events_bump_counters():
+    rec = RunRecorder()
+    rec.event("pair_overflow", total=10, budget=4)
+    rec.event("retry.restage", wait_s=10)
+    rec.event("retry.restage", wait_s=75)
+    counts = rec.event_counts()
+    assert counts == {"pair_overflow": 1, "retry.restage": 2}
+    kinds = [e["kind"] for e in rec.events]
+    assert kinds == ["pair_overflow", "retry.restage", "retry.restage"]
+    assert rec.events[0]["total"] == 10
+
+
+def test_log_phase_records_into_current_recorder():
+    from pypardis_tpu.utils.log import log_phase
+
+    rec = RunRecorder()
+    with use_recorder(rec):
+        log_phase("train", n=100, clusters=3)
+    assert rec.event_counts() == {"log.train": 1}
+    assert rec.events[0]["n"] == 100
+
+
+def test_phase_timer_feeds_registry_and_tracer():
+    from pypardis_tpu.utils.profiling import PhaseTimer
+
+    rec = RunRecorder()
+    with use_recorder(rec):
+        t = PhaseTimer()
+        with t.phase("cluster"):
+            pass
+    assert "cluster_s" in t.as_dict()  # original surface intact
+    reg = rec.metrics.as_dict()
+    assert reg["timings"]["phase.cluster"]["count"] == 1
+    assert [s.name for s in rec.tracer.spans] == ["cluster"]
+
+
+# ---------------------------------------------------------------------------
+# integration: DBSCAN.report() / summary() / export_trace()
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def fitted_model():
+    from sklearn.datasets import make_blobs
+
+    X, _ = make_blobs(
+        n_samples=2000, centers=8, n_features=4, cluster_std=0.3,
+        random_state=3,
+    )
+    return DBSCAN(eps=0.4, min_samples=5, block=64).fit(X)
+
+
+def test_report_schema_on_mesh(fitted_model):
+    r = fitted_model.report()
+    assert r["schema"] == "pypardis_tpu/run_report@1"
+    json.dumps(r)  # serializable end to end
+
+    # per-phase wall times
+    assert set(r["phases"]) >= {"partition", "cluster", "densify"}
+    assert all(v >= 0 for v in r["phases"].values())
+    assert r["run"]["total_s"] > 0
+    assert r["run"]["n_points"] == 2000 and r["run"]["n_dims"] == 4
+    assert r["run"]["n_devices"] == 8
+
+    # shard-layout overheads
+    assert r["sharding"]["halo_factor"] > 0
+    assert r["sharding"]["pad_waste"] >= 0
+    assert r["sharding"]["n_shard_partitions"] == 8
+    assert r["sharding"]["halo_bytes"] > 0
+
+    # per-device partition sizes: 8 devices, all points accounted for
+    dev = r["devices"]
+    assert dev["count"] == 8
+    assert len(dev["partition_sizes"]) == 8
+    assert sum(dev["points"]) == 2000
+
+    # restage / ladder event counts always present
+    assert set(r["events"]) == {
+        "restage", "transient_retry", "pair_overflow", "halo_overflow",
+        "merge_unconverged", "compile",
+    }
+    assert r["events"]["restage"] == 0
+
+    # registry dump rides along
+    assert "phase.cluster" in r["metrics"]["timings"]
+
+
+def test_summary_one_screen(fitted_model):
+    s = fitted_model.summary()
+    assert "2,000 pts x 4D" in s
+    assert "halo_factor" in s and "pad_waste" in s
+    assert "events:" in s
+    assert len(s.splitlines()) <= 8  # one screen, not a dump
+
+
+def test_export_trace_valid_chrome_json(fitted_model, tmp_path):
+    path = fitted_model.export_trace(str(tmp_path / "fit_trace.json"))
+    doc = json.load(open(path))
+    assert isinstance(doc["traceEvents"], list)
+    names = {e["name"] for e in doc["traceEvents"] if e.get("ph") == "X"}
+    assert "cluster" in names  # the driver phases are there
+    assert "sharded.build_shards" in names
+
+
+def test_report_single_shard_path():
+    # n < 2 * n_devices -> single-device route; schema keys still there.
+    X = np.random.default_rng(0).normal(size=(12, 3))
+    m = DBSCAN(eps=0.5, min_samples=2, block=64).fit(X)
+    r = m.report()
+    json.dumps(r)
+    assert r["run"]["n_devices"] == 1
+    assert r["devices"]["points"] == [12]
+    assert r["sharding"]["halo_factor"] == 0.0
+    assert "cluster" in r["phases"]
+
+
+def test_refit_resets_telemetry(fitted_model):
+    X = np.random.default_rng(1).normal(size=(64, 3))
+    m = DBSCAN(eps=0.5, min_samples=3, block=64)
+    m.fit(X)
+    first = m.report()
+    m.fit(X)
+    second = m.report()
+    # phases don't accumulate across fits
+    assert second["phases"]["cluster"] < first["phases"]["cluster"] * 10
+    assert second["run"]["n_points"] == 64
+    assert len(m._recorder.tracer.spans) < 40  # fresh tracer per fit
+
+
+def test_pair_overflow_event_recorded():
+    """An explicit too-small pair budget triggers the ladder; the event
+    lands in the active recorder (the same signal report() exposes)."""
+    from sklearn.datasets import make_blobs
+
+    from pypardis_tpu.obs import RunRecorder as RR
+    from pypardis_tpu.parallel import default_mesh, sharded_dbscan
+    from pypardis_tpu.partition import KDPartitioner
+    from pypardis_tpu.utils.hints import PAIR_BUDGET_HINTS
+
+    PAIR_BUDGET_HINTS.clear()
+    X, _ = make_blobs(
+        n_samples=2000, centers=8, n_features=3, cluster_std=0.3,
+        random_state=1,
+    )
+    mesh = default_mesh(8)
+    part = KDPartitioner(X, max_partitions=8)
+    rec = RR()
+    with use_recorder(rec):
+        sharded_dbscan(
+            X, part, eps=0.4, min_samples=5, block=64, mesh=mesh,
+            merge="device", pair_budget=1,
+        )
+    assert rec.event_counts().get("pair_overflow", 0) >= 1
+    PAIR_BUDGET_HINTS.clear()
