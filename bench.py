@@ -51,11 +51,12 @@ def main():
     # primary (a single sample previously made BENCH and BENCH_SCALE
     # disagree by 2x on the same config purely from link noise).
     reps = int(os.environ.get("BENCH_REPS", 3))
-    host_dt = float("inf")
+    host_samples = []
     for _ in range(reps):
         t0 = time.perf_counter()
         labels, _model = run(X)
-        host_dt = min(host_dt, time.perf_counter() - t0)
+        host_samples.append(time.perf_counter() - t0)
+    host_dt = min(host_samples)
 
     # Primary metric: fits on device-resident data — the TPU analogue
     # of the reference's train() on an already-distributed RDD (the
@@ -110,6 +111,13 @@ def main():
                 # BENCH_SCALE disagree on the same config, this says
                 # whether the delta is noise (large spread) or real.
                 "device_sample_spread": round(max(samples) / min(samples), 2),
+                # Raw per-rep wall times (device path, then host e2e):
+                # archived so a cross-round delta in the best-of-N
+                # headline is attributable to link/ambient noise vs a
+                # real regression WITHOUT rerunning (the r4->r5 4.7%
+                # question was undiagnosable from the archives alone).
+                "samples_s": [round(s, 4) for s in samples],
+                "host_samples_s": [round(s, 4) for s in host_samples],
                 "ari_vs_truth": round(ari_truth, 4),
                 "ari_vs_sklearn": ari_sklearn,
                 # The same run_report@1 schema DBSCAN.report() returns:

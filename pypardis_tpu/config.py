@@ -26,6 +26,10 @@ class DBSCANConfig:
     block: int = 1024
     precision: str = "high"
     kernel_backend: str = "auto"
+    # Owned-block clustering + edge-table merge on the sharded paths
+    # (halo points as adjacency evidence, never re-clustered); False
+    # restores the legacy duplicate-and-recluster step.
+    owner_computes: bool = True
 
     def build(self, mesh=None):
         from .dbscan import DBSCAN

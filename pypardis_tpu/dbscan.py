@@ -262,10 +262,11 @@ def _pad_and_run(
     def ladder(be):
         def run_step(pb, _mr):
             packed = run_with_restage(be, pair_budget=pb)
-            # In-band stats ride as the packed row's last two entries.
-            return packed, packed[-2:], True
+            # In-band [total, budget] stats ride in the packed row's
+            # tail (the last entry is the kernel pass count).
+            return packed, packed[-3:-1], True
 
-        return run_ladders(run_step, budget_key, None, 1)
+        return run_ladders(run_step, budget_key, None, 1)[0]
 
     try:
         packed = ladder(backend)
@@ -288,8 +289,18 @@ def _pad_and_run(
         # The pipeline's host fetch has completed, so the input
         # transfer is long since consumed — safe to recycle the buffer.
         _staging_return(staged)
-    roots, core, _total, _budget = unpack_pipeline_result(packed)
-    return roots[:n], core[:n]
+    roots, core, total, _budget, passes = unpack_pipeline_result(packed)
+    from .ops.pallas_kernels import _norm_precision_mode, effective_tile
+
+    info = {
+        "live_pairs": int(total),
+        "kernel_passes": int(passes),
+        "kernel_block": int(
+            effective_tile(block, cap, k, _norm_precision_mode(precision))
+            or block
+        ),
+    }
+    return roots[:n], core[:n], info
 
 
 def _expanded_neighbors(tree, points, eps) -> Dict:
@@ -329,7 +340,7 @@ def dbscan_partition(iterable, params):
     (_, part), _ = data[0]
     x = _as_float(np.stack([np.asarray(v) for (_k, _p), v in data]))
     y = [k for (k, _p), _v in data]
-    roots, core = _pad_and_run(
+    roots, core, _kinfo = _pad_and_run(
         x,
         params["eps"],
         params["min_samples"],
@@ -377,6 +388,7 @@ class DBSCAN:
         kernel_backend: str = "auto",
         merge: str = "auto",
         profile_dir: Optional[str] = None,
+        owner_computes: bool = True,
     ):
         self.eps = float(eps)
         self.min_samples = int(min_samples)
@@ -389,6 +401,11 @@ class DBSCAN:
         self.kernel_backend = kernel_backend
         self.merge = merge
         self.profile_dir = profile_dir
+        # Owned-block clustering + edge-table merge on the sharded
+        # paths (halo points are adjacency evidence, never re-clustered
+        # — see parallel.sharded).  False restores the legacy
+        # duplicate-and-recluster step for A/B comparison.
+        self.owner_computes = bool(owner_computes)
         # Reference attribute surface (dbscan.py:93-102).
         self.data = None
         self._result_cache = None
@@ -586,6 +603,7 @@ class DBSCAN:
                 "precision": self.precision,
                 "kernel_backend": self.kernel_backend,
                 "merge": self.merge,
+                "owner_computes": self.owner_computes,
             },
             n_points=len(self.labels_),
             n_dims=self._fit_info.get("n_dims", 0),
@@ -626,7 +644,7 @@ class DBSCAN:
         with timer.phase("cluster"):
             # _pad_and_run materializes numpy outputs, so the phase
             # bound includes all device execution.
-            roots, core = _pad_and_run(
+            roots, core, kinfo = _pad_and_run(
                 points, self.eps, self.min_samples, self.metric, self.block,
                 precision=self.precision, backend=self.kernel_backend,
             )
@@ -634,6 +652,8 @@ class DBSCAN:
         with timer.phase("densify"):
             self.labels_ = densify_labels(roots)
         self.metrics_["n_partitions"] = 1
+        # Kernel telemetry behind the report's achieved-FLOP/s model.
+        self.metrics_.update(kinfo)
         if _is_device_array(points):
             # Reduce on device; ONE stacked fetch of the extrema — each
             # device->host transfer has ~0.2s fixed latency on tunneled
@@ -712,6 +732,7 @@ class DBSCAN:
                 backend=self.kernel_backend,
                 merge=self.merge,
                 halo=halo,
+                owner_computes=self.owner_computes,
             )
         with timer.phase("densify"):
             self.labels_ = densify_labels(labels)
@@ -755,6 +776,7 @@ class DBSCAN:
                 max_partitions=self.max_partitions,
                 split_method=self.split_method,
                 merge=self.merge,
+                owner_computes=self.owner_computes,
             )
         with timer.phase("densify"):
             self.labels_ = densify_labels(labels)

@@ -31,7 +31,85 @@ _SHARDING_KEYS = (
     "halo_exchange",
     "halo_bytes",
     "input",
+    "owner_computes",
+    "duplicated_work_factor",
+    "staged_bytes_reused",
+    "staged_bytes",
 )
+
+# Model-FLOP peak per chip for the MFU denominator, matched by
+# substring against jax's device_kind.  Values are the vendor bf16
+# matmul peaks — the kernels' default ``precision='high'`` synthesizes
+# fp32 from bf16 passes on these units, so MFU against the bf16 peak
+# UNDERSTATES utilization by the synthesis factor (~3x); it is a
+# consistent, comparable lower bound, not a marketing number.  Override
+# with PYPARDIS_PEAK_FLOPS=<flops/sec> for unlisted hardware.
+_PEAK_FLOPS_TABLE = (
+    ("v5 lite", 197e12),  # v5e ("TPU v5 lite")
+    ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v6", 918e12),  # Trillium
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+# No table entry (CPU CI, exotic chips): a nominal 1 TFLOP/s keeps mfu
+# finite and comparable across CI runs without pretending to know the
+# host's real peak; peak_source says which case applied.
+_PEAK_FLOPS_DEFAULT = 1e12
+
+
+def _peak_flops():
+    """(peak_flops, source) for the current default backend's chips."""
+    import os
+
+    env = os.environ.get("PYPARDIS_PEAK_FLOPS")
+    if env:
+        return float(env), "env"
+    try:
+        import jax
+
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:  # noqa: BLE001 — reporting must never raise
+        kind = ""
+    for sub, peak in _PEAK_FLOPS_TABLE:
+        if sub in kind:
+            return peak, f"table:{sub}"
+    return _PEAK_FLOPS_DEFAULT, "default"
+
+
+def _compute_section(metrics: Dict, phases: Dict, n_dims: int) -> Dict:
+    """Achieved-FLOP/s and MFU from the kernels' in-band pair stats.
+
+    The tiled kernels' work model: every live (row, col) tile pair
+    costs ``block^2`` point pairs, each ``2 * (d + 2)`` flops under the
+    matmul distance decomposition (the |x|^2+|y|^2-2xy operands carry
+    d+2 rows), and the counts/propagation/border passes each walk the
+    same live-pair list — so model FLOPs = ``pairs * block^2 * (d+2) *
+    2 * passes``.  ``achieved_flops_per_sec`` divides by the cluster
+    phase's wall seconds; ``mfu`` divides that by the chip peak.  On
+    multi-device meshes ``pairs`` is the worst-case device's total (the
+    binding serial path), so the figure is per-chip.  All fields are
+    always present and finite — 0.0 means the fit carried no pair
+    telemetry (e.g. an empty dataset), never NaN.
+    """
+    pairs = int(metrics.get("live_pairs", 0) or 0)
+    block = int(metrics.get("kernel_block", 0) or 0)
+    passes = int(metrics.get("kernel_passes", 0) or 0)
+    cluster_s = float(phases.get("cluster", 0.0) or 0.0)
+    flops = float(pairs) * block * block * (n_dims + 2) * 2.0 * passes
+    achieved = flops / cluster_s if cluster_s > 0 else 0.0
+    peak, source = _peak_flops()
+    return {
+        "live_pairs": pairs,
+        "kernel_block": block,
+        "kernel_passes": passes,
+        "model_flops": flops,
+        "achieved_flops_per_sec": round(achieved, 1),
+        "peak_flops": peak,
+        "peak_source": source,
+        "mfu": round(achieved / peak, 8) if peak > 0 else 0.0,
+    }
 
 
 def _clean(v):
@@ -77,6 +155,11 @@ def build_run_report(
     sharding.setdefault("halo_factor", 0.0)
     sharding.setdefault("pad_waste", 0.0)
     sharding.setdefault("n_partitions", int(metrics.get("n_partitions", 1)))
+    # Always-present perf-contract fields (validated by
+    # scripts/check_bench_json.py): a single-shard fit clusters each
+    # point exactly once (factor 1.0) and stages nothing reusable.
+    sharding.setdefault("duplicated_work_factor", 1.0)
+    sharding.setdefault("staged_bytes_reused", 0)
 
     psizes = metrics.get("partition_sizes")
     devices: Dict = {"count": int(n_devices)}
@@ -125,6 +208,7 @@ def build_run_report(
         },
         "phases": phases,
         "sharding": sharding,
+        "compute": _compute_section(metrics, phases, n_dims),
         "devices": devices,
         "events": events,
         "metrics": (
@@ -166,6 +250,7 @@ def format_summary(report: Dict) -> str:
         f"{parts} partition(s)",
         f"halo_factor {sh['halo_factor']:.3f}",
         f"pad_waste {sh['pad_waste']:.3f}",
+        f"dup_work {sh['duplicated_work_factor']:.2f}x",
     ]
     if "halo_bytes" in sh:
         shard_bits.append(f"halo {_fmt_bytes(sh['halo_bytes'])}")
@@ -174,7 +259,23 @@ def format_summary(report: Dict) -> str:
         if "merge_rounds" in sh:
             m += f" ({sh['merge_rounds']} rounds)"
         shard_bits.append(m)
+    if sh.get("owner_computes"):
+        shard_bits.append("owner-computes")
+    if sh.get("staged_bytes_reused", 0) > 0:
+        shard_bits.append(
+            f"staged_reuse {_fmt_bytes(sh['staged_bytes_reused'])}"
+        )
     lines.append("  sharding: " + ", ".join(shard_bits))
+    comp = report.get("compute", {})
+    if comp.get("live_pairs", 0) > 0:
+        lines.append(
+            f"  compute: {comp['live_pairs']:,} live pairs x "
+            f"{comp['kernel_passes']} pass(es) @ block "
+            f"{comp['kernel_block']} -> "
+            f"{comp['achieved_flops_per_sec'] / 1e9:,.1f} GFLOP/s "
+            f"(mfu {comp['mfu']:.2%} of {comp['peak_flops'] / 1e12:.0f} "
+            f"TFLOP/s {comp['peak_source']} peak)"
+        )
     dev_pts = report["devices"].get("points")
     if dev_pts and len(dev_pts) > 1:
         lo, hi = min(dev_pts), max(dev_pts)

@@ -444,7 +444,8 @@ def live_tile_pairs(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("metric", "block", "precision", "layout")
+    jax.jit,
+    static_argnames=("metric", "block", "precision", "layout", "row_tiles"),
 )
 def neighbor_counts(
     points: jnp.ndarray,
@@ -454,6 +455,7 @@ def neighbor_counts(
     block: int = 1024,
     precision: str = "high",
     layout: str = "nd",
+    row_tiles: int | None = None,
 ) -> jnp.ndarray:
     """Per-point count of valid points within eps (self included).
 
@@ -464,11 +466,18 @@ def neighbor_counts(
     bounding box lies farther than eps from the row tile's are skipped
     (``lax.cond``), so spatially sorted inputs do O(N * local density)
     work instead of O(N^2).
+
+    ``row_tiles`` restricts the computed ROWS to the first
+    ``row_tiles * block`` points (the output shrinks to match) while
+    columns still cover all N — the owner-computes primitive: owned
+    slots occupy the slab prefix, and their counts need halo columns
+    as evidence without ever counting the halo rows themselves.
     """
     metric = _norm_metric(metric)
     layout = _norm_layout(layout)
     nt, pts, msk = _tiles_t(points, mask, block, layout)
     lo, hi = tile_bounds(pts, msk)
+    rt = nt if row_tiles is None else min(row_tiles, nt)
 
     def row_tile(xi, mi, lo_i, hi_i):
         skip = tile_skip_mask(lo_i, hi_i, lo, hi, eps, metric)
@@ -486,12 +495,17 @@ def neighbor_counts(
         counts, _ = jax.lax.scan(col_step, acc0, jnp.arange(nt))
         return jnp.where(mi, counts, 0)
 
-    counts = jax.lax.map(lambda args: row_tile(*args), (pts, msk, lo, hi))
+    counts = jax.lax.map(
+        lambda args: row_tile(*args), (pts[:rt], msk[:rt], lo[:rt], hi[:rt])
+    )
     return counts.reshape(-1)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("metric", "block", "precision", "layout")
+    jax.jit,
+    static_argnames=(
+        "metric", "block", "precision", "layout", "owned_tiles",
+    ),
 )
 def min_neighbor_label(
     points: jnp.ndarray,
@@ -503,6 +517,7 @@ def min_neighbor_label(
     precision: str = "high",
     row_mask: jnp.ndarray | None = None,
     layout: str = "nd",
+    owned_tiles: int | None = None,
 ) -> jnp.ndarray:
     """Per-point min label over eps-neighbors drawn from ``src_mask``.
 
@@ -515,6 +530,12 @@ def min_neighbor_label(
     may be silently pruned to INT32_MAX.  The default (``None``) covers
     ALL rows, so every row's output is correct — pass a mask only when
     you will mask those rows out anyway.
+
+    ``owned_tiles`` declares the first ``owned_tiles * block`` slots as
+    OWNED and the rest as halo: (halo row, halo col) tile pairs are
+    skipped outright.  Halo slots then exchange labels with owned slots
+    only — the owner-computes adjacency rule, where halo-halo edges are
+    each some partition's owned-halo edge and are recovered there.
     """
     metric = _norm_metric(metric)
     layout = _norm_layout(layout)
@@ -527,9 +548,12 @@ def min_neighbor_label(
         row_lo, row_hi = tile_bounds(pts, jnp.ones_like(smsk))
     else:
         row_lo, row_hi = tile_bounds(pts, row_mask.reshape(nt, block))
+    col_ids = jnp.arange(nt, dtype=jnp.int32)
 
-    def row_tile(xi, lo_i, hi_i):
+    def row_tile(ri, xi, lo_i, hi_i):
         skip = tile_skip_mask(lo_i, hi_i, lo, hi, eps, metric)
+        if owned_tiles is not None:
+            skip = skip | ((ri >= owned_tiles) & (col_ids >= owned_tiles))
 
         def col_step(acc, jc):
             def compute(a):
@@ -545,5 +569,8 @@ def min_neighbor_label(
         best, _ = jax.lax.scan(col_step, acc0, jnp.arange(nt))
         return best
 
-    best = jax.lax.map(lambda args: row_tile(*args), (pts, row_lo, row_hi))
+    best = jax.lax.map(
+        lambda args: row_tile(*args),
+        (jnp.arange(nt, dtype=jnp.int32), pts, row_lo, row_hi),
+    )
     return best.reshape(-1)

@@ -142,15 +142,20 @@ def dbscan_fixed_size(
     ``block``; ``mask``: (N,) bool validity.  Returns ``(labels, core,
     pair_stats)``:
 
-    * ``pair_stats``: (2,) int32 ``[live_pairs_total, budget]``.  On
-      the Pallas path, from the tile-pair extraction: when ``total >
-      budget`` the labels are INVALID — pairs were dropped — and the
-      caller must rerun with ``pair_budget >= total`` (``pair_budget``
-      is static; the returned total is exact, so one retry always
-      suffices).  The XLA path reports its true total with budget 0
-      ("cannot overflow") — or the caller's explicit ``pair_budget``,
-      mirroring the overflow contract so the drivers' rerun ladder is
-      exercisable off-TPU (labels stay valid either way).
+    * ``pair_stats``: (3,) int32 ``[live_pairs_total, budget,
+      kernel_passes]``.  On the Pallas path, the first two come from
+      the tile-pair extraction: when ``total > budget`` the labels are
+      INVALID — pairs were dropped — and the caller must rerun with
+      ``pair_budget >= total`` (``pair_budget`` is static; the
+      returned total is exact, so one retry always suffices).  The XLA
+      path reports its true total with budget 0 ("cannot overflow") —
+      or the caller's explicit ``pair_budget``, mirroring the overflow
+      contract so the drivers' rerun ladder is exercisable off-TPU
+      (labels stay valid either way).  ``kernel_passes`` counts the
+      full tiled passes actually executed (1 counts pass + the
+      propagation rounds + the border recompute when taken) — the
+      ``passes`` term of the achieved-FLOP/s model in
+      ``obs.report``.
 
     * ``labels``: (N,) int32 — the *root point index* of the point's
       cluster (min index over the component's core points), or -1 for
@@ -268,7 +273,7 @@ def dbscan_fixed_size(
         f_new = _pointer_jump(f_new, core)
         return f_new, g, jnp.any(f_new != f), rounds + 1
 
-    f, g, changed, _ = jax.lax.while_loop(
+    f, g, changed, rounds = jax.lax.while_loop(
         cond, body, (f0, f0, jnp.bool_(True), 0)
     )
 
@@ -286,7 +291,220 @@ def dbscan_fixed_size(
     labels = jnp.where(
         core, f, jnp.where(mask & (border != _INT_INF), border, -1)
     ).astype(jnp.int32)
+    # Tiled passes executed: the counts pass, one minlab per round, and
+    # the border recompute when the loop exited at max_rounds.
+    passes = 1 + rounds + changed.astype(jnp.int32)
+    pair_stats = jnp.concatenate([pair_stats[:2], passes[None]])
     return labels, core, pair_stats
+
+
+# ---------------------------------------------------------------------------
+# Owner-computes clustering: halo slots are adjacency evidence, never
+# re-clustered.
+#
+# The legacy sharded step ran full DBSCAN over each partition's
+# (owned + halo) slab — every halo point was neighbor-counted, core-
+# tested and label-propagated a second time in every foreign partition
+# (the reference's duplicate-points-into-neighborhoods design,
+# PAPER.md steps 2-4; measured as a 3.16x duplicated-work tax at the
+# r5 geometry).  The owner-computes formulation keeps the halo slots
+# only as *evidence*:
+#
+# * counts run over OWNED rows only (halo columns still contribute, so
+#   owned core status stays exact under the 2*eps halo guarantee);
+# * halo core flags come from each point's OWNER (the home partition's
+#   counts), not from a local recount;
+# * the min-label propagation runs with (halo row, halo col) tile
+#   pairs skipped: halo-core slots relay labels between owned clusters
+#   they touch (a core halo point genuinely connects them), but
+#   halo-halo edges are dropped — every such edge is some partition's
+#   owned-halo edge (one endpoint is owned wherever it is home), so
+#   the cross-partition merge recovers exactly those links from the
+#   home runs' tables.  Local components may come back finer than the
+#   legacy run's; the merged result is identical.
+#
+# Each halo slot's final label IS the compact (owned_root, halo_gid)
+# edge table the merge consumes — same wire format as the legacy halo
+# occurrence tables, so both merge modes (in-graph pmin loop and the
+# host union-find spill) work unchanged.
+# ---------------------------------------------------------------------------
+
+
+def _oc_sorted_pairs(pairs, keep, nt):
+    """Re-sort a filtered Pallas pair list back to row-major.
+
+    Dropped entries take the dump row ``nt`` (col 0) and a stable sort
+    on the row id moves them to the tail while preserving each kept
+    row's consecutive run — the layout `_first_visit` requires.
+    """
+    rows, cols = pairs
+    rows = jnp.where(keep, rows, nt)
+    cols = jnp.where(keep, cols, 0)
+    order = jnp.argsort(rows, stable=True)
+    return rows[order], cols[order]
+
+
+def oc_extract(
+    points, eps, mask, *, owned, metric, block, precision, backend,
+    layout: str = "nd", pair_budget: int | None = None,
+):
+    """Shared pre-pass for the owner-computes kernels.
+
+    Resolves the backend once and extracts whatever the passes share:
+    the Pallas tile-pair list, or (XLA) the diagnostic live-pair count.
+    Returns ``(kind, pairs, stats)`` — ``kind`` in ``("xla",
+    "pallas")``, ``pairs`` None on XLA, ``stats`` (2,) int32
+    ``[live_pairs_total, budget]`` with the usual overflow contract.
+
+    The XLA total subtracts the halo-halo tile pairs the propagation
+    will skip, so ``live_pairs`` reflects the work this path actually
+    does (the Pallas total stays the extraction's — its budget
+    semantics bind the full list).
+    """
+    from .distances import count_live_tile_pairs
+
+    n = points.shape[0] if layout == "nd" else points.shape[1]
+    d = points.shape[1] if layout == "nd" else points.shape[0]
+    kind = resolve_backend(backend, metric, n, block, d, precision)
+    if kind == "pallas":
+        from .pallas_kernels import (
+            _check_mosaic_tile,
+            _norm_precision_mode,
+            _pallas_block,
+            kernel_pair_list,
+        )
+
+        _check_mosaic_tile(
+            _pallas_block(block, n, d, _norm_precision_mode(precision)),
+            n, interpret=jax.default_backend() != "tpu",
+        )
+        pairs, stats = kernel_pair_list(
+            points, eps, mask, block, precision, layout,
+            budget=pair_budget,
+        )
+        return "pallas", pairs, stats
+    from .pallas_kernels import _norm_precision_mode, effective_tile
+
+    count_block = effective_tile(
+        block, n, d, _norm_precision_mode(precision)
+    ) or block
+    total = count_live_tile_pairs(
+        points, mask, eps, metric=metric, block=count_block, layout=layout,
+    )
+    if owned < n:
+        halo = (
+            points[owned:] if layout == "nd" else points[:, owned:]
+        )
+        total = total - count_live_tile_pairs(
+            halo, mask[owned:], eps, metric=metric,
+            block=min(count_block, n - owned), layout=layout,
+        )
+    stats = jnp.stack(
+        [total, jnp.int32(0 if pair_budget is None else pair_budget)]
+    )
+    return "xla", None, stats
+
+
+def oc_counts(
+    points, eps, min_samples, mask, *, owned, metric, block, precision,
+    kind, pairs, layout: str = "nd",
+):
+    """Owned-row core flags: counts over owned ROWS x all columns.
+
+    ``owned`` (static) is the slab prefix length holding owned slots;
+    halo columns contribute to the counts (exactness under the 2*eps
+    halo) but no halo row is ever counted.  Returns (owned,) bool.
+    """
+    if kind == "pallas":
+        from .pallas_kernels import (
+            _norm_precision_mode, _pallas_block, neighbor_counts_pallas,
+        )
+
+        n = points.shape[0] if layout == "nd" else points.shape[1]
+        d = points.shape[1] if layout == "nd" else points.shape[0]
+        pb = _pallas_block(block, n, d, _norm_precision_mode(precision))
+        nt, ont = n // pb, owned // pb
+        counts = neighbor_counts_pallas(
+            points, eps, mask, block=block, precision=precision,
+            layout=layout,
+            pairs=_oc_sorted_pairs(pairs, pairs[0] < ont, nt),
+        )[:owned]
+    else:
+        counts = neighbor_counts(
+            points, eps, mask, metric=metric, block=block,
+            precision=precision, layout=layout, row_tiles=owned // block,
+        )
+    # Same self-count clamp as dbscan_fixed_size: a valid point is
+    # always within eps of itself, whatever the f32 expansion says.
+    return (jnp.maximum(counts, 1) >= min_samples) & mask[:owned]
+
+
+def oc_propagate(
+    points, eps, mask, core_all, *, owned, metric, block, precision,
+    kind, pairs, max_rounds: int = 64, layout: str = "nd",
+):
+    """Min-label propagation with halo slots as relay-only nodes.
+
+    ``core_all``: (N,) — owned slots' exact core flags followed by the
+    halo slots' OWNER-computed flags.  Halo-halo tile pairs are
+    skipped; halo-core slots still receive from and transmit to owned
+    slots, so a core halo point adjacent to two owned clusters bridges
+    them (the single-min edge a plain attachment table would emit is
+    provably too weak — a bridging halo point must link EVERY adjacent
+    owned cluster).  Returns ``(labels, passes)``: per-slot root local
+    indices (-1 noise; halo slots carry their edge-table labels), and
+    the number of minlab passes executed.
+    """
+    n = points.shape[0] if layout == "nd" else points.shape[1]
+    if kind == "pallas":
+        from .pallas_kernels import (
+            _norm_precision_mode, _pallas_block, min_neighbor_label_pallas,
+        )
+
+        d = points.shape[1] if layout == "nd" else points.shape[0]
+        pb = _pallas_block(block, n, d, _norm_precision_mode(precision))
+        nt, ont = n // pb, owned // pb
+        rows, cols = pairs
+        prop_pairs = _oc_sorted_pairs(
+            pairs, ~((rows >= ont) & (cols >= ont)), nt
+        )
+        minlab_fn = functools.partial(
+            min_neighbor_label_pallas, block=block, precision=precision,
+            layout=layout, pairs=prop_pairs,
+        )
+    else:
+        minlab_fn = functools.partial(
+            min_neighbor_label, metric=metric, block=block,
+            precision=precision, layout=layout,
+            owned_tiles=owned // block,
+        )
+
+    idx = jnp.arange(n, dtype=jnp.int32)
+    f0 = jnp.where(core_all, idx, _INT_INF)
+
+    def cond(state):
+        f, g, changed, rounds = state
+        return changed & (rounds < max_rounds)
+
+    def body(state):
+        f, _, _, rounds = state
+        g = minlab_fn(points, f, eps, core_all, row_mask=mask)
+        f_new = jnp.where(core_all, jnp.minimum(f, g), f)
+        f_new = _pointer_jump(f_new, core_all)
+        return f_new, g, jnp.any(f_new != f), rounds + 1
+
+    f, g, changed, rounds = jax.lax.while_loop(
+        cond, body, (f0, f0, jnp.bool_(True), 0)
+    )
+    border = jax.lax.cond(
+        changed,
+        lambda: minlab_fn(points, f, eps, core_all, row_mask=mask),
+        lambda: g,
+    )
+    labels = jnp.where(
+        core_all, f, jnp.where(mask & (border != _INT_INF), border, -1)
+    ).astype(jnp.int32)
+    return labels, rounds + changed.astype(jnp.int32)
 
 
 # ---------------------------------------------------------------------------

@@ -325,8 +325,8 @@ def _pipeline_pack(roots_s, core_s, pair_stats, owner, *, cap):
     back to input order.  ``owner`` sends pad slots to the dump row
     ``cap`` of a (cap+1,)-sized scatter target.
 
-    Output is ONE (cap + 2,) int32 row — ``(root + 1) | core << 30``
-    per point plus the two pair stats — rather than separate root/core
+    Output is ONE (cap + 3,) int32 row — ``(root + 1) | core << 30``
+    per point plus the three pair stats — rather than separate root/core
     rows: the device->host result transfer runs at single-digit MB/s on
     degraded tunnel sessions, so halving its bytes is wall-clock that
     matters.  Roots are < cap <= 2^30 (checked at trace time), so bit
@@ -348,13 +348,14 @@ def _pipeline_pack(roots_s, core_s, pair_stats, owner, *, cap):
 def unpack_pipeline_result(packed):
     """Host-side decode of :func:`_pipeline_pack`'s single int32 row.
 
-    Returns ``(roots, core, total, budget)`` — roots in input order
-    (-1 noise), core as bool, plus the live tile-pair stats.
+    Returns ``(roots, core, total, budget, passes)`` — roots in input
+    order (-1 noise), core as bool, plus the live tile-pair stats and
+    the kernel pass count (the FLOP-model ``passes`` term).
     """
-    body = packed[:-2]
+    body = packed[:-3]
     roots = (body & 0x3FFFFFFF) - 1
     core = (body >> 30) > 0
-    return roots, core, int(packed[-2]), int(packed[-1])
+    return roots, core, int(packed[-3]), int(packed[-2]), int(packed[-1])
 
 
 @functools.partial(
@@ -524,6 +525,14 @@ def _cluster_stepped(
                 xs, f, eps, core, mask_k, rows, cols, **kw
             ),
         )
+    # Kernel passes for the FLOP model: one counts pass, up to batch_k
+    # minlab rounds per executed batch (the in-batch convergence round
+    # is not observable from the host — this is a tight upper bound),
+    # plus the explicit border pass on a non-converged exit.
+    passes = 1 + batches * batch_k + (0 if converged else 1)
+    pair_stats = jnp.concatenate(
+        [pair_stats[:2], jnp.asarray([passes], jnp.int32)]
+    )
     return _transient_retry(
         "pack",
         lambda: np.array(_pipeline_finish_pack(

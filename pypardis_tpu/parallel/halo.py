@@ -16,6 +16,12 @@ host round-trip.  Capacity is static (XLA shapes): callers size ``hcap``
 and the returned ``overflow`` count says whether any in-box point had to
 be dropped — the driver treats overflow as an error and re-runs with a
 bigger capacity.
+
+The exchanged slabs are *transport*, not a mandate to re-cluster: under
+the owner-computes step (``sharded._device_cluster_merge_oc``) the
+received halo rows serve only as neighbor-count evidence and relay
+nodes, so the exchange's byte volume is the whole duplication cost the
+ring path pays.
 """
 
 from __future__ import annotations

@@ -67,8 +67,13 @@ def merge_occurrences(
 
     ``home_label``: (N,) each point's label from its home partition
     (root gid, -1 noise).  ``core``: (N,) home-run core flags.
-    ``occ_gid``/``occ_label``: flattened halo occurrences — point gid and
-    the label that point received in a *foreign* partition run.
+    ``occ_gid``/``occ_label``: flattened halo occurrences — point gid
+    and the label that point received in a *foreign* partition.  Both
+    sharded cluster steps emit this same wire format: the legacy step's
+    occurrences are full re-clustering labels, the owner-computes
+    step's are compact (owned_root, halo_gid) edge-table entries (the
+    halo point's relay label against the foreign partition's OWNED
+    clusters) — the union-find below is indifferent.
 
     Implements the reference merge rule (aggregator.py:38-40): an
     occurrence links its label to the point's home label only if the

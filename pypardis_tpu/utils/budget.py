@@ -19,16 +19,27 @@ from .hints import PAIR_BUDGET_HINTS
 from .shaping import round_up
 
 
+def _stat_rows(pstats) -> np.ndarray:
+    """Normalize pstats to (n_runs, width) rows.
+
+    Rows are ``[live_pairs_total, budget]`` or ``[live_pairs_total,
+    budget, kernel_passes]`` — the ladder only reads the first two
+    columns; the third rides through for the drivers' FLOP model.
+    """
+    ps = np.asarray(pstats)
+    return ps.reshape(-1, ps.shape[-1] if ps.ndim else 1)
+
+
 def pair_overflow(pstats) -> int:
     """Exact pair budget to retry with, or 0 when nothing overflowed.
 
-    ``pstats``: (n_runs, 2) per-run ``[live_pairs_total, budget]``.
+    ``pstats``: (n_runs, 2+) per-run ``[live_pairs_total, budget, ...]``.
     Budgets are shared (static), so the max total is the binding
     requirement; the total is exact, so one retry always suffices.
     ``budget == 0`` means no static budget was in play (the XLA path's
     "cannot overflow" report).
     """
-    ps = np.asarray(pstats).reshape(-1, 2)
+    ps = _stat_rows(pstats)
     total, budget = int(ps[:, 0].max()), int(ps[:, 1].max())
     if budget and total > budget:
         from ..obs import event as obs_event
@@ -46,7 +57,7 @@ def pair_overflow(pstats) -> int:
 def seed_hint(key, pstats) -> None:
     """Remember the exact budget that sufficed after an observed
     overflow (seed-on-overflow-only — see utils.hints)."""
-    total = int(np.asarray(pstats).reshape(-1, 2)[:, 0].max())
+    total = int(_stat_rows(pstats)[:, 0].max())
     if total > 0:
         PAIR_BUDGET_HINTS.put(key, round_up(total, 4096))
 
@@ -70,6 +81,10 @@ def run_ladders(run_step, hint_key, pair_budget, merge_rounds):
     raises — never returns labels built from a truncated pair list),
     hint seeding after an observed overflow, and one 4x merge-rounds
     retry on non-convergence (then raises).
+
+    Returns ``(outputs, pstats)`` — the successful attempt's outputs
+    plus its pair stats, so drivers can surface live-pair volume and
+    kernel passes (the achieved-FLOP/s model) without a second fetch.
     """
     from .log import get_logger
 
@@ -112,4 +127,4 @@ def run_ladders(run_step, hint_key, pair_budget, merge_rounds):
         break
     if overflowed:
         seed_hint(hint_key, pstats)
-    return outputs
+    return outputs, pstats

@@ -45,13 +45,32 @@ def main() -> None:
         fail("missing/invalid 'telemetry' block")
     if tel.get("schema") != "pypardis_tpu/run_report@1":
         fail(f"telemetry schema is {tel.get('schema')!r}")
-    for key in ("run", "phases", "sharding", "devices", "events",
-                "metrics"):
+    for key in ("run", "phases", "sharding", "compute", "devices",
+                "events", "metrics"):
         if key not in tel:
             fail(f"telemetry missing section {key!r}")
+
+    def number(section, key):
+        v = tel[section].get(key)
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            fail(f"telemetry.{section}.{key} is {v!r}, expected number")
+        if v != v or v in (float("inf"), float("-inf")):
+            fail(f"telemetry.{section}.{key} is non-finite ({v!r})")
+        return v
+
     for key in ("halo_factor", "pad_waste"):
-        if key not in tel["sharding"]:
-            fail(f"telemetry.sharding missing {key!r}")
+        number("sharding", key)
+    # Owner-computes / staging perf contract (ISSUE 2): the duplicated
+    # clustered-volume factor and the staging-reuse counter must be
+    # present and finite on EVERY row (single-shard rows report 1.0/0).
+    number("sharding", "duplicated_work_factor")
+    number("sharding", "staged_bytes_reused")
+    # Achieved-FLOP/s model: live pairs, pass count, mfu — finite
+    # numbers always; a fit with no pair telemetry reports zeros, never
+    # NaN.
+    for key in ("live_pairs", "kernel_passes",
+                "achieved_flops_per_sec", "mfu"):
+        number("compute", key)
     for key in ("restage", "pair_overflow", "halo_overflow",
                 "merge_unconverged", "compile"):
         if key not in tel["events"]:
@@ -63,7 +82,9 @@ def main() -> None:
 
     print(
         f"bench JSON OK: {row['metric']} = {row['value']} {row['unit']} "
-        f"(events: {tel['events']})"
+        f"(dup_work={tel['sharding']['duplicated_work_factor']}, "
+        f"staged_reuse={tel['sharding']['staged_bytes_reused']}, "
+        f"mfu={tel['compute']['mfu']}, events: {tel['events']})"
     )
 
 

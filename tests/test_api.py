@@ -131,8 +131,8 @@ def test_pad_and_run_device_input_single_shard(blobs750):
     from sklearn.metrics import adjusted_rand_score
 
     X = blobs750.astype(np.float32)
-    r_host, c_host = _pad_and_run(X, 0.3, 10, "euclidean", 256)
-    r_dev, c_dev = _pad_and_run(jnp.asarray(X), 0.3, 10, "euclidean", 256)
+    r_host, c_host, _ = _pad_and_run(X, 0.3, 10, "euclidean", 256)
+    r_dev, c_dev, _ = _pad_and_run(jnp.asarray(X), 0.3, 10, "euclidean", 256)
     # The two paths center by slightly different constants (f64 vs f32
     # mean), so exact-eps boundary pairs may legitimately flip; demand
     # identical cluster STRUCTURE, not bit-equal roots.
@@ -155,15 +155,15 @@ def test_packed_pipeline_result_roundtrip():
         [True, False, True, False, False, True, True, False] + [False] * 8
     )
     owner = jnp.arange(cap, dtype=jnp.int32)
-    stats = jnp.asarray([42, 100], jnp.int32)
+    stats = jnp.asarray([42, 100, 7], jnp.int32)
     packed = np.asarray(
         _pipeline_pack(roots_s, core_s, stats, owner, cap=cap)
     )
-    roots, core, total, budget = unpack_pipeline_result(packed)
+    roots, core, total, budget, passes = unpack_pipeline_result(packed)
     want = np.asarray([3, -1, 0, 5, -1, 2, 7, 1] + [-1] * 8)
     assert (roots == want).all()
     assert (core == np.asarray(core_s)).all()
-    assert (total, budget) == (42, 100)
+    assert (total, budget, passes) == (42, 100, 7)
 
 
 def test_cluster_mapping_vectorized_matches_loop():

@@ -96,7 +96,8 @@ def test_pad_and_run_falls_back_end_to_end(monkeypatch):
     X = rng.normal(size=(500, 4)).astype(np.float32)
     # _pad_and_run is the single-shard driver entry (the CI mesh routes
     # DBSCAN.fit to the sharded path, which has its own fallback test).
-    roots, core = dbscan_mod._pad_and_run(X, 0.5, 5, "euclidean", 256)
+    roots, core, _kinfo = dbscan_mod._pad_and_run(X, 0.5, 5, "euclidean",
+                                                  256)
     assert len(roots) == 500 and len(core) == 500
     assert calls == ["auto", "xla"]
 

@@ -74,3 +74,126 @@ def test_ring_fewer_partitions_than_devices(sharded_setup):
     l_ring, c_ring, _ = sharded_dbscan(X, part4, halo="ring", **kw)
     assert np.array_equal(c_host, c_ring)
     assert np.array_equal(densify_labels(l_host), densify_labels(l_ring))
+
+
+# ---------------------------------------------------------------------------
+# Owner-computes step (ISSUE 2): halo slots are adjacency evidence,
+# never re-clustered.  Labels must be byte-identical to the legacy
+# duplicate-and-recluster step on every distributed mode.
+# ---------------------------------------------------------------------------
+
+
+def _six_modes(X, mesh, part, *, eps, min_samples, block, owner_computes):
+    """Labels/core/stats for all six distributed modes: {host, ring}
+    halo x {device, host} merge on host input, plus the device-input
+    ring route under both merges."""
+    import jax
+
+    from pypardis_tpu.parallel.sharded import sharded_dbscan_device
+
+    kw = dict(eps=eps, min_samples=min_samples, block=block, mesh=mesh,
+              owner_computes=owner_computes)
+    out = {}
+    for halo in ("host", "ring"):
+        for merge in ("device", "host"):
+            out[f"{halo}+{merge}"] = sharded_dbscan(
+                X, part, halo=halo, merge=merge, **kw
+            )
+    Xd = jax.device_put(np.asarray(X))
+    for merge in ("device", "host"):
+        labels, core, stats, _part, _pid = sharded_dbscan_device(
+            Xd, eps=eps, min_samples=min_samples, block=block, mesh=mesh,
+            merge=merge, owner_computes=owner_computes,
+            max_partitions=part.n_partitions,
+        )
+        out[f"device_input+{merge}"] = (labels, core, stats)
+    return out
+
+
+def test_owner_computes_six_mode_parity(sharded_setup):
+    """Owner-computes labels byte-match the legacy step AND each other
+    across all six distributed modes (the device-input route
+    repartitions from a subsample, so its parity is within-route:
+    owner-computes vs legacy on identical partitioning)."""
+    X, mesh, part = sharded_setup
+    kw = dict(eps=0.4, min_samples=5, block=128)
+    oc = _six_modes(X, mesh, part, owner_computes=True, **kw)
+    legacy = _six_modes(X, mesh, part, owner_computes=False, **kw)
+    for mode in oc:
+        l_oc, c_oc, s_oc = oc[mode]
+        l_le, c_le, _s_le = legacy[mode]
+        assert np.array_equal(c_oc, c_le), mode
+        assert np.array_equal(l_oc, l_le), mode
+        assert s_oc["owner_computes"] is True, mode
+        assert s_oc["duplicated_work_factor"] < _s_le[
+            "duplicated_work_factor"
+        ], mode
+    # Host-input modes agree byte-for-byte among themselves too.
+    ref = oc["host+device"][0]
+    for mode in ("host+host", "ring+device", "ring+host"):
+        assert np.array_equal(oc[mode][0], ref), mode
+
+
+def test_owner_computes_r5_geometry_duplication_bound():
+    """The acceptance geometry (16-D blobs, eps=2.4 — the r5 bench
+    setup scaled to CI): owner-computes must report a clustered-volume
+    ``duplicated_work_factor`` <= 1.5 where the legacy step pays the
+    full 1 + halo_factor duplication, with labels byte-identical to the
+    fused single-shard engine."""
+    from benchdata import make_blob_data
+    from pypardis_tpu import DBSCAN
+
+    X, _truth = make_blob_data(4000, 16, n_centers=32, std=0.4)
+    mesh = default_mesh(8)
+    part = KDPartitioner(X, max_partitions=8)
+    kw = dict(eps=2.4, min_samples=10, block=128, mesh=mesh)
+    l_le, c_le, s_le = sharded_dbscan(X, part, owner_computes=False, **kw)
+    assert s_le["halo_factor"] > 1.0  # the duplication tax is real here
+    assert s_le["duplicated_work_factor"] > 2.0
+    # Byte parity with the fused single-shard engine, on EVERY
+    # distributed mode, with the clustered volume back near 1.
+    single = DBSCAN(eps=2.4, min_samples=10, block=128, max_partitions=1)
+    ref = single.fit_predict(X)
+    modes = _six_modes(X, mesh, part, eps=2.4, min_samples=10, block=128,
+                       owner_computes=True)
+    for mode, (labels, core, stats) in modes.items():
+        assert stats["duplicated_work_factor"] <= 1.5, mode
+        np.testing.assert_array_equal(
+            densify_labels(labels), ref, err_msg=mode
+        )
+        np.testing.assert_array_equal(
+            core, single.core_sample_mask_, err_msg=mode
+        )
+    assert np.array_equal(modes["host+device"][0], l_le)
+    assert np.array_equal(modes["host+device"][1], c_le)
+
+
+def test_owner_computes_halo_bridges_two_owned_clusters():
+    """A core halo point adjacent to TWO owned clusters of one foreign
+    partition must merge both (the single-min-edge formulation provably
+    drops one of the links — this pins the relay propagation).
+
+    Geometry: clumps A and B live left of the KD split, clump H right
+    of it; H is within eps of both, A and B are > eps apart, so the
+    only path A-B runs through H's points (halo slots in A/B's
+    partition)."""
+    rng = np.random.default_rng(0)
+
+    def clump(cx, cy, m=20):
+        return rng.normal([cx, cy], 0.01, size=(m, 2))
+
+    X = np.concatenate([
+        clump(-0.05, 0.0), clump(-0.05, 0.8), clump(0.05, 0.4),
+    ])
+    part = KDPartitioner(X, max_partitions=2)
+    mesh = default_mesh(8)
+    for kwargs in (
+        dict(), dict(merge="host"), dict(halo="ring"),
+        dict(halo="ring", merge="host"),
+    ):
+        labels, core, _stats = sharded_dbscan(
+            X, part, eps=0.5, min_samples=5, block=64, mesh=mesh,
+            owner_computes=True, **kwargs,
+        )
+        assert core.all(), kwargs
+        assert (labels == labels[0]).all(), kwargs

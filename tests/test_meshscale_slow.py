@@ -73,3 +73,36 @@ def test_sharded_100k_skewed_density(data100k):
     dense = densify_labels(labels)
     assert ari_vs_truth(dense, truth) >= 0.99
     assert stats.get("merge_converged", True) in (True, None)
+
+
+def test_owner_computes_100k_all_modes_byte_parity(data100k,
+                                                   single_shard_ref):
+    """ISSUE 2 acceptance at CI scale: owner-computes labels are
+    byte-identical to the legacy step AND to the fused single-shard
+    engine across every host-input distributed mode at 100k points,
+    with the clustered-volume factor back near 1."""
+    X, truth = data100k
+    ref, ref_core = single_shard_ref
+    part = KDPartitioner(X, max_partitions=8)
+    mesh = default_mesh(8)
+    kw = dict(eps=0.3, min_samples=10, block=1024, mesh=mesh)
+    for mode in (
+        dict(), dict(merge="host"), dict(halo="ring"),
+        dict(halo="ring", merge="host"),
+    ):
+        l_oc, c_oc, s_oc = sharded_dbscan(
+            X, part, owner_computes=True, **mode, **kw
+        )
+        l_le, c_le, s_le = sharded_dbscan(
+            X, part, owner_computes=False, **mode, **kw
+        )
+        assert np.array_equal(l_oc, l_le), mode
+        assert np.array_equal(c_oc, c_le), mode
+        assert s_oc["duplicated_work_factor"] < s_le[
+            "duplicated_work_factor"
+        ], mode
+        dense = densify_labels(l_oc)
+        np.testing.assert_array_equal(c_oc, ref_core)
+        np.testing.assert_array_equal(dense[ref_core], ref[ref_core])
+        np.testing.assert_array_equal(dense == -1, ref == -1)
+        assert ari_vs_truth(dense, truth) >= 0.99

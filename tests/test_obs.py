@@ -229,6 +229,51 @@ def test_summary_one_screen(fitted_model):
     assert len(s.splitlines()) <= 8  # one screen, not a dump
 
 
+def test_report_compute_and_perf_contract_sections(fitted_model):
+    """ISSUE 2 telemetry: the compute section (achieved-FLOP/s model
+    from the kernels' in-band pair stats) and the always-present
+    duplicated_work_factor / staged_bytes_reused fields — finite
+    numbers, never NaN (scripts/check_bench_json.py enforces the same
+    contract on bench rows)."""
+    import math
+
+    r = fitted_model.report()
+    comp = r["compute"]
+    for key in ("live_pairs", "kernel_block", "kernel_passes",
+                "model_flops", "achieved_flops_per_sec", "peak_flops",
+                "mfu"):
+        assert key in comp, key
+        assert math.isfinite(float(comp[key])), key
+    # The mesh fit really ran tiled passes over live pairs.
+    assert comp["live_pairs"] > 0
+    assert comp["kernel_passes"] >= 2  # counts + >=1 propagation pass
+    assert comp["kernel_block"] > 0
+    assert comp["achieved_flops_per_sec"] > 0
+    assert 0 < comp["mfu"] < 1
+    sh = r["sharding"]
+    assert sh["owner_computes"] is True
+    # Owner-computes: clustered volume ~ owned slots + padding, far
+    # below the legacy 1 + pad + halo_factor.
+    assert 1.0 <= sh["duplicated_work_factor"] < 1.0 + sh[
+        "pad_waste"
+    ] + 0.5
+    assert sh["staged_bytes_reused"] == 0  # cold fit
+    assert sh["staged_bytes"] > 0
+    # "compute:" line renders in the one-screen summary.
+    assert "compute:" in fitted_model.summary()
+
+
+def test_report_compute_single_shard_nonzero():
+    """The single-shard pipeline threads its packed pair stats into the
+    same compute section."""
+    X = np.random.default_rng(2).normal(size=(600, 4))
+    m = DBSCAN(eps=0.4, min_samples=5, block=64, max_partitions=1).fit(X)
+    comp = m.report()["compute"]
+    assert comp["live_pairs"] > 0
+    assert comp["kernel_passes"] >= 2
+    assert comp["mfu"] > 0
+
+
 def test_export_trace_valid_chrome_json(fitted_model, tmp_path):
     path = fitted_model.export_trace(str(tmp_path / "fit_trace.json"))
     doc = json.load(open(path))

@@ -152,13 +152,67 @@ def test_e2e_backend_pallas_interpret(blob_data, monkeypatch):
     l_p, core_p, pair_stats = dbscan_fixed_size(
         pts, 2.0, 8, mask, block=256, backend="pallas"
     )
-    total, budget = np.asarray(pair_stats)
+    total, budget, passes = np.asarray(pair_stats)
     assert 0 < total <= budget
+    assert passes >= 2  # the counts pass plus at least one minlab pass
     valid = np.asarray(mask)
     assert np.array_equal(np.asarray(l_x)[valid], np.asarray(l_p)[valid])
     assert np.array_equal(
         np.asarray(core_x)[valid], np.asarray(core_p)[valid]
     )
+
+
+def test_owner_computes_pallas_pair_filtering(blob_data, monkeypatch):
+    """The owner-computes kernels drive Pallas with FILTERED pair lists
+    (owned-row subset for counts, halo-halo pairs dropped for the relay
+    propagation, both re-sorted to row-major for `_first_visit`) —
+    interpret-mode parity against the XLA kind on the same slab."""
+    import functools
+
+    from pypardis_tpu.ops import labels as lb
+    from pypardis_tpu.ops import pallas_kernels as pk
+
+    pts, mask = blob_data
+    owned = 1536  # 6 of 8 tiles owned, 2 halo, at block 256
+    monkeypatch.setattr(
+        pk,
+        "neighbor_counts_pallas",
+        functools.partial(pk.neighbor_counts_pallas, interpret=True),
+    )
+    monkeypatch.setattr(
+        pk,
+        "min_neighbor_label_pallas",
+        functools.partial(pk.min_neighbor_label_pallas, interpret=True),
+    )
+    kw = dict(owned=owned, metric="euclidean", block=256,
+              precision="highest")
+    pairs, stats = pk.kernel_pair_list(
+        pts, 2.0, mask, 256, "highest", "nd"
+    )
+    assert int(stats[0]) <= int(stats[1])
+    core_x = lb.oc_counts(pts, 2.0, 8, mask, kind="xla", pairs=None, **kw)
+    core_p = lb.oc_counts(
+        pts, 2.0, 8, mask, kind="pallas", pairs=pairs, **kw
+    )
+    assert core_x.shape == (owned,)
+    assert np.array_equal(np.asarray(core_x), np.asarray(core_p))
+    # Owner-supplied halo flags: the exact full-slab core test.
+    full_counts = np.asarray(
+        neighbor_counts(pts, 2.0, mask, block=256, precision="highest")
+    )
+    halo_core = jnp.asarray(
+        (full_counts[owned:] >= 8) & np.asarray(mask)[owned:]
+    )
+    core_all = jnp.concatenate([core_x, halo_core])
+    l_x, p_x = lb.oc_propagate(
+        pts, 2.0, mask, core_all, kind="xla", pairs=None, **kw
+    )
+    l_p, p_p = lb.oc_propagate(
+        pts, 2.0, mask, core_all, kind="pallas", pairs=pairs, **kw
+    )
+    valid = np.asarray(mask)
+    assert np.array_equal(np.asarray(l_x)[valid], np.asarray(l_p)[valid])
+    assert int(p_x) >= 1 and int(p_p) >= 1
 
 
 def test_resolve_backend_rules():
