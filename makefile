@@ -3,7 +3,8 @@
 
 PY ?= python
 
-.PHONY: all wheel native test verify tpu-smoke bench bench-smoke demo clean
+.PHONY: all wheel native test verify tpu-smoke bench bench-smoke \
+	partition-probe demo clean
 
 all: native test
 
@@ -37,10 +38,19 @@ bench:
 	$(PY) bench.py
 
 # Tiny-n benchmark + schema check of the emitted JSON line (the
-# metric/value/unit triple plus the run_report@1 telemetry block).
-bench-smoke:
+# metric/value/unit triple plus the run_report@1 telemetry block),
+# then the CI-sized partitioner depth-scaling probe (fails when the
+# level builder's mp-doubling cost ratio exceeds 1.5x).
+bench-smoke: partition-probe
 	JAX_PLATFORMS=cpu BENCH_N=2000 BENCH_DIM=4 BENCH_REPS=1 \
 	BENCH_DEV_REPS=1 $(PY) bench.py | $(PY) scripts/check_bench_json.py
+
+# KDPartitioner build-time-vs-max_partitions rows (both builders, with
+# per-level breakdowns).  Full-size run: `PROBE_N=10000000 make
+# partition-probe`.
+partition-probe:
+	PROBE_N=$${PROBE_N:-200000} PROBE_MPS=$${PROBE_MPS:-8,16} \
+	PROBE_REPS=$${PROBE_REPS:-3} $(PY) scripts/partition_probe.py
 
 demo:
 	$(PY) -m pypardis_tpu.demo

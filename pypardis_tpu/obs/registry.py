@@ -101,6 +101,11 @@ class MetricsRegistry:
             k: v for k, v in self._counters.items() if k.startswith(prefix)
         }
 
+    def gauges_with_prefix(self, prefix: str) -> Dict[str, object]:
+        return {
+            k: v for k, v in self._gauges.items() if k.startswith(prefix)
+        }
+
     def merge(self, other: "MetricsRegistry") -> "MetricsRegistry":
         """Fold ``other`` into this registry (see class docstring)."""
         for k, v in other._counters.items():

@@ -35,6 +35,9 @@ _SHARDING_KEYS = (
     "duplicated_work_factor",
     "staged_bytes_reused",
     "staged_bytes",
+    "overlap_efficiency",
+    "partition_levels_s",
+    "partition_builder",
 )
 
 # Model-FLOP peak per chip for the MFU denominator, matched by
@@ -157,9 +160,13 @@ def build_run_report(
     sharding.setdefault("n_partitions", int(metrics.get("n_partitions", 1)))
     # Always-present perf-contract fields (validated by
     # scripts/check_bench_json.py): a single-shard fit clusters each
-    # point exactly once (factor 1.0) and stages nothing reusable.
+    # point exactly once (factor 1.0), stages nothing reusable, runs
+    # no chained overlap loop (efficiency 0.0), and builds no KD tree
+    # (empty per-level timing list).
     sharding.setdefault("duplicated_work_factor", 1.0)
     sharding.setdefault("staged_bytes_reused", 0)
+    sharding.setdefault("overlap_efficiency", 0.0)
+    sharding.setdefault("partition_levels_s", [])
 
     psizes = metrics.get("partition_sizes")
     devices: Dict = {"count": int(n_devices)}
@@ -193,6 +200,21 @@ def build_run_report(
         "compile": ev.get("compile", 0),
     }
 
+    # Host-stepped propagation breakdown (pipeline._cluster_stepped's
+    # stepped.* gauges): present only when the fit actually stepped, so
+    # "bounded by the tunnel, not compute" reads off prepare/rounds/
+    # border/pack seconds and the speculation stats directly.
+    stepped = (
+        {
+            k[len("stepped."):]: v
+            for k, v in recorder.metrics.gauges_with_prefix(
+                "stepped."
+            ).items()
+        }
+        if recorder is not None
+        else {}
+    )
+
     report = {
         "schema": REPORT_SCHEMA,
         "params": _clean(params),
@@ -217,6 +239,8 @@ def build_run_report(
             else {"counters": {}, "gauges": {}, "timings": {}}
         ),
     }
+    if stepped:
+        report["stepped"] = stepped
     return _clean(report)
 
 
@@ -265,7 +289,28 @@ def format_summary(report: Dict) -> str:
         shard_bits.append(
             f"staged_reuse {_fmt_bytes(sh['staged_bytes_reused'])}"
         )
+    if sh.get("overlap_efficiency", 0) > 0:
+        shard_bits.append(f"overlap {sh['overlap_efficiency']:.0%}")
     lines.append("  sharding: " + ", ".join(shard_bits))
+    levels = sh.get("partition_levels_s") or []
+    if levels:
+        lines.append(
+            "  partition levels: "
+            + " | ".join(f"{t:.3f}s" for t in levels)
+            + (f" ({sh.get('partition_builder')})"
+               if sh.get("partition_builder") else "")
+        )
+    st = report.get("stepped")
+    if st:
+        lines.append(
+            "  stepped: "
+            f"prepare {st.get('prepare_s', 0):.3f}s | "
+            f"rounds {st.get('rounds_s', 0):.3f}s "
+            f"({st.get('batches', 0)} x {st.get('batch_size', 0)}"
+            f"{', speculative' if st.get('speculate') else ''}) | "
+            f"border {st.get('border_s', 0):.3f}s | "
+            f"pack {st.get('pack_s', 0):.3f}s"
+        )
     comp = report.get("compute", {})
     if comp.get("live_pairs", 0) > 0:
         lines.append(

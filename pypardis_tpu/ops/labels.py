@@ -114,6 +114,40 @@ def _pointer_jump(f: jnp.ndarray, active: jnp.ndarray) -> jnp.ndarray:
     return f
 
 
+def dbscan_fixed_size(
+    points,
+    eps,
+    min_samples,
+    mask,
+    metric: str = "euclidean",
+    block: int = 1024,
+    max_rounds: int = 64,
+    precision: str = "high",
+    backend: str = "auto",
+    layout: str = "nd",
+    pair_budget: int | None = None,
+):
+    """Validating entry point for :func:`_dbscan_fixed_size_jit` (the
+    jitted body, where ``eps`` may be a tracer and cannot be checked).
+    Concrete hyperparameters reject here — ``eps=-0.3`` used to behave
+    exactly like ``eps=0.3`` through the squared-distance kernels."""
+    from ..utils.validate import validate_params
+
+    validate_params(eps, min_samples)
+    return _dbscan_fixed_size_jit(
+        points, eps, min_samples, mask, metric=metric, block=block,
+        max_rounds=max_rounds, precision=precision, backend=backend,
+        layout=layout, pair_budget=pair_budget,
+    )
+
+
+# The wrapper keeps the jit surface callers rely on (tests drop cached
+# executables through the public name).
+dbscan_fixed_size.clear_cache = (  # type: ignore[attr-defined]
+    lambda: _dbscan_fixed_size_jit.clear_cache()
+)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -121,7 +155,7 @@ def _pointer_jump(f: jnp.ndarray, active: jnp.ndarray) -> jnp.ndarray:
         "pair_budget",
     ),
 )
-def dbscan_fixed_size(
+def _dbscan_fixed_size_jit(
     points: jnp.ndarray,
     eps: float,
     min_samples: int,

@@ -457,11 +457,41 @@ def _transient_retry(stage, fn, retryable=_default_transient):
     raise last
 
 
+def _step_overlap_enabled() -> bool:
+    """Whether the stepped loop speculatively dispatches batch ``b+1``
+    before reading batch ``b``'s convergence flag, overlapping the
+    flag's device->host latency (~0.2-2s per batch on tunneled links)
+    with the next batch's execution.
+
+    Default OFF on TPU: speculation queues a second execution of the
+    round program, which is exactly the queued-re-execution mode that
+    poisons tunneled axon workers (see _cluster_tables_1dev_chained's
+    probe discipline).  PYPARDIS_STEP_OVERLAP=1 opts in on deployments
+    without that failure mode; =0 forces the serial loop anywhere.
+    """
+    import os
+
+    env = os.environ.get("PYPARDIS_STEP_OVERLAP")
+    if env is not None:
+        return env == "1"
+    import jax as _jax
+
+    return _jax.default_backend() != "tpu"
+
+
 def _cluster_stepped(
     xs, mask_k, owner, eps, *, cap, min_samples, block, precision,
     pair_budget,
 ):
-    """Stage 2 (host-stepped, Pallas): one device call per round."""
+    """Stage 2 (host-stepped, Pallas): one device call per round batch.
+
+    Emits a per-stage breakdown (prepare / rounds / border / pack wall
+    seconds, batch count and size, speculation stats) as ``stepped.*``
+    gauges on the current telemetry recorder — surfaced as the
+    ``stepped`` section of ``DBSCAN.report()``, so "bounded by the
+    tunnel, not compute" is a measurement, not an attribution.
+    """
+    from ..obs import current as obs_current
     from .labels import (
         dbscan_border_pallas,
         dbscan_prepare_pallas,
@@ -469,6 +499,9 @@ def _cluster_stepped(
     )
 
     kw = dict(block=block, precision=precision, layout="dn")
+    import time as _time
+
+    t0 = _time.perf_counter()
 
     def run_prepare():
         # The compile/sync discipline for the two prepare programs AND
@@ -483,6 +516,7 @@ def _cluster_stepped(
     (rows, cols), pair_stats, core, f = _transient_retry(
         "prepare", run_prepare
     )
+    prepare_s = _time.perf_counter() - t0
     g = None
     converged = False
     # ROUND_BATCH propagation rounds per device call: the per-call
@@ -490,55 +524,112 @@ def _cluster_stepped(
     # 50M points dominated the whole fit when paid per round.  Each
     # call still runs only seconds (bounded by the batch), far below
     # the worker watchdog that motivates host stepping.
-    import time as _time
 
     # Watchdog ceiling: a single degraded round at ~100M capacity can
     # run the better part of a minute, and a full 8-round batch at that
     # size crashed the worker outright (round-4 measurement) — scale
     # the batch down with capacity so one call stays safely short.
     batch_k = max(1, min(ROUND_BATCH, (1 << 27) // max(xs.shape[1], 1)))
-    batches = 0
+    max_batches = -(-MAX_ROUNDS // batch_k)
+    speculate = _step_overlap_enabled()
+    batches = 0  # batches whose results were CONSUMED
+    dispatched = 0  # includes the wasted post-fixpoint speculation
     t_rounds = _time.perf_counter()
-    for _ in range(-(-MAX_ROUNDS // batch_k)):
-        def some_rounds(f=f):
-            out = dbscan_rounds_pallas(
-                xs, f, eps, core, mask_k, rows, cols,
-                k_rounds=batch_k, **kw
-            )
-            return out + (bool(out[2]),)  # sync inside the retry scope
 
-        f, g, _, changed = _transient_retry("round", some_rounds)
-        batches += 1
-        if not changed:  # the last executed round was a fixpoint
-            converged = True
-            break
+    def dispatch(fi):
+        nonlocal dispatched
+        dispatched += 1
+        return dbscan_rounds_pallas(
+            xs, fi, eps, core, mask_k, rows, cols, k_rounds=batch_k, **kw
+        )
+
+    if not speculate:
+        for _ in range(max_batches):
+            def some_rounds(f=f):
+                out = dispatch(f)
+                return out + (bool(out[2]),)  # sync inside retry scope
+
+            f, g, _, changed = _transient_retry("round", some_rounds)
+            batches += 1
+            if not changed:  # the last executed round was a fixpoint
+                converged = True
+                break
+    else:
+        # Double-buffered rounds: batch b+1 dispatches from batch b's
+        # (still in-flight) state BEFORE b's convergence flag is read,
+        # so the flag's host round trip overlaps b+1's execution.  A
+        # batch run past the fixpoint recomputes the identical state
+        # (min-label propagation is idempotent there), so consuming
+        # batch b's outputs keeps results byte-identical to the serial
+        # loop; the one speculative batch after convergence is wasted
+        # work the overlap already paid for.
+        pending = None  # (f_out, g_out, changed_handle), unsynced
+        while batches < max_batches and not converged:
+            last = batches + 1 >= max_batches
+
+            def one_window():
+                nonlocal pending
+                try:
+                    cur = pending if pending is not None else dispatch(f)
+                    spec = None if last else dispatch(cur[0])
+                    changed = bool(np.asarray(cur[2]))
+                    return cur, spec, changed
+                except Exception:
+                    # The in-flight window may be poisoned — drop it so
+                    # the retry redispatches from the last synced state.
+                    pending = None
+                    raise
+
+            cur, pending, changed = _transient_retry("round", one_window)
+            batches += 1
+            f, g = cur[0], cur[1]
+            if not changed:
+                converged = True
+    rounds_s = _time.perf_counter() - t_rounds
     from ..utils.log import log_phase
 
     log_phase(
         "stepped_rounds", batches=batches, batch_size=batch_k,
-        converged=converged, seconds=round(_time.perf_counter() - t_rounds, 2),
+        converged=converged, speculate=speculate,
+        dispatched=dispatched, seconds=round(rounds_s, 2),
     )
+    border_s = 0.0
     if not converged:
+        t_b = _time.perf_counter()
         g = _transient_retry(
             "border",
             lambda: dbscan_border_pallas(
                 xs, f, eps, core, mask_k, rows, cols, **kw
             ),
         )
-    # Kernel passes for the FLOP model: one counts pass, up to batch_k
-    # minlab rounds per executed batch (the in-batch convergence round
-    # is not observable from the host — this is a tight upper bound),
-    # plus the explicit border pass on a non-converged exit.
-    passes = 1 + batches * batch_k + (0 if converged else 1)
+        border_s = _time.perf_counter() - t_b
+    # Kernel passes for the FLOP model: one counts pass, batch_k minlab
+    # rounds per DISPATCHED batch (the speculative post-fixpoint batch
+    # executed too; the in-batch convergence round is not observable
+    # from the host — this is a tight upper bound), plus the explicit
+    # border pass on a non-converged exit.
+    passes = 1 + dispatched * batch_k + (0 if converged else 1)
     pair_stats = jnp.concatenate(
         [pair_stats[:2], jnp.asarray([passes], jnp.int32)]
     )
-    return _transient_retry(
+    t_p = _time.perf_counter()
+    out = _transient_retry(
         "pack",
         lambda: np.array(_pipeline_finish_pack(
             f, g, core, mask_k, pair_stats, owner, cap=cap
         )),
     )
+    m = obs_current().metrics
+    m.set("stepped.prepare_s", round(prepare_s, 6))
+    m.set("stepped.rounds_s", round(rounds_s, 6))
+    m.set("stepped.border_s", round(border_s, 6))
+    m.set("stepped.pack_s", round(_time.perf_counter() - t_p, 6))
+    m.set("stepped.batches", batches)
+    m.set("stepped.batch_size", batch_k)
+    m.set("stepped.dispatched_batches", dispatched)
+    m.set("stepped.speculate", speculate)
+    m.set("stepped.converged", converged)
+    return out
 
 
 def dbscan_device_pipeline(
@@ -552,16 +643,30 @@ def dbscan_device_pipeline(
     backend: str = "auto",
     sort: bool = True,
     pair_budget: int | None = None,
+    layout_key=None,
 ):
     """points_t: (d, cap) float32, centered, zero-padded past ``n``
-    (traced).  Returns a host (cap + 2,) int32 array: per point the
-    packed ``(root + 1) | core << 30`` value (input order; decode via
+    (traced) — or a ZERO-ARG CALLABLE producing it, evaluated only
+    when the layout actually runs (see ``layout_key``).  Returns a
+    host (cap + 2,) int32 array: per point the packed ``(root + 1) |
+    core << 30`` value (input order; decode via
     :func:`unpack_pipeline_result`), then ``[live_pairs_total,
     budget]`` from the Pallas tile-pair extraction (rides in-band so
     the driver gets results and overflow status in ONE device->host
     transfer; zeros on XLA).  Materialized on host here so the bulk
     transfer doubles as the execution-fault sync inside the retry
     scope.
+
+    ``layout_key``: content key under which the layout products —
+    the sorted/segment-broken ``(xs, mask, owner)`` device arrays,
+    which depend on the data, block, precision, and eps but NOT on
+    min_samples/metric/pair_budget — are cached through the staging
+    economy (:mod:`pypardis_tpu.parallel.staging`, route
+    ``pipeline_layout``).  A warm repeat fit then skips the host
+    staging fill, the host->device transfer, AND the device Morton
+    sort; nothing downstream donates these arrays, so reuse is safe.
+    None (e.g. device-resident input, or arrays too large to retain —
+    the driver gates) disables caching.
 
     Two separately-jitted stages rather than one fused program: the
     fused compile at ~50M-point capacities crashed the axon compile
@@ -576,28 +681,46 @@ def dbscan_device_pipeline(
     from ..obs import event as obs_event, span as obs_span
     from .labels import resolve_backend
 
-    cap = points_t.shape[1]
-    key = (
-        points_t.shape, points_t.dtype, min_samples, metric, block,
-        precision, backend, sort, pair_budget,
-    )
+    cached = None
+    if layout_key is not None:
+        from ..parallel import staging as _staging
 
-    def run_layout():
-        out = _pipeline_layout(
-            points_t, eps, n, block=block, sort=sort, precision=precision
+        cached = _staging.device_get("pipeline_layout", layout_key)
+    if cached is not None:
+        (xs, mask_k, owner), aux = cached
+        cap = int(aux["cap"])
+    else:
+        if callable(points_t):
+            points_t = points_t()
+        cap = points_t.shape[1]
+        key = (
+            points_t.shape, points_t.dtype, min_samples, metric, block,
+            precision, backend, sort, pair_budget,
         )
-        if key not in _compiled_pipeline_keys:
-            obs_event("compile", stage="pipeline")
-            # First time for this shape: let stage 1 finish on device
-            # before stage 2's compile starts (block_until_ready can
-            # return early on tunneled deployments; a 1-element
-            # transfer is a reliable barrier).
-            np.asarray(out[0][:1, :1])
-            _compiled_pipeline_keys.add(key)
-        return out
 
-    with obs_span("pipeline.layout", sort=bool(sort)):
-        xs, mask_k, owner = _transient_retry("layout", run_layout)
+        def run_layout():
+            out = _pipeline_layout(
+                points_t, eps, n, block=block, sort=sort,
+                precision=precision
+            )
+            if key not in _compiled_pipeline_keys:
+                obs_event("compile", stage="pipeline")
+                # First time for this shape: let stage 1 finish on
+                # device before stage 2's compile starts
+                # (block_until_ready can return early on tunneled
+                # deployments; a 1-element transfer is a reliable
+                # barrier).
+                np.asarray(out[0][:1, :1])
+                _compiled_pipeline_keys.add(key)
+            return out
+
+        with obs_span("pipeline.layout", sort=bool(sort)):
+            xs, mask_k, owner = _transient_retry("layout", run_layout)
+        if layout_key is not None:
+            _staging.device_put_cached(
+                "pipeline_layout", layout_key, (xs, mask_k, owner),
+                aux={"cap": cap},
+            )
     capk = xs.shape[1]
     stepped = (
         capk >= STEP_THRESHOLD

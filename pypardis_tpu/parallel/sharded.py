@@ -788,6 +788,347 @@ def _cluster_tables_1dev_chained(
     return own_glab, own_core, halo_glab, pair_stats
 
 
+# ---------------------------------------------------------------------------
+# overlapped (double-buffered) 1-device chained route
+# ---------------------------------------------------------------------------
+
+
+def _overlap_enabled(overlap) -> bool:
+    """Resolve the chained-overlap switch: explicit argument wins, then
+    the PYPARDIS_CHAINED_OVERLAP env kill-switch, default on."""
+    if overlap is not None:
+        return bool(overlap)
+    import os
+
+    return os.environ.get("PYPARDIS_CHAINED_OVERLAP", "1") != "0"
+
+
+def _put_slab(a, dev):
+    """Device_put one host slab for the overlapped chained loop.
+
+    On TPU the put is the pinned-staging fast path and the source
+    buffer is protected by the rotation discipline (reused only after
+    the consuming partition's completion probe).  Off-TPU ``device_put``
+    may return a ZERO-COPY view over the numpy memory, which the device
+    cache then retains across fits while the pool rewrites the buffer —
+    an explicit copy keeps cached slabs immutable everywhere else.
+    """
+    if jax.default_backend() == "tpu":
+        return jax.device_put(a, dev)
+    return jax.device_put(np.array(a), dev)
+
+
+def _chained_tables_overlap(
+    points, partitioner, eps, *, center, part_idx, halo_idx,
+    cap, hcap, p_total, block, min_samples, metric, precision, backend,
+    pair_budget, base_key, mesh,
+):
+    """Double-buffered per-partition build + chained execution.
+
+    The legacy 1-device chained flow is strictly serial on the host
+    side: build ALL (P, cap, k) slabs, ship them, then chain the
+    per-partition cluster dispatches — every second of Morton sorting
+    and slab filling happens while the device sits idle.  Here the loop
+    pipelines: while the device executes partition ``p``, the host
+    builds and ``device_put``s partition ``p+1``'s slabs, and the
+    1-element completion probe of ``p`` (the same fetch the chained
+    path already needs against queued-re-execution faults on tunneled
+    deployments) doubles as the pipeline barrier.  Exactly one
+    execution is ever in flight, preserving the chained path's sync
+    discipline; only host work overlaps it.
+
+    Mutation safety: the two rotating pooled coordinate buffers mean
+    slab ``p+2``'s host build (the earliest reuse of ``p``'s buffer)
+    starts only after ``p``'s probe completed — an in-flight transfer
+    can never read a buffer being rewritten, on any backend
+    (regression-pinned in tests/test_overlap.py).
+
+    Per-partition device slabs are cached through the staging economy
+    (``chained_owned`` keyed WITHOUT eps / ``chained_halo`` WITH it, the
+    same split as the stacked host route), so warm refits skip the host
+    build and the transfer, and an eps sweep re-ships only halos.
+
+    Returns ``(glabs, cores, pstats_list, gid_o_host, gid_h_host,
+    dev_gids, overlap_efficiency)`` — per-partition device label/core
+    arrays plus the host gid tables both merges consume.
+    """
+    import time as _time
+
+    n, k = points.shape
+    dev = mesh.devices.reshape(-1)[0]
+    own_entry = staging.device_get("chained_owned", base_key)
+    halo_entry = staging.device_get(
+        "chained_halo", base_key + (float(eps),)
+    )
+    own_slabs = (
+        None if own_entry is None
+        else [tuple(own_entry[0][3 * p:3 * p + 3]) for p in range(p_total)]
+    )
+    halo_slabs = (
+        None if halo_entry is None
+        else [tuple(halo_entry[0][3 * p:3 * p + 3]) for p in range(p_total)]
+    )
+    # Host gid tables (fresh, not pooled: the host merge reads them
+    # after this loop returns, so they must never alias a reusable
+    # buffer).  Cold builds fill them as a byproduct of the slab fill;
+    # warm hits replay the deterministic Morton order host-side (the
+    # sort runs in the same recentred f32 frame as the cached slabs,
+    # so the rows match byte-for-byte) rather than fetching (P, cap)
+    # ints back over the link.
+    gid_o_host = np.full((p_total, cap), n, np.int32)
+    gid_h_host = np.full((p_total, hcap), n, np.int32)
+
+    def _replay_gids(idx_all, gid_host):
+        for p in range(p_total):
+            idx = idx_all[p]
+            if len(idx):
+                sub = _recentre_rows(points, idx, center)
+                gid_host[p, : len(idx)] = idx[spatial_order(sub)]
+
+    if own_slabs is not None:
+        _replay_gids(part_idx, gid_o_host)
+    if halo_slabs is not None:
+        _replay_gids(halo_idx, gid_h_host)
+
+    built_own = [] if own_slabs is None else own_slabs
+    built_halo = [] if halo_slabs is None else halo_slabs
+    rot_own = [None, None]
+    rot_halo = [None, None]
+    host_bufs: list = []
+
+    def _rotating(rot, shape, slot):
+        buf = rot[slot]
+        if buf is None:
+            buf = rot[slot] = staging.borrow(shape, np.float32)
+            host_bufs.append(buf)
+        return buf
+
+    def _build(p, idx_all, capn, built, rot, gid_host):
+        buf = _rotating(rot, (capn, k), p % 2)
+        idx = idx_all[p]
+        buf[len(idx):] = 0.0
+        msk_row = np.zeros((1, capn), bool)
+        _fill_slab(buf[None], msk_row, gid_host[p:p + 1], 0, points, idx,
+                   center)
+        built.append(
+            (
+                _put_slab(buf, dev),
+                _put_slab(msk_row[0], dev),
+                _put_slab(gid_host[p], dev),
+            )
+        )
+
+    def ensure(p):
+        if own_slabs is None and len(built_own) <= p:
+            _build(p, part_idx, cap, built_own, rot_own, gid_o_host)
+        if halo_slabs is None and len(built_halo) <= p:
+            _build(p, halo_idx, hcap, built_halo, rot_halo, gid_h_host)
+
+    key = (
+        "cluster", (p_total, cap, k), (p_total, hcap, k), float(eps),
+        int(min_samples), str(metric), block, precision, backend,
+        pair_budget,
+    )
+    first = key not in _chained_compiled
+    ensure(0)
+    if first:
+        obs_event("compile", stage="chained_cluster")
+        # Idle-device barrier before the cluster program's first
+        # compile (same discipline as _cluster_tables_1dev_chained).
+        np.asarray(built_own[0][2][:1])
+
+    glabs, cores, pstats = [], [], []
+    busy = 0.0
+    idle_overlaps = 0
+    t_loop = _time.perf_counter()
+    for p in range(p_total):
+        po, mo, go = built_own[p]
+        ph, mh, hg = built_halo[p]
+        t_disp = _time.perf_counter()
+        pts = jnp.concatenate([po, ph], axis=0)
+        msk = jnp.concatenate([mo, mh])
+        gid = jnp.concatenate([go, hg])
+        lab, cor, ps = dbscan_fixed_size(
+            pts, eps, min_samples, msk, metric=metric, block=block,
+            precision=precision, backend=backend, pair_budget=pair_budget,
+        )
+        glab = jnp.where(
+            lab >= 0,
+            jnp.take(gid, jnp.clip(lab, 0, None)),
+            -1,
+        ).astype(jnp.int32)
+        glabs.append(glab)
+        cores.append(cor)
+        pstats.append(ps)
+        # THE overlap: partition p+1's host build + transfer runs while
+        # the device executes partition p.
+        if p + 1 < p_total:
+            ensure(p + 1)
+        t_built = _time.perf_counter()
+        ready_early = bool(
+            getattr(glab, "is_ready", lambda: False)()
+        )
+        # Completion probe: the chained path's anti-queued-re-execution
+        # sync, now also the rotation barrier freeing slab p's buffers.
+        np.asarray(glab[:1])
+        t_done = _time.perf_counter()
+        # Device-busy upper bound: when the device finished inside the
+        # host build window the busy interval is clipped to it.
+        busy += (t_built if ready_early else t_done) - t_disp
+        if ready_early:
+            idle_overlaps += 1
+    wall = _time.perf_counter() - t_loop
+    if first:
+        _chained_compiled.add(key)
+    if own_slabs is None:
+        staging.device_put_cached(
+            "chained_owned", base_key,
+            tuple(a for triple in built_own for a in triple),
+        )
+    if halo_slabs is None:
+        staging.device_put_cached(
+            "chained_halo", base_key + (float(eps),),
+            tuple(a for triple in built_halo for a in triple),
+        )
+    staging.give_back(host_bufs)
+    overlap_eff = busy / wall if wall > 0 else 0.0
+    from ..utils.log import log_phase
+
+    log_phase(
+        "chained_overlap", partitions=p_total,
+        overlap_efficiency=round(overlap_eff, 4),
+        device_idle_overlaps=idle_overlaps,
+        warm=bool(own_entry is not None),
+    )
+    dev_gids = (
+        [t[2] for t in built_own], [t[2] for t in built_halo]
+    )
+    return glabs, cores, pstats, gid_o_host, gid_h_host, dev_gids, (
+        overlap_eff
+    )
+
+
+def _sharded_dbscan_1dev_overlap(
+    points, partitioner, *, eps, min_samples, metric, block, mesh, axis,
+    n_points, precision, backend, merge, pair_budget, merge_rounds,
+    n_shards, base_key,
+):
+    """Driver for the overlapped 1-device chained route: geometry +
+    halo sets on host, then the double-buffered loop, then the same
+    merge programs (in-graph or host union-find) the legacy chained
+    path runs — labels byte-identical to it.  ``stats`` additionally
+    carries ``overlap_efficiency`` (device-busy / wall seconds of the
+    chained loop)."""
+    from ..partition import expanded_members
+
+    n, k = points.shape
+    center, _lo, _hi, labels = _expanded_frame_meta(
+        points, partitioner, eps
+    )
+    p_real, p_total, part_idx, cap = _layout_geometry(
+        partitioner, labels, n_shards, block
+    )
+    members = expanded_members(partitioner.tree, points, 2 * eps)
+    halo_idx = [arr[~own] for arr, own in (members[l] for l in labels)]
+    del members
+    empty = np.empty(0, np.int32)
+    part_idx = list(part_idx) + [empty] * (p_total - len(part_idx))
+    halo_idx = list(halo_idx) + [empty] * (p_total - len(halo_idx))
+    hcap = round_up(max(max((len(h) for h in halo_idx), default=1), 1),
+                    block)
+    n_halo = sum(len(h) for h in halo_idx)
+    stats = {
+        "owned_cap": cap,
+        "n_shard_partitions": p_total,
+        "pad_waste": float(p_total * cap) / max(n, 1) - 1.0,
+        "partition_sizes": _partition_sizes(part_idx, p_total),
+        "halo_factor": float(n_halo) / max(n, 1),
+        "halo_cap": hcap,
+        "halo_bytes": int(n_halo) * k * 4,
+    }
+    hint_key = _sharded_hint_key(
+        (p_total, cap, k), hcap, block, precision, eps, metric
+    ) + (False,)
+    eff_cell = [0.0]
+
+    def run_step(pb, mr):
+        glabs, cores, pstats_l, gid_o, gid_h, dev_gids, eff = (
+            _with_kernel_fallback(
+                lambda be: _chained_tables_overlap(
+                    points, partitioner, eps,
+                    center=center, part_idx=part_idx, halo_idx=halo_idx,
+                    cap=cap, hcap=hcap, p_total=p_total, block=block,
+                    min_samples=min_samples, metric=metric,
+                    precision=precision, backend=be, pair_budget=pb,
+                    base_key=base_key, mesh=mesh,
+                ),
+                backend,
+            )
+        )
+        eff_cell[0] = eff
+        own_glab = jnp.stack([g[:cap] for g in glabs])
+        halo_glab = jnp.stack([g[cap:] for g in glabs])
+        own_core = jnp.stack([c[:cap] for c in cores])
+        pair_stats = jnp.stack(pstats_l).max(axis=0)[None]
+        if merge == "host":
+            # The host union-find merge is exact — no rounds ladder.
+            return (
+                (own_glab, own_core, halo_glab, gid_o, gid_h),
+                pair_stats,
+                True,
+            )
+        og_dev = jnp.stack(dev_gids[0])
+        hg_dev = jnp.stack(dev_gids[1])
+
+        def per_device(a, b, c, d, e):
+            final, core_g, rounds, converged = _merge_from_tables(
+                a, b, c, d, e, axis=axis, n_points=n_points,
+                merge_rounds=mr,
+            )
+            return final, core_g, rounds, converged
+
+        mkey = ("merge", own_glab.shape, halo_glab.shape, n_points, mr)
+        if mkey not in _chained_compiled:
+            obs_event("compile", stage="chained_merge")
+            # Idle-device barrier before the merge program's first
+            # compile (the stack dispatches above may still run).
+            np.asarray(own_glab[:1, :1])
+        spec2 = P("p", None)
+        final, core_g, rounds, converged = shard_map(
+            per_device,
+            mesh=mesh,
+            in_specs=(spec2, spec2, spec2, spec2, spec2),
+            out_specs=(P(), P(), P(), P()),
+            check_vma=False,
+        )(own_glab, own_core, og_dev, hg_dev, halo_glab)
+        _chained_compiled.add(mkey)
+        return (final, core_g, rounds), pair_stats, converged
+
+    with obs_span("sharded.execute", halo="host", merge=merge,
+                  overlap=True):
+        out, pstats = run_ladders(
+            run_step, hint_key, pair_budget, merge_rounds
+        )
+    if merge == "host":
+        own_glab, own_core, halo_glab, gid_o, gid_h = out
+        with obs_span("sharded.merge_host"):
+            final, core = _host_merge_finish(
+                n, gid_o, own_glab, own_core, gid_h, halo_glab
+            )
+        stats = dict(stats, merge="host")
+    else:
+        final, core, m_rounds = out
+        final, core = np.asarray(final), np.asarray(core)
+        stats = dict(
+            stats, merge="device", merge_rounds=int(m_rounds),
+            merge_converged=True,
+        )
+    stats["overlap_efficiency"] = round(float(eff_cell[0]), 4)
+    _exec_stats(stats, oc_on=False, pstats=pstats, block=block, k=k,
+                precision=precision, n=n)
+    return _canonicalize_roots(final, core), core, stats
+
+
 def _device_cluster_merge(
     o, om, og, h, hm, hg, *, eps, min_samples, metric, block, precision,
     backend, axis, n_points, pair_budget=None, merge_rounds=32,
@@ -1433,6 +1774,7 @@ def sharded_dbscan(
     merge_rounds: int = 32,
     stream: Optional[bool] = None,
     owner_computes: bool = True,
+    overlap: Optional[bool] = None,
 ):
     """Cluster ``points`` over the device mesh.
 
@@ -1481,6 +1823,14 @@ def sharded_dbscan(
     ``np.memmap`` larger than host RAM clusters from disk — requires
     ``halo='ring'``.  ``None`` auto-enables it for memmap inputs on
     the ring path.
+
+    ``overlap``: double-buffer the 1-device chained route — build +
+    ship partition ``i+1``'s slabs while the device executes partition
+    ``i`` (:func:`_chained_tables_overlap`; labels byte-identical to
+    the serial build).  ``None`` reads the PYPARDIS_CHAINED_OVERLAP
+    env kill-switch and defaults on; a warm stacked-array cache from a
+    previous non-overlapped fit still wins (nothing left to overlap).
+    Multi-device meshes and the ring path are unaffected.
     """
     from ..ops.distances import _norm_metric
     from .mesh import default_mesh
@@ -1576,6 +1926,28 @@ def sharded_dbscan(
                     k=k, precision=precision, n=n)
         staging.give_back(host_bufs)
         return _canonicalize_roots(labels, core), core, stats
+    if (
+        mesh.devices.size == 1
+        and len(partitioner.partitions) > 1
+        and _overlap_enabled(overlap)
+    ):
+        base_key = _sharding_cache_key(
+            points, partitioner, n_shards, block, sharding
+        )
+        if not staging.device_peek("host_owned", base_key):
+            # The double-buffered chained route: per-partition host
+            # build + transfer overlapped with device execution.  A
+            # live stacked-array cache (a previous non-overlapped fit)
+            # falls through instead — its warm path has no host work
+            # left to hide.
+            return _sharded_dbscan_1dev_overlap(
+                points, partitioner, eps=eps, min_samples=min_samples,
+                metric=metric, block=block, mesh=mesh, axis=axis,
+                n_points=n, precision=precision, backend=backend,
+                merge=merge, pair_budget=pair_budget,
+                merge_rounds=merge_rounds, n_shards=n_shards,
+                base_key=base_key,
+            )
     with obs_span("sharded.build_shards", halo="host"):
         arrays, stats, host_bufs = _host_build_cached(
             points, partitioner, eps, n_shards, block, sharding

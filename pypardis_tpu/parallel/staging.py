@@ -137,6 +137,22 @@ def device_get(route: str, key) -> Optional[tuple]:
     return arrays, dict(aux)
 
 
+def device_peek(route: str, key) -> bool:
+    """True when ``route`` holds a live entry for ``key`` — a pure
+    lookahead for drivers choosing between a cached-array path and a
+    streaming rebuild.  Never touches the reuse accounting and never
+    evicts (the committed ``device_get`` still decides both)."""
+    entry = _device_cache.get(route)
+    return entry is not None and entry[0] == key
+
+
+def device_evict(route: str) -> None:
+    """Drop one route's cached entry (restage paths: a transient
+    device fault can delete cached buffers out from under the cache —
+    the retry must rebuild, not re-serve dead handles)."""
+    _device_cache.pop(route, None)
+
+
 def device_put_cached(route: str, key, arrays: tuple, aux=None) -> tuple:
     """Record freshly staged device arrays (plus their build stats) for
     reuse by the next fit."""

@@ -1,5 +1,7 @@
-"""Shared utilities: shape arithmetic, metrics, checkpointing."""
+"""Shared utilities: shape arithmetic, validation, metrics,
+checkpointing."""
 
 from .shaping import clamp_block, round_up
+from .validate import validate_params
 
-__all__ = ["round_up", "clamp_block"]
+__all__ = ["round_up", "clamp_block", "validate_params"]

@@ -1,0 +1,29 @@
+"""Hyperparameter validation shared by the drivers and kernel entry
+points.
+
+The reference inherits sklearn's input contract (reject bad
+hyperparameters loudly); this repro silently accepted ``eps <= 0`` —
+the kernels compare SQUARED distances, so ``eps=-0.3`` behaved exactly
+like ``eps=0.3`` — and non-finite eps produced all-noise labels.  One
+validator, called by ``DBSCAN.train`` with the concrete values and by
+``ops.labels.dbscan_fixed_size`` defensively (tracers pass through
+unchecked; their driver already validated).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def validate_params(eps, min_samples) -> None:
+    """Raise ValueError on an invalid concrete (eps, min_samples).
+
+    Values that are not plain numbers (jax tracers on the in-jit call
+    sites) are skipped — validation happens once, host-side, with the
+    concrete hyperparameters.
+    """
+    if isinstance(min_samples, (int, np.integer)) and min_samples < 1:
+        raise ValueError(f"min_samples must be >= 1, got {min_samples}")
+    if isinstance(eps, (int, float, np.floating)):
+        if not np.isfinite(eps) or eps <= 0:
+            raise ValueError(f"eps must be positive and finite, got {eps}")

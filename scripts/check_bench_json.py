@@ -65,6 +65,23 @@ def main() -> None:
     # present and finite on EVERY row (single-shard rows report 1.0/0).
     number("sharding", "duplicated_work_factor")
     number("sharding", "staged_bytes_reused")
+    # Host-pipeline contract (ISSUE 3): the chained-loop overlap gauge
+    # and the partitioner's per-level build breakdown must be present
+    # and finite on EVERY row (single-shard rows report 0.0 / []).
+    number("sharding", "overlap_efficiency")
+    levels = tel["sharding"].get("partition_levels_s")
+    if not isinstance(levels, list):
+        fail(
+            f"telemetry.sharding.partition_levels_s is {levels!r}, "
+            f"expected a list"
+        )
+    for i, v in enumerate(levels):
+        if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                or v != v or v in (float("inf"), float("-inf")):
+            fail(
+                f"telemetry.sharding.partition_levels_s[{i}] is {v!r}, "
+                f"expected a finite number"
+            )
     # Achieved-FLOP/s model: live pairs, pass count, mfu — finite
     # numbers always; a fit with no pair telemetry reports zeros, never
     # NaN.

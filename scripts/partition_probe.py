@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""KDPartitioner build-time-vs-max_partitions micro-bench.
+
+Emits one JSON row per (builder, max_partitions) cell: wall seconds
+(best of ``PROBE_REPS``), the per-level breakdown, and the cost ratio
+against the smallest mp — the number behind the host-pipeline
+acceptance contract (the level-synchronous builder's mp=16 build costs
+<= 1.5x its mp=8 build; the legacy builder's per-node gathers measured
+~5x at 10M points, MESHSCALE_r05).  Pure numpy: no JAX import, so it
+probes the host phase alone.
+
+Env:  PROBE_N (default 1_000_000), PROBE_DIM (16), PROBE_MPS
+("8,16,32"), PROBE_REPS (2), PROBE_CHECK ("1" fails the process when
+the level builder's ratio exceeds PROBE_RATIO_MAX, default 1.5 —
+"0" to just report).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pypardis_tpu.partition import KDPartitioner, clear_level_pool  # noqa: E402
+
+
+def main() -> int:
+    n = int(os.environ.get("PROBE_N", 1_000_000))
+    dim = int(os.environ.get("PROBE_DIM", 16))
+    mps = [
+        int(x)
+        for x in os.environ.get("PROBE_MPS", "8,16,32").split(",")
+        if x
+    ]
+    reps = int(os.environ.get("PROBE_REPS", 2))
+    check = os.environ.get("PROBE_CHECK", "1") == "1"
+    ratio_max = float(os.environ.get("PROBE_RATIO_MAX", 1.5))
+
+    rng = np.random.default_rng(0)
+    pts = rng.normal(size=(n, dim)).astype(np.float32)
+
+    failures = []
+    for builder in ("legacy", "level"):
+        clear_level_pool()
+        base = None
+        for mp in sorted(mps):
+            best, levels = None, []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                part = KDPartitioner(
+                    pts, max_partitions=mp, builder=builder
+                )
+                dt = time.perf_counter() - t0
+                if best is None or dt < best:
+                    best, levels = dt, list(part.level_times_s)
+            if base is None:
+                base = best
+            ratio = best / base if base > 0 else 1.0
+            print(
+                json.dumps(
+                    {
+                        "metric": "kdpartitioner_build_s",
+                        "builder": builder,
+                        "n": n,
+                        "dim": dim,
+                        "max_partitions": mp,
+                        "build_s": round(best, 4),
+                        "ratio_vs_min_mp": round(ratio, 3),
+                        "levels_s": [round(t, 4) for t in levels],
+                        "n_partitions": part.n_partitions,
+                    }
+                )
+            )
+            if (
+                check
+                and builder == "level"
+                and mp == 2 * min(mps)
+                and ratio > ratio_max
+            ):
+                failures.append(
+                    f"level builder mp={mp} ratio {ratio:.2f} > "
+                    f"{ratio_max} vs mp={min(mps)}"
+                )
+    for f in failures:
+        print(f"partition probe FAILED: {f}", file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -333,3 +333,30 @@ def test_pair_overflow_event_recorded():
         )
     assert rec.event_counts().get("pair_overflow", 0) >= 1
     PAIR_BUDGET_HINTS.clear()
+
+
+def test_report_host_pipeline_fields():
+    """ISSUE 3 contract: overlap_efficiency and partition_levels_s are
+    present on EVERY report — 0.0/[] for single-shard fits, populated
+    per-level timings for sharded fits."""
+    import numpy as np
+
+    from pypardis_tpu import DBSCAN
+
+    rng = np.random.default_rng(9)
+    X = rng.normal(size=(12, 2))  # < 2*n_devices: the single-shard path
+    m = DBSCAN(eps=0.5, min_samples=3).fit(X)
+    rep = m.report()
+    assert rep["sharding"]["overlap_efficiency"] == 0.0
+    assert rep["sharding"]["partition_levels_s"] == []
+    assert "overlap" in rep["params"]
+
+    X = rng.normal(size=(4000, 3))
+    m = DBSCAN(eps=0.4, min_samples=5, block=64).fit(X)  # 8-dev sharded
+    rep = m.report()
+    levels = rep["sharding"]["partition_levels_s"]
+    assert isinstance(levels, list) and len(levels) >= 1
+    assert all(isinstance(t, float) and t >= 0 for t in levels)
+    assert rep["sharding"]["partition_builder"] == "level"
+    # summary renders the new lines without raising
+    assert "partition levels" in m.summary()

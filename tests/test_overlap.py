@@ -1,0 +1,124 @@
+"""Double-buffered chained execution (ISSUE 3 tentpole, prong 2).
+
+The overlapped 1-device chained route builds + ships partition i+1's
+slabs while the device executes partition i.  The contract: labels are
+BYTE-IDENTICAL with overlap on vs off (the overlap changes scheduling,
+never values), the rotating staging buffers can never serve a stale or
+in-flight-mutated slab, and the loop reports its ``overlap_efficiency``
+gauge.
+"""
+
+import numpy as np
+import pytest
+from sklearn.datasets import make_blobs
+
+from pypardis_tpu.parallel import default_mesh, sharded_dbscan
+from pypardis_tpu.parallel import staging
+from pypardis_tpu.partition import KDPartitioner
+
+
+@pytest.fixture(autouse=True)
+def _fresh_staging():
+    staging.clear()
+    yield
+    staging.clear()
+
+
+@pytest.fixture()
+def data():
+    X, _ = make_blobs(
+        n_samples=4000, centers=10, n_features=3, cluster_std=0.3,
+        random_state=5,
+    )
+    return X.astype(np.float32)
+
+
+KW = dict(eps=0.4, min_samples=5, block=64)
+
+
+@pytest.mark.parametrize("merge", ["device", "host"])
+def test_overlap_labels_byte_identical(data, merge):
+    """Chained-route labels with overlap on == off == the 8-device
+    fused program, on both merge modes."""
+    part = KDPartitioner(data, max_partitions=8)
+    ref, ref_core, s_ref = sharded_dbscan(
+        data, part, mesh=default_mesh(8), merge=merge, **KW
+    )
+    mesh1 = default_mesh(1)
+    staging.clear()
+    l_off, c_off, s_off = sharded_dbscan(
+        data, part, mesh=mesh1, merge=merge, overlap=False, **KW
+    )
+    staging.clear()
+    l_on, c_on, s_on = sharded_dbscan(
+        data, part, mesh=mesh1, merge=merge, overlap=True, **KW
+    )
+    np.testing.assert_array_equal(l_on, l_off)
+    np.testing.assert_array_equal(c_on, c_off)
+    np.testing.assert_array_equal(l_on, ref)
+    # The overlapped run measured its chained loop; the others ran none.
+    assert 0.0 < s_on["overlap_efficiency"] <= 1.0
+    assert "overlap_efficiency" not in s_off
+
+
+def test_overlap_warm_refit_reuses_chained_slabs(data):
+    """Warm refits serve the per-partition device slabs from the
+    staging cache; an eps sweep re-ships only the (eps-keyed) halos."""
+    part = KDPartitioner(data, max_partitions=8)
+    mesh1 = default_mesh(1)
+    l1, _c, s1 = sharded_dbscan(data, part, mesh=mesh1, overlap=True, **KW)
+    assert s1["staged_bytes_reused"] == 0 and s1["staged_bytes"] > 0
+    l2, _c, s2 = sharded_dbscan(data, part, mesh=mesh1, overlap=True, **KW)
+    assert s2["staged_bytes"] == 0
+    assert s2["staged_bytes_reused"] == s1["staged_bytes"]
+    np.testing.assert_array_equal(l1, l2)
+    kw = dict(KW, eps=0.5)
+    _l, _c, s3 = sharded_dbscan(data, part, mesh=mesh1, overlap=True, **kw)
+    assert s3["staged_bytes_reused"] > 0  # owned slabs from cache
+    assert s3["staged_bytes"] > 0  # halos re-shipped
+
+
+def test_overlap_mutation_safety(data):
+    """The rotating pooled buffers and the device slab cache never
+    serve stale bytes: mutate the input in place between overlapped
+    fits and the second fit must match a cold fit of the new data."""
+    X = np.array(data)
+    mesh1 = default_mesh(1)
+    part1 = KDPartitioner(X, max_partitions=8)
+    l1, _c, _s = sharded_dbscan(X, part1, mesh=mesh1, overlap=True, **KW)
+    X[:500] += 50.0  # in place — same array object, same shapes
+    part2 = KDPartitioner(X, max_partitions=8)
+    l2, _c2, s2 = sharded_dbscan(X, part2, mesh=mesh1, overlap=True, **KW)
+    assert s2["staged_bytes_reused"] == 0  # content fingerprint missed
+    staging.clear()
+    ref, _rc, _rs = sharded_dbscan(
+        X, part2, mesh=mesh1, overlap=False, **KW
+    )
+    np.testing.assert_array_equal(l2, ref)
+    assert not np.array_equal(l1, l2)
+
+
+def test_overlap_pool_rotation_across_fits(data):
+    """Back-to-back overlapped fits of DIFFERENT datasets reuse the
+    host slab pool (the borrow/return pairs) — results must follow the
+    data, never the buffer history."""
+    mesh1 = default_mesh(1)
+    X2 = data + np.float32(25.0)
+    part1 = KDPartitioner(data, max_partitions=8)
+    part2 = KDPartitioner(X2, max_partitions=8)
+    sharded_dbscan(data, part1, mesh=mesh1, overlap=True, **KW)
+    l2, _c, _s = sharded_dbscan(X2, part2, mesh=mesh1, overlap=True, **KW)
+    staging.clear()
+    ref, _rc, _rs = sharded_dbscan(
+        X2, part2, mesh=mesh1, overlap=False, **KW
+    )
+    np.testing.assert_array_equal(l2, ref)
+
+
+def test_overlap_env_kill_switch(data, monkeypatch):
+    monkeypatch.setenv("PYPARDIS_CHAINED_OVERLAP", "0")
+    part = KDPartitioner(data, max_partitions=8)
+    _l, _c, stats = sharded_dbscan(
+        data, part, mesh=default_mesh(1), **KW
+    )
+    assert "overlap_efficiency" not in stats

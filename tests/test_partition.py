@@ -113,3 +113,116 @@ def test_partitioner_preserves_float32():
     part = KDPartitioner(pts, max_partitions=4)
     assert part.points.dtype == np.float32  # no silent f64 doubling
     assert part.n_partitions == 4
+
+
+# -- level-synchronous fast path vs legacy builder -------------------------
+
+
+def _assert_builders_identical(pts, **kw):
+    a = KDPartitioner(pts, builder="legacy", **kw)
+    b = KDPartitioner(pts, builder="level", **kw)
+    assert a.tree == b.tree
+    np.testing.assert_array_equal(a.result, b.result)
+    assert sorted(a.partitions) == sorted(b.partitions)
+    for label in a.partitions:
+        np.testing.assert_array_equal(
+            a.partitions[label], b.partitions[label]
+        )
+    assert sorted(a.bounding_boxes) == sorted(b.bounding_boxes)
+    for label in a.bounding_boxes:
+        np.testing.assert_array_equal(
+            a.bounding_boxes[label].lower, b.bounding_boxes[label].lower
+        )
+        np.testing.assert_array_equal(
+            a.bounding_boxes[label].upper, b.bounding_boxes[label].upper
+        )
+    return a, b
+
+
+@pytest.mark.parametrize("method", ["min_var", "rotation", "mean_var",
+                                    "median_search"])
+@pytest.mark.parametrize("sample_size", [None, 700])
+def test_level_builder_byte_identical(method, sample_size):
+    """The level-synchronous fast path reproduces the legacy builder's
+    tree, result, partitions, and boxes EXACTLY — same RNG stream for
+    the subsample draws, same reductions on the same row order."""
+    pts = np.random.default_rng(20).normal(size=(5000, 3))
+    _assert_builders_identical(
+        pts, max_partitions=16, split_method=method,
+        sample_size=sample_size, seed=3,
+    )
+
+
+def test_level_builder_budget_stop_identical():
+    """A max_partitions that exhausts mid-level stops both builders at
+    the same node."""
+    pts = np.random.default_rng(21).normal(size=(3000, 2))
+    for mp in (3, 5, 7, 11):
+        _assert_builders_identical(pts, max_partitions=mp)
+
+
+def test_level_builder_degenerate_identical():
+    """All-equal coordinates: the exact-median fallback and the
+    give-up path replicate."""
+    # fully degenerate: no split possible anywhere
+    pts = np.ones((100, 2))
+    a, b = _assert_builders_identical(pts, max_partitions=8)
+    assert a.tree == [] and a.n_partitions == 1
+    # one constant axis: rotation hits the fallback on that axis
+    rng = np.random.default_rng(22)
+    pts = np.concatenate(
+        [np.ones((400, 1)), rng.normal(size=(400, 1))], axis=1
+    )
+    for method in ("rotation", "min_var"):
+        _assert_builders_identical(
+            pts, max_partitions=8, split_method=method
+        )
+
+
+def test_level_builder_fortran_order_identical():
+    pts = np.asfortranarray(
+        np.random.default_rng(23).normal(size=(2000, 4))
+    )
+    _assert_builders_identical(pts, max_partitions=8)
+
+
+def test_level_builder_emits_level_times():
+    pts = np.random.default_rng(24).normal(size=(4000, 3))
+    part = KDPartitioner(pts, max_partitions=16, builder="level")
+    assert part.builder == "level"
+    # 16 partitions = 4 complete levels, one timing each
+    assert len(part.level_times_s) == 4
+    assert all(t >= 0 for t in part.level_times_s)
+    legacy = KDPartitioner(pts, max_partitions=16, builder="legacy")
+    assert len(legacy.level_times_s) == 4
+
+
+def test_builder_auto_resolution(tmp_path):
+    pts = np.random.default_rng(25).normal(size=(500, 2))
+    assert KDPartitioner(pts, max_partitions=4).builder == "level"
+    mm_path = tmp_path / "pts.bin"
+    mm = np.memmap(mm_path, dtype=np.float64, mode="w+", shape=(500, 2))
+    mm[:] = pts
+    # memmaps keep the O(index)-memory legacy build (the level buffer
+    # would materialize the dataset in RAM)
+    part = KDPartitioner(mm, max_partitions=4)
+    assert part.builder == "legacy"
+    with pytest.raises(ValueError):
+        KDPartitioner(pts, builder="bogus")
+
+
+def test_level_pool_reuse_stays_correct():
+    """Pooled level buffers are reused across builds — a second build
+    on DIFFERENT data of the same shape must not inherit anything."""
+    from pypardis_tpu.partition import clear_level_pool
+
+    clear_level_pool()
+    rng = np.random.default_rng(26)
+    pts1 = rng.normal(size=(3000, 3))
+    pts2 = rng.normal(size=(3000, 3)) + 5.0
+    KDPartitioner(pts1, max_partitions=8, builder="level")
+    b = KDPartitioner(pts2, max_partitions=8, builder="level")
+    a = KDPartitioner(pts2, max_partitions=8, builder="legacy")
+    assert a.tree == b.tree
+    np.testing.assert_array_equal(a.result, b.result)
+    clear_level_pool()

@@ -98,3 +98,30 @@ def test_ring_route_caches_owned_slabs(data):
     _l, _c, s2 = sharded_dbscan(data, part, **kw)
     assert s1["staged_bytes_reused"] == 0
     assert s2["staged_bytes_reused"] > 0
+
+
+def test_single_shard_layout_cache(data):
+    """ISSUE 3: the single-shard route caches its layout products
+    (sorted device arrays) by content — a warm repeat fit skips the
+    staging fill, the transfer, and the device Morton sort, and an
+    in-place mutation can never be served stale."""
+    from pypardis_tpu.dbscan import _pad_and_run
+
+    X = np.array(data[:1200], np.float32)
+    l1, c1, i1 = _pad_and_run(X, 0.4, 5, "euclidean", 128)
+    assert i1["staged_bytes_reused"] == 0 and i1["staged_bytes"] > 0
+    l2, c2, i2 = _pad_and_run(X, 0.4, 5, "euclidean", 128)
+    assert i2["staged_bytes"] == 0
+    assert i2["staged_bytes_reused"] == i1["staged_bytes"]
+    np.testing.assert_array_equal(l1, l2)
+    np.testing.assert_array_equal(c1, c2)
+    # different eps -> different layout (segment breaks) -> miss
+    _l, _c, i3 = _pad_and_run(X, 0.5, 5, "euclidean", 128)
+    assert i3["staged_bytes_reused"] == 0
+    # in-place mutation -> content fingerprint miss, fresh labels
+    X[:100] += 40.0
+    l4, _c4, i4 = _pad_and_run(X, 0.4, 5, "euclidean", 128)
+    assert i4["staged_bytes_reused"] == 0
+    staging.clear()
+    l5, _c5, _i5 = _pad_and_run(X, 0.4, 5, "euclidean", 128)
+    np.testing.assert_array_equal(l4, l5)

@@ -1,0 +1,69 @@
+"""Input validation (ISSUE 3 satellite): the sklearn-inherited input
+contract.  ``eps=-0.3`` used to behave exactly like ``eps=0.3`` (the
+kernels compare squared distances) and a single NaN poisoned the
+Morton span into silently wrong labels."""
+
+import numpy as np
+import pytest
+
+from pypardis_tpu import DBSCAN
+
+
+@pytest.fixture()
+def X():
+    return np.random.default_rng(0).normal(size=(64, 3))
+
+
+@pytest.mark.parametrize("eps", [0.0, -0.3, float("nan"), float("inf")])
+def test_train_rejects_bad_eps(X, eps):
+    with pytest.raises(ValueError, match="eps"):
+        DBSCAN(eps=eps, min_samples=5).fit(X)
+
+
+@pytest.mark.parametrize("min_samples", [0, -1])
+def test_train_rejects_bad_min_samples(X, min_samples):
+    with pytest.raises(ValueError, match="min_samples"):
+        DBSCAN(eps=0.5, min_samples=min_samples).fit(X)
+
+
+@pytest.mark.parametrize("bad", [np.nan, np.inf, -np.inf])
+def test_train_rejects_nonfinite_coordinates(X, bad):
+    X = X.copy()
+    X[17, 1] = bad
+    with pytest.raises(ValueError, match="NaN or infinite"):
+        DBSCAN(eps=0.5, min_samples=5).fit(X)
+
+
+def test_train_rejects_nonfinite_device_input(X):
+    import jax.numpy as jnp
+
+    Xd = jnp.asarray(X.astype(np.float32)).at[3, 0].set(jnp.nan)
+    with pytest.raises(ValueError, match="NaN or infinite"):
+        DBSCAN(eps=0.5, min_samples=5).fit(Xd)
+
+
+def test_finite_check_env_opt_out(X, monkeypatch):
+    """Trusted pipelines can skip the O(N*k) pass — the fit then runs
+    (and may return garbage labels, which is the documented trade)."""
+    monkeypatch.setenv("PYPARDIS_SKIP_FINITE_CHECK", "1")
+    X = X.copy()
+    X[0, 0] = np.nan
+    DBSCAN(eps=0.5, min_samples=5).fit(X)  # must not raise
+
+
+def test_dbscan_fixed_size_rejects_bad_params():
+    import jax.numpy as jnp
+
+    from pypardis_tpu.ops.labels import dbscan_fixed_size
+
+    pts = jnp.zeros((128, 2), jnp.float32)
+    mask = jnp.ones((128,), bool)
+    with pytest.raises(ValueError, match="eps"):
+        dbscan_fixed_size(pts, -1.0, 5, mask, block=128)
+    with pytest.raises(ValueError, match="min_samples"):
+        dbscan_fixed_size(pts, 0.5, 0, mask, block=128)
+
+
+def test_valid_fit_still_works(X):
+    labels = DBSCAN(eps=0.5, min_samples=3).fit_predict(X)
+    assert labels.shape == (len(X),)
