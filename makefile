@@ -4,7 +4,7 @@
 PY ?= python
 
 .PHONY: all wheel native test verify tpu-smoke bench bench-smoke \
-	partition-probe demo clean
+	partition-probe serve-probe demo clean
 
 all: native test
 
@@ -41,9 +41,18 @@ bench:
 # metric/value/unit triple plus the run_report@1 telemetry block),
 # then the CI-sized partitioner depth-scaling probe (fails when the
 # level builder's mp-doubling cost ratio exceeds 1.5x).
-bench-smoke: partition-probe
+bench-smoke: partition-probe serve-probe
 	JAX_PLATFORMS=cpu BENCH_N=2000 BENCH_DIM=4 BENCH_REPS=1 \
 	BENCH_DEV_REPS=1 $(PY) bench.py | $(PY) scripts/check_bench_json.py
+
+# Serving probe: per-batch-size QPS + p50/p99 rows from the query
+# engine, each checked against the brute-force core-point oracle; the
+# emitted telemetry (run_report@1 + its new `serving` block) is
+# schema-validated like the bench row.
+serve-probe:
+	JAX_PLATFORMS=cpu SERVE_N=$${SERVE_N:-4000} \
+	SERVE_Q=$${SERVE_Q:-1024} $(PY) scripts/serve_probe.py \
+	| $(PY) scripts/check_bench_json.py
 
 # KDPartitioner build-time-vs-max_partitions rows (both builders, with
 # per-level breakdowns).  Full-size run: `PROBE_N=10000000 make

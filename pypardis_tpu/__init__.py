@@ -64,11 +64,14 @@ from .partition import (
 from .dbscan import DBSCAN, dbscan_partition, map_cluster_id
 from .config import DBSCANConfig
 from .checkpoint import (
+    load_index,
     load_model,
     load_partitioner,
+    save_index,
     save_model,
     save_partitioner,
 )
+from .serve import CorePointIndex, QueryEngine
 
 __all__ = [
     "obs",
@@ -87,5 +90,9 @@ __all__ = [
     "load_model",
     "save_partitioner",
     "load_partitioner",
+    "save_index",
+    "load_index",
+    "CorePointIndex",
+    "QueryEngine",
     "__version__",
 ]

@@ -69,7 +69,11 @@ class PartitionTree:
         return len(self.bounding_boxes)
 
     def route(self, points: np.ndarray) -> np.ndarray:
-        """Replay the split tree (shared with KDPartitioner.route)."""
+        """Replay the split tree (shared with KDPartitioner.route);
+        validates dimensionality and finiteness against the tree."""
+        from .utils.validate import check_query_points
+
+        check_query_points(points, self.k)
         return route_tree(self.tree, points)
 
 
@@ -107,12 +111,26 @@ def save_model(model, path: str) -> None:
         # (allow_pickle=False); store their string form instead and say
         # so loudly rather than writing an unreadable checkpoint.
         keys = keys.astype(str)
+    # Core-point coordinates (original dtype, cores only — the noise
+    # and border rows stay behind): everything a restarted process
+    # needs to build the serving index (pypardis_tpu.serve) and answer
+    # out-of-sample queries byte-identically without re-clustering.
+    cores = getattr(model, "_serve_core_points", None)
+    if cores is None and model.data is not None \
+            and model.core_sample_mask_ is not None:
+        cores = np.asarray(model.data)[
+            np.asarray(model.core_sample_mask_, bool)
+        ]
     np.savez(
         _norm_npz(path),
         kind="dbscan_model",
         params=json.dumps(params),
         labels_=model.labels_,
         core_sample_mask_=model.core_sample_mask_,
+        core_points=(
+            cores if cores is not None
+            else np.zeros((0, 0), np.float32)
+        ),
         keys=keys,
         box_labels=np.asarray(labels, dtype=np.int64),
         box_lower=np.stack([boxes[l].lower for l in labels])
@@ -157,7 +175,72 @@ def load_model(path: str):
             for l, b in model.bounding_boxes.items()
         }
         model.metrics_ = json.loads(str(z["metrics"]))
+        # Core coordinates (absent in pre-serving checkpoints): the
+        # loaded model can build the serving index and predict()
+        # without retraining or the original dataset.
+        if "core_points" in z.files and z["core_points"].size:
+            model._serve_core_points = z["core_points"]
         # ``result`` builds lazily from the restored keys/labels (the
         # property key-sorts; an eager unsorted build here violated the
         # sortByKey contract for non-arange keys).
     return model
+
+
+def save_index(index, path: str) -> None:
+    """Persist a serving index (:class:`pypardis_tpu.serve.
+    CorePointIndex`): the padded core slabs, labels, per-block bounds,
+    split tree, and geometry — a restarted process loads and serves
+    without the model, the dataset, or a rebuild."""
+    np.savez(
+        _norm_npz(path),
+        kind="serve_index",
+        params=json.dumps({
+            "eps": index.eps,
+            "block": index.block,
+            "qblock": index.qblock,
+            "n_core": index.n_core,
+            "leaf_cap": int(index.stats.get("leaf_cap", 0)),
+            "n_leaves": int(index.stats.get("n_leaves", 0)),
+        }),
+        center=index.center,
+        tree=np.asarray(index.tree, np.float64).reshape(-1, 5),
+        coords=index.coords,
+        labels=index.labels,
+        blo=index.blo,
+        bhi=index.bhi,
+    )
+
+
+def load_index(path: str):
+    """Restore a serving index saved by :func:`save_index` (slabs load
+    byte-identical, so a restored index serves identical answers)."""
+    from .serve import CorePointIndex
+
+    with np.load(_norm_npz(path), allow_pickle=False) as z:
+        if str(z["kind"]) != "serve_index":
+            raise ValueError(f"{path} is not a serving-index checkpoint")
+        params = json.loads(str(z["params"]))
+        idx = CorePointIndex(
+            eps=params["eps"],
+            center=z["center"],
+            tree=z["tree"],
+            coords=z["coords"],
+            labels=z["labels"],
+            blo=z["blo"],
+            bhi=z["bhi"],
+            block=params["block"],
+            qblock=params["qblock"],
+            n_core=params["n_core"],
+            stats={
+                "n_core": params["n_core"],
+                "n_leaves": params["n_leaves"],
+                "leaf_cap": params["leaf_cap"],
+                "index_bytes": int(
+                    z["coords"].nbytes + z["labels"].nbytes
+                    + z["blo"].nbytes + z["bhi"].nbytes
+                ),
+                "staged_bytes_reused": 0,
+                "staged_bytes": 0,
+            },
+        )
+    return idx

@@ -517,6 +517,11 @@ class DBSCAN:
         # export_trace().
         self._recorder = None
         self._fit_info: Dict[str, int] = {}
+        # Serving state (pypardis_tpu.serve): the cached query engine
+        # and, for checkpoint-loaded models, the persisted core-point
+        # coordinates the index builds from.
+        self._serve_engine = None
+        self._serve_core_points = None
 
     # -- training ---------------------------------------------------------
 
@@ -546,6 +551,11 @@ class DBSCAN:
         rec = obs.RunRecorder()
         self._recorder = rec
         self.metrics_ = {}
+        # A refit invalidates the serving surface: the cached engine
+        # indexes the PREVIOUS clustering, and checkpoint-carried core
+        # points describe a model this fit replaces.
+        self._serve_engine = None
+        self._serve_core_points = None
 
         if len(points) == 0:
             self.labels_ = np.empty(0, np.int32)
@@ -665,11 +675,46 @@ class DBSCAN:
     def result(self, value):
         self._result_cache = value
 
+    def _require_fitted(self) -> None:
+        """One not-fitted guard, one message — every result surface
+        (``assignments``/``report``/``summary``/``predict``/...) used
+        to phrase this differently."""
+        if self.labels_ is None:
+            raise RuntimeError(
+                "this DBSCAN model is not fitted; call fit()/train() first"
+            )
+
     def assignments(self):
         """[(key, global cluster id)] — reference dbscan.py:128-134."""
-        if self.result is None:
-            raise RuntimeError("call train() first")
+        self._require_fitted()
         return self.result
+
+    # -- serving ----------------------------------------------------------
+
+    def predict(self, X) -> np.ndarray:
+        """Out-of-sample cluster assignment: (N,) int32 labels.
+
+        DBSCAN's own serving rule (Ester et al., KDD 1996): a query
+        joins cluster ``c`` iff it lies within ``eps`` of a core point
+        of ``c`` — resolved to the NEAREST core point (ties: smallest
+        label) — else noise (-1).  Runs through the cached
+        :meth:`query_engine`; exact against the brute-force core-point
+        oracle on every backend (:mod:`pypardis_tpu.serve`).
+        """
+        return self.query_engine().predict(X)
+
+    def query_engine(self, **kw):
+        """The cached serving engine over this model's core-point index
+        (built on first use; kwargs — ``leaves``/``block``/``qblock``/
+        ``backend``/``batch_capacity``/... — force a rebuild).  Works on
+        checkpoint-loaded models without retraining: ``save_model``
+        persists the core points."""
+        self._require_fitted()
+        if self._serve_engine is None or kw:
+            from .serve import QueryEngine
+
+            self._serve_engine = QueryEngine.from_model(self, **kw)
+        return self._serve_engine
 
     # -- telemetry --------------------------------------------------------
 
@@ -683,10 +728,14 @@ class DBSCAN:
         metrics-registry dump.  ``bench.py`` embeds the identical
         structure in its JSON line.
         """
-        if self.labels_ is None:
-            raise RuntimeError("call fit()/train() first")
+        self._require_fitted()
         from .obs import build_run_report
 
+        eng = self._serve_engine
+        serving = (
+            eng.serving_stats() if eng is not None and eng.queries > 0
+            else None
+        )
         return build_run_report(
             self._recorder,
             params={
@@ -707,6 +756,7 @@ class DBSCAN:
             n_devices=self._fit_info.get("n_devices", 1),
             backend=jax_backend_name(),
             metrics=self.metrics_,
+            serving=serving,
         )
 
     def summary(self) -> str:
@@ -720,8 +770,12 @@ class DBSCAN:
         chrome://tracing / ui.perfetto.dev).  Complements the
         ``profile_dir`` jax.profiler trace: this one is always recorded
         and costs microseconds."""
+        self._require_fitted()
         if self._recorder is None:
-            raise RuntimeError("call fit()/train() first")
+            raise RuntimeError(
+                "no telemetry recorded for this model (loaded from a "
+                "checkpoint?) — export_trace needs an in-process fit"
+            )
         return self._recorder.tracer.export_chrome_trace(path)
 
     # -- internals --------------------------------------------------------

@@ -137,6 +137,7 @@ def build_run_report(
     n_devices: int,
     backend: str,
     metrics: Dict,
+    serving: Optional[Dict] = None,
 ) -> Dict:
     """Assemble the stable report dict from a fit's recorder + metrics.
 
@@ -241,6 +242,12 @@ def build_run_report(
     }
     if stepped:
         report["stepped"] = stepped
+    # Serving-engine gauges (QPS / batch fill / latency percentiles):
+    # present only once the model's query engine has answered queries
+    # (pypardis_tpu.serve) — scripts/check_bench_json.py validates the
+    # block on serve_probe rows.
+    if serving:
+        report["serving"] = serving
     return _clean(report)
 
 
@@ -320,6 +327,19 @@ def format_summary(report: Dict) -> str:
             f"{comp['achieved_flops_per_sec'] / 1e9:,.1f} GFLOP/s "
             f"(mfu {comp['mfu']:.2%} of {comp['peak_flops'] / 1e12:.0f} "
             f"TFLOP/s {comp['peak_source']} peak)"
+        )
+    srv = report.get("serving")
+    if srv:
+        lines.append(
+            f"  serving: {srv.get('queries', 0):,} queries in "
+            f"{srv.get('batches', 0)} batch(es) @ "
+            f"{srv.get('qps', 0):,.0f} q/s, "
+            f"p50 {srv.get('p50_ms', 0):.2f}ms "
+            f"p99 {srv.get('p99_ms', 0):.2f}ms, "
+            f"fill {srv.get('batch_fill', 0):.0%}, "
+            f"{srv.get('n_core', 0):,} cores / "
+            f"{srv.get('n_leaves', 0)} leaves "
+            f"({_fmt_bytes(srv.get('index_bytes', 0))})"
         )
     dev_pts = report["devices"].get("points")
     if dev_pts and len(dev_pts) > 1:

@@ -15,6 +15,7 @@ from .distances import (
     pairwise_sq_dists,
 )
 from .labels import dbscan_fixed_size, densify_labels
+from .query import brute_force_query, query_min_core
 
 __all__ = [
     "neighbor_counts",
@@ -22,4 +23,6 @@ __all__ = [
     "pairwise_sq_dists",
     "dbscan_fixed_size",
     "densify_labels",
+    "brute_force_query",
+    "query_min_core",
 ]

@@ -633,3 +633,115 @@ def min_neighbor_label_pallas(
     if poison is not None:
         best = jnp.where(poison, jnp.iinfo(jnp.int32).min, best)
     return best
+
+
+# -- serving: out-of-sample query kernel ---------------------------------
+
+
+def _query_leaf_kernel(leaf_ref, zero_ref, q_ref, c_ref, lab_ref,
+                       out_lab_ref, out_d2_ref, *, d):
+    """Grid (nqt, nb): query tile i folds column block j of its leaf's
+    core slab into the running per-row (min d2, min label among ties).
+
+    d^2 accumulates per axis in index order — the same IEEE float32 op
+    sequence as :func:`pypardis_tpu.ops.query.axis_sq_dists`, each
+    square sealed against FMA contraction with the prefetched runtime
+    zero (``ops.query.seal_f32``) — so the result is bit-identical to
+    the XLA path and the numpy oracle (the serving exactness contract).
+    The MXU decomposition is deliberately not used: its accumulation
+    order is backend-scheduled.  Pad core slots carry PAD_COORD (d^2
+    overflows to +inf) and INT32_MAX labels, so no mask enters the
+    kernel at all.
+    """
+    from .query import seal_f32
+
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        out_lab_ref[0] = jnp.full_like(out_lab_ref[0], _INT_INF)
+        out_d2_ref[0] = jnp.full_like(out_d2_ref[0], jnp.inf)
+
+    z = zero_ref[0]
+    q = q_ref[0]  # (d, qb)
+    c = c_ref[...]  # (d, block)
+    diff = q[0][:, None] - c[0][None, :]
+    acc = seal_f32(diff * diff, z)
+    for a in range(1, d):
+        diff = q[a][:, None] - c[a][None, :]
+        acc = acc + seal_f32(diff * diff, z)
+    lb = lab_ref[0, 0, :]
+    m = jnp.min(acc, axis=1)
+    cand = jnp.min(
+        jnp.where(acc == m[:, None], lb[None, :], _INT_INF), axis=1
+    )
+    bd2 = out_d2_ref[0, 0, :]
+    bl = out_lab_ref[0, 0, :]
+    take = (m < bd2) | ((m == bd2) & (cand < bl))
+    out_d2_ref[0, 0, :] = jnp.where(take, m, bd2)
+    out_lab_ref[0, 0, :] = jnp.where(take, cand, bl)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block", "nb", "interpret")
+)
+def query_min_core_pallas(
+    q, tile_leaf, coords, labels, zero_i32, *, block, nb, interpret=False
+):
+    """Pallas twin of :func:`pypardis_tpu.ops.query.query_min_core`.
+
+    Same packed (2, nqt, qb) int32 result contract (labels +
+    bitcast d2); the leaf indirection rides as scalar prefetch so each
+    tile's BlockSpecs address its leaf's slab blocks directly (the
+    block-sparse idiom of the fit kernels).  ``zero_i32``: a (1,) int32
+    zero ARRAY from the caller — it must reach the kernel as a traced
+    runtime value for the anti-FMA seal (``ops.query.seal_f32``) to
+    survive compilation.  No box pruning inside — every block of the
+    leaf's slab is visited, which is semantically identical (pruning
+    only skips provably-losing blocks) and keeps the kernel a pure
+    reduction.
+    """
+    nqt, d, qb = q.shape
+    lab3 = labels.reshape(-1, 1, block)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(nqt, nb),
+        in_specs=[
+            pl.BlockSpec(
+                (1, d, qb), lambda i, j, leaf, z: (i, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (d, block), lambda i, j, leaf, z: (0, leaf[i] * nb + j),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, block),
+                lambda i, j, leaf, z: (leaf[i] * nb + j, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=(
+            pl.BlockSpec(
+                (1, 1, qb), lambda i, j, leaf, z: (i, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, qb), lambda i, j, leaf, z: (i, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ),
+    )
+    labs, d2 = pl.pallas_call(
+        functools.partial(_query_leaf_kernel, d=d),
+        grid_spec=grid_spec,
+        out_shape=(
+            jax.ShapeDtypeStruct((nqt, 1, qb), jnp.int32),
+            jax.ShapeDtypeStruct((nqt, 1, qb), jnp.float32),
+        ),
+        interpret=interpret,
+    )(tile_leaf, zero_i32, q, coords, lab3)
+    return jnp.stack([
+        labs[:, 0, :],
+        jax.lax.bitcast_convert_type(d2[:, 0, :], jnp.int32),
+    ])

@@ -1,0 +1,241 @@
+"""Out-of-sample query primitive: nearest core point within eps.
+
+DBSCAN's own definition gives serving semantics for free (Ester et al.,
+KDD 1996): a query point belongs to cluster ``c`` iff it lies within
+``eps`` of a *core* point of ``c``, else it is noise.  The serving
+subsystem (:mod:`pypardis_tpu.serve`) resolves ties deterministically:
+the query takes the label of its NEAREST core point, and among equally
+near core points the smallest label wins — so ``(min d^2, then min
+label)`` is the complete assignment rule.
+
+Exactness discipline: the device kernels and the numpy oracle
+(:func:`brute_force_query`) compute squared distances with the SAME
+sequence of IEEE float32 operations — per-axis ``(q_a - c_a)^2`` terms
+accumulated in axis order (:func:`axis_sq_dists`).  One compiler hazard
+stands between that and bit-equality: backends contract ``acc + d*d``
+into an FMA (one rounding instead of two — measured last-ulp drift on
+XLA:CPU, immune to every HLO-level barrier), so each square is sealed
+behind an integer XOR with a RUNTIME zero (:func:`seal_f32`) that no
+compiler can fold away.  With the seal, d^2 is bit-identical across
+numpy / XLA / Pallas and ``predict`` matches the brute-force oracle
+EXACTLY on every backend — by construction, not by tolerance.  (The
+fit kernels' matmul decomposition is deliberately NOT used here: its
+accumulation order is backend-scheduled.)
+
+Layout mirrors the fit kernels: core-point slabs ride in the transposed
+``(d, L*C)`` layout (point axis minor — dense in HBM for any d), one
+padded slab of ``C`` slots per KD leaf, ``C`` a multiple of the column
+``block``.  Pad slots carry ``PAD_COORD`` coordinates (astronomically
+far — their d^2 overflows to +inf and can never win a min) and
+``INT32_MAX`` labels, so no mask array enters the compute at all.
+Query batches arrive as ``(nqt, d, qb)`` tiles, each tile scanning one
+leaf's slab (``tile_leaf`` holds the leaf id per tile).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_INT_INF = np.int32(np.iinfo(np.int32).max)
+# Pad-slot coordinate: (PAD_COORD - x)^2 overflows float32 to +inf for
+# any real x, so pad slots lose every min and fail every eps test.
+PAD_COORD = np.float32(2e19)
+# Inverted-box sentinel for empty column blocks (same convention as
+# ops.distances._BIG): gap to anything is astronomically positive.
+BIG = np.float32(3e38)
+
+
+def eps2_f32(eps) -> np.float32:
+    """The float32 squared-eps threshold, computed identically on every
+    path (host oracle and device kernels compare against this exact
+    bit pattern)."""
+    e = np.float32(eps)
+    return np.float32(e * e)
+
+
+def axis_sq_dists(q, c):
+    """(m, d) x (n, d) -> (m, n) float32 squared distances, accumulated
+    per axis in index order — the numpy reference arithmetic: each
+    subtract/multiply/add is one correctly-rounded IEEE float32 op in a
+    fixed order.  The device kernels replay the identical op sequence
+    (:func:`_axis_sq_dists_t`), so d^2 is bit-identical between the
+    oracle and every backend."""
+    diff = q[:, 0, None] - c[None, :, 0]
+    acc = diff * diff
+    for a in range(1, q.shape[1]):
+        diff = q[:, a, None] - c[None, :, a]
+        acc = acc + diff * diff
+    return acc
+
+
+def seal_f32(x, zero_i32):
+    """Value-identity that compilers cannot see through: bitcast to
+    int32, XOR with a RUNTIME zero, bitcast back.
+
+    XLA:CPU's LLVM backend contracts ``acc + d*d`` into an FMA (one
+    rounding instead of two — measured last-ulp drift vs numpy), and no
+    HLO-level barrier survives to the instruction selector.  Routing
+    the product through an integer op whose operand is a traced runtime
+    value forces the multiply to materialize with its own rounding —
+    restoring numpy's exact op sequence.  ``zero_i32`` MUST be traced
+    (a jit argument or prefetched scalar); a literal 0 constant-folds
+    and the contraction returns.
+    """
+    import jax
+
+    return jax.lax.bitcast_convert_type(
+        jax.lax.bitcast_convert_type(x, jnp.int32) ^ zero_i32,
+        jnp.float32,
+    )
+
+
+def _axis_sq_dists_t(q_t, c_t, zero_i32):
+    """Transposed-layout device twin of :func:`axis_sq_dists`: (d, m) x
+    (d, n) -> (m, n), same ops in the same order (layout changes
+    indexing, never arithmetic); every square rides through
+    :func:`seal_f32` so no backend can fuse it into the accumulate."""
+    diff = q_t[0][:, None] - c_t[0][None, :]
+    acc = seal_f32(diff * diff, zero_i32)
+    for a in range(1, q_t.shape[0]):
+        diff = q_t[a][:, None] - c_t[a][None, :]
+        acc = acc + seal_f32(diff * diff, zero_i32)
+    return acc
+
+
+def brute_force_query(queries, cores, labels, eps):
+    """The numpy oracle: exact ``(label, d2)`` per query over ALL cores.
+
+    ``queries``/``cores`` are cast to float32 first (the serving dtype
+    — callers pass already-centered coordinates); d^2 accumulates via
+    :func:`axis_sq_dists`.  Returns ``(labels, d2)``: label -1 and
+    d2 = +inf where no core lies within eps.  This is the reference
+    the device engine must match exactly (tests pin equality).
+    """
+    q = np.asarray(queries, np.float32)
+    c = np.asarray(cores, np.float32)
+    lab = np.asarray(labels, np.int32)
+    m = len(q)
+    out_lab = np.full(m, -1, np.int32)
+    out_d2 = np.full(m, np.inf, np.float32)
+    if m == 0 or len(c) == 0:
+        return out_lab, out_d2
+    e2 = eps2_f32(eps)
+    # Chunk queries so the (chunk, n_core) temp stays ~256MB at most.
+    chunk = max(1, (1 << 26) // max(len(c), 1))
+    for s in range(0, m, chunk):
+        d2 = axis_sq_dists(q[s:s + chunk], c)
+        dmin = d2.min(axis=1)
+        tied = np.where(d2 == dmin[:, None], lab[None, :], _INT_INF)
+        labmin = tied.min(axis=1).astype(np.int32)
+        sel = dmin <= e2
+        out_lab[s:s + chunk] = np.where(sel, labmin, -1)
+        out_d2[s:s + chunk] = np.where(sel, dmin, np.float32(np.inf))
+    return out_lab, out_d2
+
+
+def _block_best(d2, lab_block, best_d2, best_lab):
+    """Fold one (qb, block) distance tile into the per-row running
+    ``(min d2, min label among ties)`` — the deterministic assignment
+    rule, applied identically in the XLA scan, the Pallas kernel, and
+    (via global min) the numpy oracle."""
+    m = jnp.min(d2, axis=1)
+    cand = jnp.min(
+        jnp.where(d2 == m[:, None], lab_block[None, :], _INT_INF), axis=1
+    )
+    take = (m < best_d2) | ((m == best_d2) & (cand < best_lab))
+    return jnp.where(take, m, best_d2), jnp.where(take, cand, best_lab)
+
+
+@functools.partial(jax.jit, static_argnames=("block", "nb"))
+def query_min_core(
+    q, qmask, tile_leaf, coords, labels, blo, bhi, eps2, zero_i32,
+    *, block, nb
+):
+    """XLA query kernel: per query row, ``(min d2, min label)`` over its
+    leaf's core slab.
+
+    ``q``: (nqt, d, qb) float32 centered query tiles (pad rows at
+    ``PAD_COORD``); ``qmask``: (nqt, qb) bool row validity (tightens
+    the pruning boxes only — pad rows' outputs are garbage the caller
+    drops); ``tile_leaf``: (nqt,) int32 leaf per tile; ``coords``:
+    (d, L*C) core slabs; ``labels``: (L*C,) int32; ``blo``/``bhi``:
+    (L*nb, d) per-column-block core bounds (inverted for empty
+    blocks); ``eps2``: float32 scalar; ``zero_i32``: a TRACED int32
+    zero (see :func:`seal_f32` — pass ``jnp.int32(0)`` as an argument,
+    never bake a literal).  Column blocks whose box lies
+    farther than eps from the tile's query box are skipped — sound for
+    the final within-eps verdict because a within-eps core's block can
+    never be pruned (box min-distance <= true distance <= eps).
+
+    Returns one packed (2, nqt, qb) int32 array — ``[labels,
+    bitcast(d2)]`` — so the engine fetches results in a single
+    device->host transfer (:func:`unpack_query_result` decodes).
+    """
+    nqt, d, qb = q.shape
+
+    def tile(args):
+        qi, mi, leaf = args
+        valid = mi[None, :]
+        qlo = jnp.min(jnp.where(valid, qi, BIG), axis=1)
+        qhi = jnp.max(jnp.where(valid, qi, -BIG), axis=1)
+
+        def col(carry, j):
+            cb = leaf * nb + j
+            gap = jnp.maximum(
+                0.0, jnp.maximum(blo[cb] - qhi, qlo - bhi[cb])
+            )
+            skip = jnp.sum(gap * gap) > eps2
+
+            def compute(c):
+                cols = jax.lax.dynamic_slice(
+                    coords, (0, cb * block), (d, block)
+                )
+                lb = jax.lax.dynamic_slice(labels, (cb * block,), (block,))
+                d2 = _axis_sq_dists_t(qi, cols, zero_i32)
+                return _block_best(d2, lb, c[0], c[1])
+
+            return jax.lax.cond(skip, lambda c: c, compute, carry), None
+
+        init = (
+            jnp.full((qb,), jnp.inf, jnp.float32),
+            jnp.full((qb,), _INT_INF, jnp.int32),
+        )
+        (bd2, bl), _ = jax.lax.scan(col, init, jnp.arange(nb))
+        return bl, bd2
+
+    labs, d2 = jax.lax.map(tile, (q, qmask, tile_leaf))
+    return jnp.stack([labs, jax.lax.bitcast_convert_type(d2, jnp.int32)])
+
+
+def unpack_query_result(packed, eps2):
+    """Host decode of the kernels' packed (2, nqt, qb) int32 result:
+    ``(raw_labels, raw_d2)`` — raw, i.e. before the within-eps verdict
+    (the engine folds multi-leaf replicas first, then applies
+    ``d2 <= eps2``)."""
+    packed = np.asarray(packed)
+    return packed[0], packed[1].view(np.float32)
+
+
+def resolve_query_backend(backend: str, qb: int, block: int) -> str:
+    """Resolve "auto" to "pallas" on TPU when the tile shapes are
+    Mosaic-legal (trailing dims multiples of 128), else "xla" — the
+    same dispatch contract as :func:`pypardis_tpu.ops.labels.
+    resolve_backend`, minus the metric cases (queries are Euclidean
+    squared-distance by definition)."""
+    if backend == "auto":
+        if (
+            jax.default_backend() == "tpu"
+            and qb % 128 == 0
+            and block % 128 == 0
+        ):
+            return "pallas"
+        return "xla"
+    if backend not in ("xla", "pallas"):
+        raise ValueError(
+            f"backend must be auto|xla|pallas, got {backend!r}"
+        )
+    return backend

@@ -146,6 +146,13 @@ def device_peek(route: str, key) -> bool:
     return entry is not None and entry[0] == key
 
 
+def route_nbytes(route: str) -> int:
+    """Bytes currently device-resident for ``route`` (0 when empty) —
+    telemetry for long-lived residents like the serving index."""
+    entry = _device_cache.get(route)
+    return 0 if entry is None else int(entry[3])
+
+
 def device_evict(route: str) -> None:
     """Drop one route's cached entry (restage paths: a transient
     device fault can delete cached buffers out from under the cache —

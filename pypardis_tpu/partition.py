@@ -213,8 +213,23 @@ def route_tree(tree, points: np.ndarray) -> np.ndarray:
     label; points with coordinate >= boundary go right (strict ``<``
     stays left, matching the reference's split semantics,
     partition.py:27-30).
+
+    Inputs are validated against the tree: an array too narrow for the
+    recorded split axes, or one carrying NaN/inf coordinates (a NaN
+    fails every ``>=`` and silently slides down the left spine), raises
+    ValueError instead of routing garbage.
     """
-    points = np.asarray(points, dtype=np.float64)
+    from .utils.validate import check_query_points
+
+    tree = list(tree)
+    points = check_query_points(points).astype(np.float64, copy=False)
+    if tree:
+        need = max(int(a) for _p, a, _b, _l, _r in tree) + 1
+        if points.shape[1] < need:
+            raise ValueError(
+                f"points have {points.shape[1]} dims but the split tree "
+                f"routes on axis {need - 1}"
+            )
     labels = np.zeros(len(points), dtype=np.int32)
     for parent, axis, boundary, _left, right in tree:
         mask = labels == int(parent)
@@ -621,5 +636,12 @@ class KDPartitioner:
         return np.array([len(self.partitions[l]) for l in labels])
 
     def route(self, points: np.ndarray) -> np.ndarray:
-        """Assign new points to partitions by replaying the split tree."""
+        """Assign new points to partitions by replaying the split tree.
+
+        Validates dimensionality against the fitted ``k`` and rejects
+        non-finite coordinates (see :func:`route_tree`).
+        """
+        from .utils.validate import check_query_points
+
+        check_query_points(points, self.k)
         return route_tree(self.tree, points)

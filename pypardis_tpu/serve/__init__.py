@@ -1,0 +1,32 @@
+"""Serving subsystem: device-resident core-point index + query engine.
+
+The reference stops at ``assignments()`` — a dump of training-set
+labels (its dbscan.py:128-134).  This package answers *out-of-sample*
+queries ("which cluster does this new point belong to?") at high QPS:
+
+* :class:`CorePointIndex` (:mod:`.index`) — core points + labels of a
+  fitted model, bucketed by KD leaf, Morton-sorted, padded to block
+  shape, and parked on device through the staging economy
+  (:mod:`pypardis_tpu.parallel.staging`, route ``serve_index``) so
+  repeated engine builds and refits over the same clustering re-ship
+  nothing;
+* the query kernels (:mod:`pypardis_tpu.ops.query` and the Pallas twin
+  in :mod:`pypardis_tpu.ops.pallas_kernels`) — tiled min-squared-
+  distance-within-eps scans of each query tile against its leaf's core
+  slab, exact against the numpy brute-force oracle by construction;
+* :class:`QueryEngine` (:mod:`.engine`) — ``predict`` plus a bounded
+  submit/drain queue that coalesces small requests into padded device
+  batches and double-buffers host routing against device execution,
+  reporting QPS / batch-fill / latency percentiles through the obs
+  registry into ``report()["serving"]``.
+
+Surface via the model: ``DBSCAN.predict(X)`` / ``DBSCAN.query_engine()``;
+persistence via :func:`pypardis_tpu.checkpoint.save_index` /
+``load_index`` (and ``save_model`` checkpoints carry the core points, so
+a restarted process serves without re-clustering).
+"""
+
+from .engine import QueryEngine
+from .index import CorePointIndex, build_index
+
+__all__ = ["CorePointIndex", "QueryEngine", "build_index"]

@@ -1,0 +1,274 @@
+"""Micro-batching query engine over a :class:`CorePointIndex`.
+
+``predict(X)`` is the sync path; ``submit(X)`` / ``drain()`` is the
+serving path: a bounded queue coalesces small requests into padded
+device batches, and the drain loop double-buffers — while the device
+executes batch *i*, the host routes and assembles batch *i+1* (the same
+discipline as the fit pipeline's ``_chained_tables_overlap``).  The
+rotation barrier is the result fetch: a batch's pooled host staging
+buffer goes back to the pool only after its packed result has
+materialized on host, so an in-flight transfer can never alias a reused
+buffer.
+
+Telemetry rides the obs registry (gauges ``serving.*``): QPS over
+engine-busy wall time, batch-fill ratio (real routed rows / padded
+device rows), and p50/p99 request latency — surfaced as the
+``serving`` block of ``DBSCAN.report()`` and validated by
+``scripts/check_bench_json.py``.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..ops.query import _INT_INF, unpack_query_result
+from .index import CorePointIndex, build_index
+
+
+class QueryTicket:
+    """One submitted request; resolved by the next ``drain()``."""
+
+    __slots__ = ("n", "labels", "d2", "_t_submit", "latency_ms", "_q")
+
+    def __init__(self, n: int, q: np.ndarray):
+        self.n = int(n)
+        self.labels: Optional[np.ndarray] = None
+        self.d2: Optional[np.ndarray] = None
+        self.latency_ms: Optional[float] = None
+        self._t_submit = time.perf_counter()
+        self._q = q
+
+    @property
+    def done(self) -> bool:
+        return self.labels is not None
+
+    def result(self, return_distance: bool = False):
+        if self.labels is None:
+            raise RuntimeError(
+                "ticket not resolved yet; call QueryEngine.drain() first"
+            )
+        if return_distance:
+            return self.labels, np.sqrt(self.d2)
+        return self.labels
+
+
+class _Inflight:
+    __slots__ = ("packed", "rowmap", "qbuf", "tickets", "n_rows", "fill")
+
+    def __init__(self, packed, rowmap, qbuf, tickets, n_rows, fill):
+        self.packed = packed
+        self.rowmap = rowmap
+        self.qbuf = qbuf
+        self.tickets = tickets
+        self.n_rows = n_rows
+        self.fill = fill
+
+
+class QueryEngine:
+    """Batched out-of-sample cluster assignment at serving rates.
+
+    ``backend`` dispatches the query kernel (``auto`` picks Pallas on
+    TPU when the tiles are Mosaic-legal, XLA everywhere else;
+    ``interpret=True`` runs the Pallas kernel through its interpreter —
+    the CI path).  ``batch_capacity`` bounds the rows coalesced into
+    one device batch; ``max_pending`` bounds the queue (``submit``
+    raises when full — backpressure, never silent truncation).
+    """
+
+    def __init__(
+        self,
+        index: CorePointIndex,
+        *,
+        backend: str = "auto",
+        interpret: bool = False,
+        batch_capacity: int = 4096,
+        max_pending: int = 1 << 16,
+    ):
+        from ..obs import RunRecorder
+
+        self.index = index
+        self.backend = backend
+        self.interpret = bool(interpret)
+        self.batch_capacity = int(batch_capacity)
+        self.max_pending = int(max_pending)
+        self.recorder = RunRecorder()
+        self._pending: deque = deque()
+        self._pending_rows = 0
+        self._lat_ms: deque = deque(maxlen=8192)
+        self.queries = 0
+        self.batches = 0
+        self._busy_s = 0.0
+        self._fill_num = 0
+        self._fill_den = 0
+
+    @classmethod
+    def from_model(cls, model, *, leaves=None, block: int = 256,
+                   qblock: int = 128, backend: Optional[str] = None,
+                   **kw) -> "QueryEngine":
+        index = build_index(
+            model, leaves=leaves, block=block, qblock=qblock
+        )
+        if backend is None:
+            backend = getattr(model, "kernel_backend", "auto")
+        return cls(index, backend=backend, **kw)
+
+    # -- request surface --------------------------------------------------
+
+    def submit(self, X) -> QueryTicket:
+        """Enqueue a request (validated immediately; results after the
+        next :meth:`drain`)."""
+        q = self.index.prepare_queries(X)
+        if self._pending_rows + len(q) > self.max_pending:
+            raise RuntimeError(
+                f"query queue full ({self._pending_rows} rows pending, "
+                f"max_pending={self.max_pending}); drain() first"
+            )
+        t = QueryTicket(len(q), q)
+        self._pending.append(t)
+        self._pending_rows += len(q)
+        return t
+
+    def predict(self, X, return_distance: bool = False):
+        """Sync out-of-sample assignment: (N,) int32 labels (noise =
+        -1), plus float32 distances to the assigning core point
+        (+inf for noise) when ``return_distance``."""
+        t = self.submit(X)
+        self.drain()
+        return t.result(return_distance)
+
+    def drain(self) -> int:
+        """Process every pending request; returns the query count.
+
+        Coalesces tickets into ``batch_capacity``-row batches and
+        pipelines them: batch *i+1*'s host routing/assembly overlaps
+        batch *i*'s device execution; finalizing *i* (the result fetch)
+        is the rotation barrier that frees its pooled staging buffer.
+        """
+        if not self._pending:
+            return 0
+        t0 = time.perf_counter()
+        batches = []
+        cur, rows = [], 0
+        while self._pending:
+            t = self._pending.popleft()
+            if cur and rows + t.n > self.batch_capacity:
+                batches.append(cur)
+                cur, rows = [], 0
+            cur.append(t)
+            rows += t.n
+        if cur:
+            batches.append(cur)
+        self._pending_rows = 0
+        inflight = None
+        n_done = 0
+        for group in batches:
+            nxt = self._dispatch(group)
+            if inflight is not None:
+                n_done += self._finalize(inflight)
+            inflight = nxt
+        if inflight is not None:
+            n_done += self._finalize(inflight)
+        self._busy_s += time.perf_counter() - t0
+        self.queries += n_done
+        self.batches += len(batches)
+        self._publish()
+        return n_done
+
+    # -- internals --------------------------------------------------------
+
+    def _dispatch(self, tickets) -> _Inflight:
+        qf32 = (
+            tickets[0]._q if len(tickets) == 1
+            else np.concatenate([t._q for t in tickets])
+        )
+        n_rows = len(qf32)
+        if self.index.n_core == 0 or n_rows == 0:
+            return _Inflight(None, [], None, tickets, n_rows, 1.0)
+        qbuf, qmask, tile_leaf, rowmap = self.index.assemble(qf32)
+        packed = self.index.dispatch(
+            qbuf, qmask, tile_leaf, backend=self.backend,
+            interpret=self.interpret,
+        )
+        fill = sum(len(a) for a in rowmap) / max(qbuf.shape[0]
+                                                 * qbuf.shape[2], 1)
+        return _Inflight(packed, rowmap, qbuf, tickets, n_rows, fill)
+
+    def _finalize(self, fl: _Inflight) -> int:
+        best_d2 = np.full(fl.n_rows, np.inf, np.float32)
+        best_lab = np.full(fl.n_rows, _INT_INF, np.int32)
+        if fl.packed is not None:
+            # The host materialization IS the execution sync — after
+            # it, the batch's input transfer is provably consumed and
+            # the staging buffer may rotate back into the pool.
+            labs, d2 = unpack_query_result(fl.packed, self.index.eps2)
+            for t, arr in enumerate(fl.rowmap):
+                lt, dt = labs[t, :len(arr)], d2[t, :len(arr)]
+                cur_d, cur_l = best_d2[arr], best_lab[arr]
+                take = (dt < cur_d) | ((dt == cur_d) & (lt < cur_l))
+                best_d2[arr] = np.where(take, dt, cur_d)
+                best_lab[arr] = np.where(take, lt, cur_l)
+            from ..parallel import staging
+
+            staging.give_back([fl.qbuf])
+        within = best_d2 <= self.index.eps2
+        labels = np.where(within, best_lab, -1).astype(np.int32)
+        d2 = np.where(within, best_d2, np.float32(np.inf))
+        now = time.perf_counter()
+        s = 0
+        for t in fl.tickets:
+            t.labels = labels[s:s + t.n]
+            t.d2 = d2[s:s + t.n]
+            t.latency_ms = (now - t._t_submit) * 1e3
+            t._q = None
+            self._lat_ms.append(t.latency_ms)
+            s += t.n
+        self._fill_num += int(round(fl.fill * fl.n_rows))
+        self._fill_den += fl.n_rows
+        return fl.n_rows
+
+    def _publish(self) -> None:
+        m = self.recorder.metrics
+        for k, v in self.serving_stats().items():
+            if isinstance(v, (int, float, bool)):
+                m.set(f"serving.{_key(k)}", v)
+
+    # -- telemetry --------------------------------------------------------
+
+    def serving_stats(self) -> Dict:
+        """Finite-by-construction serving gauges (the ``serving`` block
+        of ``DBSCAN.report()``)."""
+        lat = np.asarray(self._lat_ms, np.float64)
+        p50, p99 = (
+            (float(np.percentile(lat, 50)), float(np.percentile(lat, 99)))
+            if len(lat) else (0.0, 0.0)
+        )
+        from ..parallel import staging
+
+        st = self.index.stats
+        return {
+            "queries": int(self.queries),
+            "batches": int(self.batches),
+            "qps": round(self.queries / self._busy_s, 1)
+            if self._busy_s > 0 else 0.0,
+            "p50_ms": round(p50, 3),
+            "p99_ms": round(p99, 3),
+            "batch_fill": round(
+                self._fill_num / self._fill_den, 4
+            ) if self._fill_den else 0.0,
+            "n_core": int(self.index.n_core),
+            "n_leaves": int(st.get("n_leaves", 0)),
+            "index_bytes": int(st.get("index_bytes", 0)),
+            "index_device_bytes": int(staging.route_nbytes("serve_index")),
+            "staged_bytes_reused": int(st.get("staged_bytes_reused", 0)),
+            "backend": str(self.backend),
+        }
+
+
+def _key(k: str) -> str:
+    from ..obs.registry import sanitize_segment
+
+    return sanitize_segment(k)

@@ -1,0 +1,400 @@
+"""The device-resident core-point index behind the query engine.
+
+Built once from a fitted (or checkpoint-loaded) model:
+
+1. extract the core points and their global labels;
+2. build a small KD tree over the (centered, float32) cores and bucket
+   them by leaf via the same split-tree replay that routes training
+   points (:func:`pypardis_tpu.partition.route_tree` semantics);
+3. Morton-sort each bucket (tile-local bounding boxes stay tight, so
+   the query kernel's block pruning works) and pad every bucket to one
+   common block-multiple capacity ``C`` — pad slots carry far-away
+   coordinates and INT32_MAX labels, so no mask enters the kernels;
+4. park the ``(d, L*C)`` coordinate slab, label row, and per-block
+   bounds on device through the staging economy
+   (:mod:`pypardis_tpu.parallel.staging`, route ``serve_index``),
+   content-keyed: a second engine build over the same clustering — or
+   a refit that reproduces the same core set — reuses the device
+   memory and ships nothing (``staged_bytes_reused`` in the stats).
+
+Query routing replays the SAME tree with an eps-widened margin
+(:func:`pypardis_tpu.partition.expanded_members` — the box-expansion
+logic of the fit path): a query within eps of a leaf boundary lands in
+every leaf whose core set could contain its nearest within-eps core, so
+the per-leaf kernel results combine into the exact global answer.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..ops.query import (
+    BIG,
+    PAD_COORD,
+    _INT_INF,
+    brute_force_query,
+    eps2_f32,
+)
+from ..utils import clamp_block, round_up
+from ..utils.validate import check_query_points, validate_params
+
+# Routing margin slack over eps: the leaf-membership test runs in
+# float64 on float32 coordinates, while the within-eps verdict is a
+# float32 sum — 0.1% of slack dwarfs any accumulated ulp gap, and extra
+# slack only ever ADDS candidate leaves (never changes the answer).
+_MARGIN_SLACK = 1.001
+
+
+def _leaf_partition(cores_c: np.ndarray, leaves: int, seed: int):
+    """(tree, {leaf -> core indices}) over the centered float32 cores.
+
+    A fresh deterministic KDPartitioner (not the fit's partition tree):
+    the serving tree must balance the CORE set — the fit tree balances
+    all points and may be absent entirely (single-shard fits,
+    checkpoint-loaded models).  Determinism makes a rebuilt index —
+    same cores, any process — byte-identical, which is what lets
+    checkpoint-restored models serve identical answers.
+    """
+    from ..partition import KDPartitioner
+
+    if leaves <= 1 or len(cores_c) < 2:
+        return [], {0: np.arange(len(cores_c), dtype=np.int64)}
+    part = KDPartitioner(
+        cores_c, max_partitions=int(leaves), split_method="min_var",
+        seed=seed,
+    )
+    return part.tree, part.partitions
+
+
+class CorePointIndex:
+    """Core points of a fitted DBSCAN, laid out for batched queries.
+
+    Construct via :meth:`build` (from core points + labels) or
+    :func:`pypardis_tpu.checkpoint.load_index`.  All host arrays are
+    plain numpy; device residency happens lazily in
+    :meth:`device_arrays` through the staging cache.
+    """
+
+    def __init__(
+        self, *, eps, center, tree, coords, labels, blo, bhi,
+        block: int, qblock: int, n_core: int, stats: Optional[Dict] = None,
+    ):
+        self.eps = float(eps)
+        self.eps2 = eps2_f32(eps)
+        self.center = np.asarray(center, np.float64)
+        self.tree = [
+            (int(p), int(a), float(b), int(l), int(r))
+            for p, a, b, l, r in tree
+        ]
+        self.coords = np.asarray(coords, np.float32)  # (d, L*C)
+        self.labels = np.asarray(labels, np.int32)  # (L*C,)
+        self.blo = np.asarray(blo, np.float32)  # (L*nb, d)
+        self.bhi = np.asarray(bhi, np.float32)
+        self.block = int(block)
+        self.qblock = int(qblock)
+        self.n_core = int(n_core)
+        self.stats: Dict = dict(stats or {})
+        self._margin = self.eps * _MARGIN_SLACK
+        self._dev = None
+
+    # -- construction -----------------------------------------------------
+
+    @classmethod
+    def build(
+        cls, cores, labels, eps, *, leaves: Optional[int] = None,
+        block: int = 256, qblock: int = 128, seed: int = 0,
+        stage: bool = True,
+    ):
+        """Index ``(n_core, d)`` core points with their cluster labels.
+
+        ``leaves``: KD leaf budget (default scales with the core count);
+        ``block``: column tile of the query kernels (clamped to the
+        largest bucket); ``qblock``: query rows per tile.  ``stage``
+        ships the slabs to device immediately so the build's
+        ``staged_bytes_reused``/``staged_bytes`` telemetry is complete.
+        """
+        validate_params(eps, 1)
+        cores = np.asarray(cores)
+        if cores.ndim != 2:
+            raise ValueError(
+                f"core points must be (N, k) 2-D, got shape {cores.shape}"
+            )
+        labels = np.asarray(labels, np.int32)
+        if len(labels) != len(cores):
+            raise ValueError(
+                f"{len(cores)} core points but {len(labels)} labels"
+            )
+        n, d = cores.shape
+        t0 = time.perf_counter()
+        if n == 0:
+            idx = cls(
+                eps=eps, center=np.zeros(d), tree=[],
+                coords=np.full((d, 0), PAD_COORD, np.float32),
+                labels=np.empty(0, np.int32),
+                blo=np.empty((0, d), np.float32),
+                bhi=np.empty((0, d), np.float32),
+                block=int(block), qblock=int(qblock), n_core=0,
+            )
+            idx.stats = {"n_core": 0, "n_leaves": 0, "build_s": 0.0,
+                         "index_bytes": 0, "staged_bytes_reused": 0,
+                         "staged_bytes": 0}
+            return idx
+        # Center in float64 (the fit drivers' discipline: the f32 cast
+        # after a f64 subtract keeps GPS-scale magnitudes accurate) —
+        # the center also recenters every query, so distances are
+        # preserved exactly.
+        center = cores.mean(axis=0, dtype=np.float64)
+        cores_c = np.ascontiguousarray(
+            (cores.astype(np.float64) - center).astype(np.float32)
+        )
+        from ..partition import spatial_order
+
+        if leaves is None:
+            leaves = int(np.clip(n // max(4 * block, 1), 1, 64))
+        tree, parts = _leaf_partition(cores_c, int(leaves), seed)
+        L = len(parts)
+        assert sorted(parts) == list(range(L)), sorted(parts)
+        max_leaf = max(len(v) for v in parts.values())
+        block = clamp_block(int(block), max_leaf, floor=8)
+        C = round_up(max_leaf, block)
+        nb = C // block
+        coords = np.full((d, L * C), PAD_COORD, np.float32)
+        slab_labels = np.full(L * C, _INT_INF, np.int32)
+        for leaf in range(L):
+            idx_l = np.asarray(parts[leaf])
+            idx_l = idx_l[spatial_order(cores_c[idx_l])]
+            s = leaf * C
+            coords[:, s:s + len(idx_l)] = cores_c[idx_l].T
+            slab_labels[s:s + len(idx_l)] = labels[idx_l]
+        # Per-column-block core bounds for the XLA kernel's gap pruning
+        # (empty blocks invert, so they always prune).
+        valid = (slab_labels != _INT_INF).reshape(L * nb, block)
+        c3 = coords.reshape(d, L * nb, block)
+        blo = np.where(valid[None], c3, BIG).min(axis=2).T
+        bhi = np.where(valid[None], c3, -BIG).max(axis=2).T
+        idx = cls(
+            eps=eps, center=center, tree=tree, coords=coords,
+            labels=slab_labels, blo=blo, bhi=bhi, block=block,
+            qblock=int(qblock), n_core=n,
+        )
+        idx.stats = {
+            "n_core": n,
+            "n_leaves": L,
+            "leaf_cap": C,
+            "block": block,
+            "pad_waste": round(L * C / n - 1.0, 6),
+            "index_bytes": int(
+                coords.nbytes + slab_labels.nbytes + blo.nbytes + bhi.nbytes
+            ),
+            "staged_bytes_reused": 0,
+            "staged_bytes": 0,
+        }
+        if stage:
+            from ..parallel import staging
+
+            staging.begin_fit()
+            idx.device_arrays()
+            reused, shipped = staging.fit_stats()
+            idx.stats["staged_bytes_reused"] = int(reused)
+            idx.stats["staged_bytes"] = int(shipped)
+        idx.stats["build_s"] = round(time.perf_counter() - t0, 6)
+        return idx
+
+    # -- geometry ---------------------------------------------------------
+
+    @property
+    def d(self) -> int:
+        return self.coords.shape[0]
+
+    @property
+    def n_leaves(self) -> int:
+        return 0 if self.coords.shape[1] == 0 else (
+            self.coords.shape[1] // self.leaf_cap
+        )
+
+    @property
+    def leaf_cap(self) -> int:
+        if self.n_core == 0:
+            return self.block
+        return int(self.stats.get("leaf_cap", self.coords.shape[1]))
+
+    @property
+    def nb(self) -> int:
+        return self.leaf_cap // self.block
+
+    # -- device residency -------------------------------------------------
+
+    def _content_key(self):
+        from ..parallel import staging
+
+        return (
+            staging.points_fingerprint(self.coords),
+            staging.points_fingerprint(self.labels),
+            self.block,
+        )
+
+    def device_arrays(self):
+        """The staged (coords, labels, blo, bhi) device arrays —
+        content-keyed through the ``serve_index`` staging route, so a
+        rebuilt index over the same clustering reuses device memory."""
+        if self._dev is not None:
+            return self._dev
+        import jax.numpy as jnp
+
+        from ..parallel import staging
+
+        key = self._content_key()
+        cached = staging.device_get("serve_index", key)
+        if cached is not None:
+            arrays, _aux = cached
+        else:
+            arrays = staging.device_put_cached(
+                "serve_index", key,
+                (
+                    jnp.asarray(self.coords),
+                    jnp.asarray(self.labels),
+                    jnp.asarray(self.blo),
+                    jnp.asarray(self.bhi),
+                ),
+            )
+        self._dev = arrays
+        return arrays
+
+    # -- query-side layout ------------------------------------------------
+
+    def prepare_queries(self, X) -> np.ndarray:
+        """Validated, centered float32 queries (the serving dtype both
+        the kernels and the oracle consume)."""
+        X = check_query_points(X, self.d)
+        return (X.astype(np.float64) - self.center).astype(np.float32)
+
+    def route(self, qf32: np.ndarray):
+        """[(leaf, query indices)] in ascending leaf order — each query
+        appears in EVERY leaf whose eps-expanded region contains it
+        (the neighbor-leaf path for boundary-straddling queries)."""
+        n = len(qf32)
+        if not self.tree:
+            return [(0, np.arange(n, dtype=np.int64))] if n else []
+        from ..partition import expanded_members
+
+        members = expanded_members(self.tree, qf32, self._margin)
+        return [
+            (leaf, members[leaf][0])
+            for leaf in sorted(members)
+            if len(members[leaf][0])
+        ]
+
+    def assemble(self, qf32: np.ndarray):
+        """Pack routed queries into padded device tiles.
+
+        Returns ``(qbuf, qmask, tile_leaf, rowmap)``: ``qbuf`` is a
+        pooled ``(nqt, d, qb)`` float32 host buffer (borrowed from the
+        staging host pool — return it via ``staging.give_back`` once
+        the batch's results have materialized, the same rotation
+        barrier the fit pipelines use), ``rowmap[t]`` the query indices
+        tile ``t``'s rows answer for.  The tile count rounds up to a
+        power of two so batch programs are shared across sizes.
+        """
+        from ..parallel import staging
+
+        qb = self.qblock
+        tiles = []
+        for leaf, arr in self.route(qf32):
+            for s in range(0, len(arr), qb):
+                tiles.append((leaf, arr[s:s + qb]))
+        nqt = 1 << (max(len(tiles), 1) - 1).bit_length()
+        qbuf = staging.borrow((nqt, self.d, qb), np.float32)
+        qbuf.fill(PAD_COORD)
+        qmask = np.zeros((nqt, qb), bool)
+        tile_leaf = np.zeros(nqt, np.int32)
+        rowmap = []
+        for t, (leaf, arr) in enumerate(tiles):
+            qbuf[t, :, :len(arr)] = qf32[arr].T
+            qmask[t, :len(arr)] = True
+            tile_leaf[t] = leaf
+            rowmap.append(arr)
+        return qbuf, qmask, tile_leaf, rowmap
+
+    def dispatch(self, qbuf, qmask, tile_leaf, backend: str = "auto",
+                 interpret: bool = False):
+        """Launch the query kernel for one assembled batch (async);
+        returns the packed (2, nqt, qb) int32 device result."""
+        import jax.numpy as jnp
+
+        from ..ops.query import query_min_core, resolve_query_backend
+
+        coords, labels, blo, bhi = self.device_arrays()
+        be = resolve_query_backend(backend, self.qblock, self.block)
+        # The anti-FMA seal's zero rides as a runtime ARGUMENT — a
+        # literal inside the jit would constant-fold and re-admit the
+        # contraction (ops.query.seal_f32).
+        if be == "pallas":
+            from ..ops.pallas_kernels import query_min_core_pallas
+
+            return query_min_core_pallas(
+                jnp.asarray(qbuf), jnp.asarray(tile_leaf), coords, labels,
+                jnp.zeros(1, jnp.int32),
+                block=self.block, nb=self.nb, interpret=interpret,
+            )
+        return query_min_core(
+            jnp.asarray(qbuf), jnp.asarray(qmask), jnp.asarray(tile_leaf),
+            coords, labels, blo, bhi, jnp.float32(self.eps2),
+            jnp.int32(0),
+            block=self.block, nb=self.nb,
+        )
+
+    # -- oracle -----------------------------------------------------------
+
+    def oracle_predict(self, X):
+        """Brute-force numpy reference over the index's own core set:
+        ``(labels, d2)`` — the exactness target for ``predict`` (tests
+        pin bitwise equality of both)."""
+        qf32 = self.prepare_queries(X)
+        sel = self.labels != _INT_INF
+        return brute_force_query(
+            qf32, self.coords[:, sel].T, self.labels[sel], self.eps
+        )
+
+
+def _model_core_set(model):
+    """(core coordinates, core labels) of a fitted model — from the live
+    training data when present, else from the checkpoint-restored core
+    set (``save_model`` persists it precisely so a restarted process
+    can build this index without re-clustering)."""
+    mask = np.asarray(model.core_sample_mask_, bool)
+    labels = np.asarray(model.labels_, np.int32)[mask]
+    stored = getattr(model, "_serve_core_points", None)
+    if stored is not None:
+        cores = np.asarray(stored)
+        if len(cores) != len(labels):
+            raise ValueError(
+                f"checkpoint core set has {len(cores)} points but the "
+                f"core mask marks {len(labels)}"
+            )
+    elif model.data is not None:
+        # Device-resident training data fetches ONCE here (cores only
+        # ride forward) — serving is the explicit opt-in for that.
+        cores = np.asarray(model.data)[mask]
+    else:
+        raise RuntimeError(
+            "serving needs the core-point coordinates: fit()/train() in "
+            "this process, or load a checkpoint that carries core points "
+            "(save_model now persists them)"
+        )
+    return cores, labels
+
+
+def build_index(
+    model, *, leaves=None, block: int = 256, qblock: int = 128,
+    seed: int = 0,
+):
+    """Serving index of a fitted (or checkpoint-loaded) ``DBSCAN``."""
+    model._require_fitted()
+    cores, labels = _model_core_set(model)
+    return CorePointIndex.build(
+        cores, labels, model.eps, leaves=leaves, block=block,
+        qblock=qblock, seed=seed,
+    )

@@ -2,6 +2,8 @@
 checkpointing."""
 
 from .shaping import clamp_block, round_up
-from .validate import validate_params
+from .validate import check_query_points, validate_params
 
-__all__ = ["round_up", "clamp_block", "validate_params"]
+__all__ = [
+    "round_up", "clamp_block", "validate_params", "check_query_points",
+]

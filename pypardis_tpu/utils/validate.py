@@ -15,6 +15,31 @@ from __future__ import annotations
 import numpy as np
 
 
+def check_query_points(points, k=None) -> np.ndarray:
+    """Validate an out-of-sample query array against a fitted tree.
+
+    A wrong-dimensionality array would route through split axes that
+    mean something else entirely, and a NaN coordinate fails every
+    ``>=`` comparison and silently drifts down the left spine of the
+    tree — both came back as garbage labels instead of an error.
+    Returns the array as numpy; raises ValueError otherwise.
+    """
+    points = np.asarray(points)
+    if points.ndim != 2:
+        raise ValueError(
+            f"query points must be a 2-D (N, k) array, got shape "
+            f"{points.shape}"
+        )
+    if k is not None and points.shape[1] != int(k):
+        raise ValueError(
+            f"query dimensionality {points.shape[1]} does not match the "
+            f"fitted tree's k={int(k)}"
+        )
+    if points.dtype.kind in "fc" and not np.isfinite(points).all():
+        raise ValueError("query points contain NaN or infinite coordinates")
+    return points
+
+
 def validate_params(eps, min_samples) -> None:
     """Raise ValueError on an invalid concrete (eps, min_samples).
 

@@ -97,11 +97,39 @@ def main() -> None:
     if "points" not in tel["devices"]:
         fail("telemetry.devices missing per-device point counts")
 
+    # Serving contract (ISSUE 4): serve_probe rows must carry the
+    # ``serving`` block with finite QPS / latency-percentile /
+    # batch-fill gauges; any row that has one is held to the schema.
+    if str(row["metric"]).startswith("serve") and "serving" not in tel:
+        fail("serve row without telemetry.serving block")
+    serving = tel.get("serving")
+    if serving is not None:
+        if not isinstance(serving, dict):
+            fail(f"telemetry.serving is {type(serving).__name__}")
+        for key in ("qps", "p50_ms", "p99_ms", "batch_fill"):
+            number("serving", key)
+        for key in ("queries", "batches", "n_core", "n_leaves"):
+            v = serving.get(key)
+            if not isinstance(v, int) or v < 0:
+                fail(
+                    f"telemetry.serving.{key} is {v!r}, expected a "
+                    f"non-negative int"
+                )
+        if serving["queries"] > 0 and serving["qps"] <= 0:
+            fail("telemetry.serving.qps is 0 with queries > 0")
+
+    serve_note = (
+        f", serving: {serving['queries']}q @ {serving['qps']}q/s "
+        f"p50={serving['p50_ms']}ms p99={serving['p99_ms']}ms "
+        f"fill={serving['batch_fill']}"
+        if serving else ""
+    )
     print(
         f"bench JSON OK: {row['metric']} = {row['value']} {row['unit']} "
         f"(dup_work={tel['sharding']['duplicated_work_factor']}, "
         f"staged_reuse={tel['sharding']['staged_bytes_reused']}, "
-        f"mfu={tel['compute']['mfu']}, events: {tel['events']})"
+        f"mfu={tel['compute']['mfu']}, events: {tel['events']}"
+        f"{serve_note})"
     )
 
 

@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Serving probe: per-batch-size QPS + latency percentiles.
+
+Fits a blobs model once, then drives the query engine
+(``pypardis_tpu.serve``) at several request batch sizes, emitting one
+JSON row per size::
+
+    {"metric": "serve_qps", "value": <qps>, "unit": "queries/sec",
+     "batch_size": B, "p50_ms": ..., "p99_ms": ..., "batch_fill": ...,
+     "oracle_exact": true, "telemetry": {...run_report@1 with
+     "serving" block...}}
+
+Every row's labels are checked against the brute-force core-point
+oracle (exact equality — the serving correctness contract); the last
+row's telemetry is validated by ``scripts/check_bench_json.py`` (the
+``serving`` schema block) under ``make serve-probe`` / ``bench-smoke``.
+
+Env knobs: SERVE_N (fit points, default 4000), SERVE_DIM (default 4),
+SERVE_Q (queries per batch size, default 2048), SERVE_BATCHES (comma
+list of request sizes, default "32,256,1024"), SERVE_BACKEND
+(auto|xla|pallas, default auto).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import numpy as np
+
+    from benchdata import make_blob_data
+    from pypardis_tpu import DBSCAN
+
+    n = int(os.environ.get("SERVE_N", 4000))
+    dim = int(os.environ.get("SERVE_DIM", 4))
+    n_q = int(os.environ.get("SERVE_Q", 2048))
+    sizes = [
+        int(s) for s in os.environ.get(
+            "SERVE_BATCHES", "32,256,1024"
+        ).split(",")
+    ]
+    backend = os.environ.get("SERVE_BACKEND", "auto")
+    eps, min_samples = 2.4 * (dim / 16) ** 0.5, 10
+    X, _truth = make_blob_data(n, dim, n_centers=8, std=0.4)
+
+    model = DBSCAN(eps=eps, min_samples=min_samples, block=512)
+    model.fit(X)
+    rng = np.random.default_rng(1)
+    lo, hi = X.min(axis=0), X.max(axis=0)
+
+    for bs in sizes:
+        # Fresh engine per size so the latency/QPS gauges describe ONE
+        # batch-size regime (the index itself re-stages from the device
+        # cache — the warm path the staging economy exists for).
+        engine = model.query_engine(backend=backend)
+        queries = np.concatenate([
+            X[rng.integers(0, n, size=n_q // 2)]
+            + rng.normal(scale=eps / 2, size=(n_q // 2, dim)),
+            rng.uniform(lo, hi, size=(n_q - n_q // 2, dim)),
+        ]).astype(np.float32)
+        t0 = time.perf_counter()
+        tickets = []
+        for s in range(0, n_q, bs):
+            tickets.append(engine.submit(queries[s:s + bs]))
+            # Drain as the queue fills — the coalescer packs several
+            # submitted requests into each padded device batch.
+            if len(tickets) % 8 == 0:
+                engine.drain()
+        engine.drain()
+        wall = time.perf_counter() - t0
+        got = np.concatenate([t.result() for t in tickets])
+        olabs, _od2 = engine.index.oracle_predict(queries)
+        exact = bool(np.array_equal(got, olabs))
+        stats = engine.serving_stats()
+        row = {
+            "metric": "serve_qps",
+            "value": round(n_q / wall, 1),
+            "unit": "queries/sec",
+            "batch_size": bs,
+            "p50_ms": stats["p50_ms"],
+            "p99_ms": stats["p99_ms"],
+            "batch_fill": stats["batch_fill"],
+            "oracle_exact": exact,
+            "telemetry": model.report(),
+        }
+        print(json.dumps(row), flush=True)
+        if not exact:
+            print(
+                f"serve probe FAILED: batch_size={bs} labels diverge "
+                f"from the brute-force oracle", file=sys.stderr,
+            )
+            sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

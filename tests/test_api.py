@@ -197,3 +197,26 @@ def test_cluster_mapping_vectorized_matches_loop():
         k: set(v) for k, v in ref.rev.items()
     }
     assert agg.next_global_id == ref.next_global_id
+
+
+def test_not_fitted_message_unified():
+    """Every result surface raises the SAME not-fitted message (they
+    used to disagree: "call train() first" vs "call fit()/train()
+    first")."""
+    import pytest
+
+    m = DBSCAN()
+    surfaces = {
+        "assignments": m.assignments,
+        "report": m.report,
+        "summary": m.summary,
+        "export_trace": lambda: m.export_trace("/tmp/x.json"),
+        "predict": lambda: m.predict(np.zeros((1, 2))),
+        "query_engine": m.query_engine,
+    }
+    for name, fn in surfaces.items():
+        with pytest.raises(
+            RuntimeError,
+            match=r"not fitted; call fit\(\)/train\(\) first",
+        ):
+            fn()

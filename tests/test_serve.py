@@ -1,0 +1,266 @@
+"""Serving subsystem (ISSUE 4): device-resident core-point index +
+batched out-of-sample query engine.
+
+The correctness contract is EXACT equality with the brute-force numpy
+core-point oracle (``ops.query.brute_force_query``): nearest core
+point within eps wins, ties go to the smallest label, noise = -1 —
+bitwise on labels AND squared distances, on every backend (the kernels
+replay the oracle's IEEE float32 op sequence; the anti-FMA seal keeps
+compilers from contracting it).
+"""
+
+import numpy as np
+import pytest
+
+from pypardis_tpu import DBSCAN
+from pypardis_tpu.serve import CorePointIndex, QueryEngine, build_index
+
+INT_INF = np.iinfo(np.int32).max
+
+
+def _fit_blobs(n=750, dim=2, eps=0.3, min_samples=10, seed=0):
+    from sklearn.datasets import make_blobs
+    from sklearn.preprocessing import StandardScaler
+
+    centers = np.random.default_rng(seed).uniform(-1, 1, size=(3, dim))
+    X, _ = make_blobs(
+        n_samples=n, centers=centers, cluster_std=0.4, random_state=seed
+    )
+    X = StandardScaler().fit_transform(X)
+    return DBSCAN(eps=eps, min_samples=min_samples).fit(X), X
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    return _fit_blobs()
+
+
+@pytest.fixture(scope="module")
+def queries(fitted):
+    _m, X = fitted
+    rng = np.random.default_rng(3)
+    return np.concatenate([
+        X[:150],
+        X[rng.integers(0, len(X), 200)]
+        + rng.normal(scale=0.3, size=(200, X.shape[1])),
+        rng.uniform(-3, 3, size=(150, X.shape[1])),
+        np.full((4, X.shape[1]), 50.0),  # far from everything: noise
+    ])
+
+
+def _assert_oracle_exact(engine, Q):
+    t = engine.submit(Q)
+    engine.drain()
+    olabs, od2 = engine.index.oracle_predict(Q)
+    np.testing.assert_array_equal(t.labels, olabs)
+    np.testing.assert_array_equal(t.d2, od2)
+    return t
+
+
+def test_predict_matches_oracle_exactly(fitted, queries):
+    m, _X = fitted
+    engine = m.query_engine(leaves=8, block=32, qblock=32)
+    t = _assert_oracle_exact(engine, queries)
+    # the far queries are noise with infinite distance
+    assert (t.labels[-4:] == -1).all()
+    assert np.isinf(t.d2[-4:]).all()
+    # predict() and the ticket agree; distances are sqrt(d2)
+    labs, dist = engine.predict(queries, return_distance=True)
+    np.testing.assert_array_equal(labs, t.labels)
+    np.testing.assert_array_equal(dist, np.sqrt(t.d2))
+
+
+def test_core_training_points_keep_their_label(fitted):
+    m, X = fitted
+    core = np.asarray(m.core_sample_mask_, bool)
+    labs = m.predict(X[core])
+    np.testing.assert_array_equal(labs, m.labels_[core])
+
+
+def test_leaf_count_invariance(fitted, queries):
+    """The KD bucketing is an execution detail: 1 leaf and 8 leaves
+    (with the neighbor-leaf routing engaged) answer identically."""
+    m, _X = fitted
+    l1 = m.query_engine(leaves=1, block=32, qblock=32).predict(queries)
+    l8 = m.query_engine(leaves=8, block=32, qblock=32).predict(queries)
+    np.testing.assert_array_equal(l1, l8)
+
+
+def test_boundary_straddling_queries(fitted):
+    """Queries sitting within eps of KD leaf boundaries route to every
+    candidate leaf and still match the oracle exactly."""
+    m, X = fitted
+    engine = m.query_engine(leaves=8, block=32, qblock=32)
+    index = engine.index
+    assert index.tree, "expected a multi-leaf index"
+    rng = np.random.default_rng(7)
+    qs = []
+    for _parent, axis, boundary, _l, _r in index.tree:
+        for _ in range(8):
+            q = rng.uniform(-2, 2, size=index.d)
+            q[axis] = boundary + rng.uniform(-0.9, 0.9) * index.eps
+            qs.append(q)
+    Q = np.asarray(qs) + index.center  # prepare_queries re-centers
+    routed = engine.index.route(index.prepare_queries(Q))
+    n_rows = sum(len(arr) for _leaf, arr in routed)
+    assert n_rows > len(Q), "no query straddled a leaf boundary"
+    _assert_oracle_exact(engine, Q)
+
+
+def test_backend_parity(fitted, queries):
+    """XLA and Pallas (interpreter) kernels answer bit-identically."""
+    m, _X = fitted
+    xla = m.query_engine(leaves=4, block=32, qblock=32, backend="xla")
+    t_x = _assert_oracle_exact(xla, queries)
+    pl_eng = QueryEngine(xla.index, backend="pallas", interpret=True)
+    t_p = _assert_oracle_exact(pl_eng, queries)
+    np.testing.assert_array_equal(t_x.labels, t_p.labels)
+    np.testing.assert_array_equal(t_x.d2, t_p.d2)
+
+
+def test_checkpoint_roundtrip_serves_identically(tmp_path, fitted,
+                                                 queries):
+    """save_model -> load_model in a "fresh process" (no training data)
+    -> predict() byte-identical to the original model's."""
+    m, _X = fitted
+    want, want_d = m.query_engine(
+        leaves=8, block=32, qblock=32
+    ).predict(queries, return_distance=True)
+    path = str(tmp_path / "model.npz")
+    m.save(path)
+    m2 = DBSCAN.load(path)
+    assert m2.data is None  # serves WITHOUT the dataset
+    got, got_d = m2.query_engine(
+        leaves=8, block=32, qblock=32
+    ).predict(queries, return_distance=True)
+    np.testing.assert_array_equal(want, got)
+    np.testing.assert_array_equal(want_d, got_d)
+
+
+def test_index_checkpoint_roundtrip(tmp_path, fitted, queries):
+    from pypardis_tpu import load_index, save_index
+
+    m, _X = fitted
+    idx = build_index(m, leaves=4, block=32, qblock=32)
+    engine = QueryEngine(idx, backend="xla")
+    want = engine.predict(queries)
+    path = str(tmp_path / "index.npz")
+    save_index(idx, path)
+    idx2 = load_index(path)
+    np.testing.assert_array_equal(idx.coords, idx2.coords)
+    np.testing.assert_array_equal(idx.labels, idx2.labels)
+    got = QueryEngine(idx2, backend="xla").predict(queries)
+    np.testing.assert_array_equal(want, got)
+
+
+def test_warm_second_index_build_reuses_device_slabs(fitted):
+    """Acceptance: a warm second index build reports
+    staged_bytes_reused > 0 (the serve_index staging route)."""
+    from pypardis_tpu.parallel import staging
+
+    m, _X = fitted
+    staging.device_evict("serve_index")  # cold start, deterministically
+    first = build_index(m, leaves=4, block=32)
+    second = build_index(m, leaves=4, block=32)
+    assert first.stats["staged_bytes"] > 0
+    assert second.stats["staged_bytes_reused"] > 0
+    assert (
+        second.stats["staged_bytes_reused"] == first.stats["staged_bytes"]
+    )
+
+
+def test_engine_queue_coalesces_and_reports(fitted, queries):
+    m, _X = fitted
+    engine = QueryEngine(
+        build_index(m, leaves=4, block=32, qblock=32),
+        backend="xla", batch_capacity=128,
+    )
+    tickets = [engine.submit(queries[s:s + 32])
+               for s in range(0, 320, 32)]
+    assert not tickets[0].done
+    n = engine.drain()
+    assert n == 320
+    olabs, _ = engine.index.oracle_predict(queries[:320])
+    got = np.concatenate([t.result() for t in tickets])
+    np.testing.assert_array_equal(got, olabs)
+    stats = engine.serving_stats()
+    assert stats["queries"] == 320
+    assert stats["batches"] >= 3  # 320 rows through a 128-row coalescer
+    for key in ("qps", "p50_ms", "p99_ms", "batch_fill"):
+        assert np.isfinite(stats[key]) and stats[key] > 0, (key, stats)
+    assert stats["batch_fill"] <= 1.0
+
+
+def test_engine_queue_is_bounded(fitted):
+    m, _X = fitted
+    engine = QueryEngine(
+        build_index(m, leaves=2, block=32), max_pending=64
+    )
+    engine.submit(np.zeros((40, 2)))
+    with pytest.raises(RuntimeError, match="queue full"):
+        engine.submit(np.zeros((40, 2)))
+    engine.drain()  # drains the accepted request; queue reopens
+    engine.submit(np.zeros((40, 2)))
+
+
+def test_report_carries_serving_block(fitted, queries):
+    m, _X = fitted
+    m.query_engine(leaves=4, block=32).predict(queries[:64])
+    rep = m.report()
+    srv = rep["serving"]
+    assert srv["queries"] >= 64
+    for key in ("qps", "p50_ms", "p99_ms", "batch_fill"):
+        v = srv[key]
+        assert isinstance(v, (int, float)) and np.isfinite(v), (key, v)
+    assert "serving:" in m.summary()
+
+
+def test_all_noise_model_serves_noise():
+    rng = np.random.default_rng(5)
+    X = rng.uniform(-1, 1, size=(64, 3))
+    m = DBSCAN(eps=1e-6, min_samples=5).fit(X)
+    assert not m.core_sample_mask_.any()
+    labs, dist = m.query_engine().predict(X, return_distance=True)
+    assert (labs == -1).all() and np.isinf(dist).all()
+
+
+def test_query_validation(fitted):
+    m, _X = fitted
+    engine = m.query_engine(leaves=4, block=32)
+    with pytest.raises(ValueError, match="dimensionality"):
+        engine.predict(np.zeros((4, 5)))
+    with pytest.raises(ValueError, match="2-D"):
+        engine.predict(np.zeros(4))
+    bad = np.zeros((4, 2))
+    bad[1, 0] = np.nan
+    with pytest.raises(ValueError, match="NaN or infinite"):
+        engine.predict(bad)
+
+
+def test_oracle_property_randomized():
+    """Hypothesis-style seeded sweep: random geometry, dtype, backend,
+    leaf count — predict() equals the brute-force oracle exactly,
+    including boundary-straddling queries."""
+    for seed in range(4):
+        rng = np.random.default_rng(seed)
+        dim = int(rng.integers(2, 6))
+        n = int(rng.integers(300, 600))
+        dtype = np.float32 if seed % 2 else np.float64
+        m, X = _fit_blobs(
+            n=n, dim=dim, eps=0.4 * np.sqrt(dim), min_samples=5,
+            seed=seed,
+        )
+        X = X.astype(dtype)
+        if not m.core_sample_mask_.any():
+            continue
+        leaves = int(rng.integers(1, 9))
+        idx = build_index(m, leaves=leaves, block=16, qblock=16)
+        Q = np.concatenate([
+            X[rng.integers(0, n, 100)],
+            X[rng.integers(0, n, 100)]
+            + rng.normal(scale=m.eps, size=(100, dim)).astype(dtype),
+            rng.uniform(-4, 4, size=(50, dim)).astype(dtype),
+        ])
+        for backend, interp in (("xla", False), ("pallas", True)):
+            engine = QueryEngine(idx, backend=backend, interpret=interp)
+            _assert_oracle_exact(engine, Q)

@@ -67,3 +67,50 @@ def test_dbscan_fixed_size_rejects_bad_params():
 def test_valid_fit_still_works(X):
     labels = DBSCAN(eps=0.5, min_samples=3).fit_predict(X)
     assert labels.shape == (len(X),)
+
+
+# -- serve/route query validation (ISSUE 4 satellite) -------------------
+
+
+def test_route_rejects_wrong_dimensionality(X):
+    part = __import__("pypardis_tpu").KDPartitioner(X, max_partitions=4)
+    with pytest.raises(ValueError, match="dimensionality"):
+        part.route(np.zeros((5, X.shape[1] + 2)))
+    with pytest.raises(ValueError, match="2-D"):
+        part.route(np.zeros(3))
+
+
+def test_route_rejects_nonfinite(X):
+    part = __import__("pypardis_tpu").KDPartitioner(X, max_partitions=4)
+    bad = X.copy()
+    bad[3, 1] = np.nan
+    with pytest.raises(ValueError, match="NaN or infinite"):
+        part.route(bad)
+
+
+def test_route_tree_rejects_too_narrow_points(X):
+    """Regression: a wrong-d array used to route through split axes
+    that mean something else (or crash on an out-of-range axis)."""
+    from pypardis_tpu.partition import KDPartitioner, route_tree
+
+    part = KDPartitioner(X, max_partitions=4)
+    if not part.tree:
+        pytest.skip("degenerate tree")
+    need = max(a for _p, a, _b, _l, _r in part.tree) + 1
+    if need < 2:
+        pytest.skip("tree routes on axis 0 only")
+    with pytest.raises(ValueError, match="split tree"):
+        route_tree(part.tree, np.zeros((5, need - 1)))
+
+
+def test_loaded_partition_tree_route_validates(tmp_path, X):
+    from pypardis_tpu import KDPartitioner, load_partitioner, \
+        save_partitioner
+
+    part = KDPartitioner(X, max_partitions=4)
+    path = str(tmp_path / "tree.npz")
+    save_partitioner(part, path)
+    tree = load_partitioner(path)
+    np.testing.assert_array_equal(tree.route(X), part.route(X))
+    with pytest.raises(ValueError, match="dimensionality"):
+        tree.route(np.zeros((2, X.shape[1] + 1)))
