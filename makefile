@@ -4,7 +4,7 @@
 PY ?= python
 
 .PHONY: all wheel native test verify tpu-smoke bench bench-smoke \
-	partition-probe serve-probe demo clean
+	partition-probe serve-probe global-morton-probe demo clean
 
 all: native test
 
@@ -41,9 +41,21 @@ bench:
 # metric/value/unit triple plus the run_report@1 telemetry block),
 # then the CI-sized partitioner depth-scaling probe (fails when the
 # level builder's mp-doubling cost ratio exceeds 1.5x).
-bench-smoke: partition-probe serve-probe
+bench-smoke: partition-probe serve-probe global-morton-probe
 	JAX_PLATFORMS=cpu BENCH_N=2000 BENCH_DIM=4 BENCH_REPS=1 \
 	BENCH_DEV_REPS=1 $(PY) bench.py | $(PY) scripts/check_bench_json.py
+
+# Zero-duplication global-Morton mode probe (ISSUE 5): runs the same
+# geometry through the owner-computes KD mode and mode="global_morton"
+# (labels must byte-match; manifold row pins ARI vs the fused engine),
+# then schema-checks the emitted row — a silent fallback to the KD halo
+# path (halo_exchange != morton_ring, dup factor != 1.0, or boundary
+# bytes >= legacy halo bytes) fails CI.  Acceptance-scale run:
+# `GM_N=200000 make global-morton-probe`.
+global-morton-probe:
+	GM_N=$${GM_N:-20000} GM_DIM=$${GM_DIM:-16} \
+	$(PY) scripts/global_morton_probe.py \
+	| $(PY) scripts/check_bench_json.py
 
 # Serving probe: per-batch-size QPS + p50/p99 rows from the query
 # engine, each checked against the brute-force core-point oracle; the

@@ -479,7 +479,12 @@ class DBSCAN:
         profile_dir: Optional[str] = None,
         owner_computes: bool = True,
         overlap: Optional[bool] = None,
+        mode: str = "auto",
     ):
+        if mode not in ("auto", "kd", "global_morton"):
+            raise ValueError(
+                f"mode must be auto|kd|global_morton, got {mode!r}"
+            )
         self.eps = float(eps)
         self.min_samples = int(min_samples)
         self.metric = metric
@@ -500,6 +505,11 @@ class DBSCAN:
         # overlapped with device compute); None defers to the
         # PYPARDIS_CHAINED_OVERLAP env switch (default on).
         self.overlap = overlap
+        # Distributed execution mode: "auto"/"kd" run the KD-partition
+        # + 2*eps-halo family; "global_morton" shards by contiguous
+        # ranges of the global Morton order — zero duplicated rows,
+        # boundary TILES ride the exchange ring (parallel.global_morton).
+        self.mode = mode
         # Reference attribute surface (dbscan.py:93-102).
         self.data = None
         self._result_cache = None
@@ -750,6 +760,7 @@ class DBSCAN:
                 "merge": self.merge,
                 "owner_computes": self.owner_computes,
                 "overlap": self.overlap,
+                "mode": self.mode,
             },
             n_points=len(self.labels_),
             n_dims=self._fit_info.get("n_dims", 0),
@@ -830,6 +841,22 @@ class DBSCAN:
                        timer) -> None:
         from .parallel.sharded import sharded_dbscan
 
+        if self.mode == "global_morton":
+            if _is_device_array(points):
+                raise ValueError(
+                    "mode='global_morton' needs host-resident input: "
+                    "the global Morton keying runs on the host "
+                    "(device-resident inputs take the KD ring route)"
+                )
+            if isinstance(points, np.memmap):
+                raise ValueError(
+                    "mode='global_morton' does not stream memmaps: the "
+                    "global Morton keying materializes one f32 copy of "
+                    "the dataset; use the default KD ring route for "
+                    "disk-backed inputs"
+                )
+            self._train_sharded_global_morton(points, timer)
+            return
         if _is_device_array(points):
             # Device-resident input never round-trips the coordinates
             # through the host (the analogue of train(rdd) on
@@ -983,6 +1010,66 @@ class DBSCAN:
         self.neighbors = None
         self._neighbors_lazy = True
         self.cluster_dict = _partition_cluster_dict(pid_np, self.labels_)
+
+    def _train_sharded_global_morton(self, points: np.ndarray,
+                                     timer) -> None:
+        """Zero-duplication global-Morton sharded fit.
+
+        Shards are contiguous ranges of the global Morton order
+        (:mod:`pypardis_tpu.parallel.global_morton`) — there is no KD
+        partition phase; the Morton keying happens inside the cluster
+        phase's build span.  The parity surface maps ranges onto the
+        usual attributes: ``partitioner_`` is a
+        :class:`~pypardis_tpu.partition.MortonRangePartitioner` (no
+        split tree), ``bounding_boxes`` the per-range extents, and
+        ``neighbors`` each shard's OWNED rows — zero duplication means
+        there is no expanded-membership surface in this mode.
+        """
+        from .parallel.global_morton import global_morton_dbscan
+        from .partition import MortonRangePartitioner
+
+        with timer.phase("cluster"):
+            labels, core, stats = global_morton_dbscan(
+                points,
+                eps=self.eps,
+                min_samples=self.min_samples,
+                metric=self.metric,
+                block=self.block,
+                mesh=self.mesh,
+                precision=self.precision,
+                backend=self.kernel_backend,
+                merge=self.merge,
+            )
+        parity = stats.pop("parity", None)
+        with timer.phase("densify"):
+            self.labels_ = densify_labels(labels)
+        self.core_sample_mask_ = core
+        self.metrics_.update(stats)
+        self.metrics_["partition_builder"] = "morton_range"
+        self.metrics_["partition_levels_s"] = []
+        if parity is not None:
+            order = np.asarray(parity["order"])
+            starts = np.asarray(parity["starts"], dtype=np.int64)
+            lo = np.asarray(parity["box_lo"])
+            hi = np.asarray(parity["box_hi"])
+            boxes = {
+                s: BoundingBox(lower=lo[s], upper=hi[s])
+                for s in range(len(starts) - 1)
+                if starts[s + 1] > starts[s]
+            }
+            part = MortonRangePartitioner(order, starts, boxes)
+            self.partitioner_ = part
+            self.metrics_["n_partitions"] = part.n_partitions
+            self.bounding_boxes = boxes
+            self.expanded_boxes = {
+                l: b.expand(2 * self.eps) for l, b in boxes.items()
+            }
+            self.neighbors = {
+                s: part.partitions[s] for s in part.partitions
+            }
+            self.cluster_dict = _partition_cluster_dict(
+                part.result, self.labels_
+            )
 
     def save(self, path: str) -> None:
         """Checkpoint the trained model (labels, boxes, hyperparams)."""

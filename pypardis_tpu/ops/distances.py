@@ -221,6 +221,53 @@ def count_live_tile_pairs(
     return total
 
 
+def cross_tile_live(
+    lo_r: jnp.ndarray,
+    hi_r: jnp.ndarray,
+    lo_c: jnp.ndarray,
+    hi_c: jnp.ndarray,
+    eps,
+    metric: str = "euclidean",
+) -> jnp.ndarray:
+    """(nt_r,) bool: row tile i's box lies within eps of ANY column box.
+
+    The boundary-tile selector of the global-Morton distributed mode
+    (:mod:`pypardis_tpu.parallel.global_morton`): row boxes are one
+    shard's kernel tiles, column boxes another shard's (or every other
+    shard's, all-gathered).  A column tile whose box clears eps of every
+    row box cannot contain an eps-neighbor of any row point (the same
+    box-gap bound :func:`tile_skip_mask` uses), so the row shard never
+    needs it — this predicate is what keeps the ring exchange at tile
+    granularity instead of whole halo slabs.  Inverted (+BIG, -BIG)
+    boxes — empty tiles, padding, the caller's own tiles — are never
+    live.  Chunked like :func:`count_live_tile_pairs` so the
+    (chunk, nc, d) gap tensor stays ~256MB at any tile count.
+    """
+    metric = _norm_metric(metric)
+    nt, d = lo_r.shape
+    nc = lo_c.shape[0]
+    chunk = max(1, min(nt, -(-(1 << 26) // max(nc * d, 1))))
+    nch = -(-nt // chunk)
+    lo_p, hi_p = _pad_boxes(lo_r, hi_r, nch * chunk)
+
+    def body(carry, c):
+        s = c * chunk
+        rlo = jax.lax.dynamic_slice_in_dim(lo_p, s, chunk)
+        rhi = jax.lax.dynamic_slice_in_dim(hi_p, s, chunk)
+        gap = jnp.maximum(
+            0.0,
+            jnp.maximum(lo_c[None] - rhi[:, None], rlo[:, None] - hi_c[None]),
+        )
+        if metric == "euclidean":
+            live = jnp.sum(gap * gap, axis=-1) <= jnp.float32(eps) ** 2
+        else:
+            live = jnp.sum(gap, axis=-1) <= eps
+        return carry, jnp.any(live, axis=1)
+
+    _, liv = jax.lax.scan(body, jnp.int32(0), jnp.arange(nch))
+    return liv.reshape(-1)[:nt]
+
+
 def default_pair_budget(nt: int) -> int:
     """Default live-pair capacity: 48 pairs per row tile.
 

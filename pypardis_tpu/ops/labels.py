@@ -71,6 +71,40 @@ def resolve_backend(
     return backend
 
 
+def gm_backend(
+    backend: str, metric: str, n_total: int, owned: int, block: int,
+    d: int, precision: str,
+) -> str:
+    """Backend routing for the global-Morton cross-shard boundary scan.
+
+    The global-Morton cluster step runs the owner-computes kernels over
+    an ``owned + boundary`` slab whose split point is the shard's row
+    range — on the Pallas path that split must land on a tile boundary
+    (:func:`pypardis_tpu.ops.pallas_kernels.gm_tile_aligned`).  When it
+    cannot, ``"auto"`` routes to the XLA kernels EXPLICITLY (they have
+    no alignment constraint and identical semantics) and an explicit
+    ``backend='pallas'`` fails loudly up front rather than surfacing a
+    Mosaic lowering error from inside the exchange-fed program.
+    """
+    kind = resolve_backend(backend, metric, n_total, block, d, precision)
+    if kind != "pallas":
+        return backend
+    from .pallas_kernels import _norm_precision_mode, gm_tile_aligned
+
+    if gm_tile_aligned(
+        block, n_total, owned, d, _norm_precision_mode(precision)
+    ):
+        return backend
+    if backend == "pallas":
+        raise ValueError(
+            f"backend='pallas' cannot tile the global-Morton slab: the "
+            f"effective tile does not divide the owned prefix "
+            f"(owned={owned}, total={n_total}, block={block}); use "
+            f"backend='auto' or 'xla'"
+        )
+    return "xla"
+
+
 def is_kernel_lowering_error(exc: BaseException) -> bool:
     """True when ``exc`` plausibly comes from a Pallas kernel failing to
     lower or compile (Mosaic rejection, VMEM overflow, unsupported op).

@@ -328,6 +328,25 @@ def _check_mosaic_tile(block: int, n: int, interpret: bool) -> None:
         )
 
 
+def gm_tile_aligned(block: int, n_total: int, owned: int, d: int,
+                    mode: str = "high") -> bool:
+    """Whether the Pallas kernels can run a global-Morton owned+boundary
+    slab of ``n_total`` rows whose first ``owned`` are the shard's own
+    range.
+
+    The owner-computes pair-list filters split the tile-pair list at
+    ``owned // tile`` (``ops.labels._oc_sorted_pairs``), so the
+    effective tile must divide BOTH the total capacity and the owned
+    prefix — a boundary buffer whose offset lands mid-tile would mix
+    owned and cross-shard rows inside one Mosaic tile and corrupt the
+    row/column split.  Callers route misaligned configs to the XLA
+    kernels explicitly (:func:`pypardis_tpu.ops.labels.gm_backend`)
+    instead of paying a lowering-failure/fallback cycle on hardware.
+    """
+    b = effective_tile(block, n_total, d, mode)
+    return b is not None and owned % b == 0
+
+
 def effective_tile(block: int, n: int, d: int, mode: str = "high"):
     """The tile the Pallas kernels would actually run, or ``None`` when
     no Mosaic-legal tile exists for this (block, n).

@@ -14,4 +14,18 @@ collectives.  One jit, no host round-trips.
 from .mesh import default_mesh
 from .sharded import sharded_dbscan, sharded_dbscan_device
 
-__all__ = ["default_mesh", "sharded_dbscan", "sharded_dbscan_device"]
+
+def global_morton_dbscan(*args, **kwargs):
+    """Lazy re-export of the zero-duplication global-Morton engine
+    (:func:`pypardis_tpu.parallel.global_morton.global_morton_dbscan`)."""
+    from .global_morton import global_morton_dbscan as _gm
+
+    return _gm(*args, **kwargs)
+
+
+__all__ = [
+    "default_mesh",
+    "global_morton_dbscan",
+    "sharded_dbscan",
+    "sharded_dbscan_device",
+]

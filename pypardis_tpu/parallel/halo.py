@@ -140,3 +140,135 @@ def ring_halo_exchange_multi(
     state = jax.lax.fori_loop(0, n_dev - 1, step, state)
     _, _, _, halo, hmask, hgid, overflow = state
     return halo, hmask, hgid, overflow
+
+
+# ---------------------------------------------------------------------------
+# Tile-granular boundary exchange (global-Morton mode).
+#
+# The point-granular ring above circulates each device's WHOLE owned
+# slab and filters points against 2*eps-expanded KD boxes — correct, but
+# the interconnect carries every coordinate P-1 times.  The global-
+# Morton mode needs far less: shards are contiguous ranges of one global
+# Morton order, so only the kernel TILES whose bounding box lies within
+# eps of some other shard's tiles are ever needed elsewhere.  These
+# primitives ship exactly those tiles: a send-side selection against
+# all-gathered tile boxes (boxes are (nt, d) metadata — tiny), then a
+# ring of ppermute steps over the compacted boundary-tile buffers only.
+# ---------------------------------------------------------------------------
+
+_INT32_MAX = jnp.int32(2**31 - 1)
+_BOX_BIG = jnp.float32(3e38)
+
+
+def _keep_tiles(cat_val, cap_tiles):
+    """Stable tile compaction order: valid tiles first, keep the first
+    ``cap_tiles``.  Returns ``(order, kept_valid, dropped)``."""
+    order = jnp.argsort(~cat_val, stable=True)[:cap_tiles]
+    kept = cat_val[order]
+    dropped = jnp.sum(cat_val.astype(jnp.int32)) - jnp.sum(
+        kept.astype(jnp.int32)
+    )
+    return order, kept, dropped
+
+
+def boundary_send_select(owned, mask, gid, eps, *, gtile, btcap, axis):
+    """Per-device body: select and compact MY boundary tiles.
+
+    Must run inside ``shard_map``.  ``owned``: (cap, k) this shard's
+    Morton-range rows; ``mask``/``gid``: (cap,) validity / global ids.
+    Computes per-tile bounding boxes (tiles of ``gtile`` rows — the
+    EXCHANGE granularity, typically a quarter of the kernel block:
+    accepting a tile for one reachable row pulls all its rows, so
+    finer exchange tiles cut the shipped boundary volume several-fold
+    while the kernel keeps its own MXU-sized tiling over the packed
+    slab), all-gathers the boxes across the mesh (metadata only, never
+    coordinates), and keeps the tiles whose box lies within eps of ANY
+    other device's tile box — the only tiles any other shard can need,
+    by the box-gap bound.
+
+    Returns ``(send_pts (btcap, gtile, k), send_msk, send_gid, send_lo,
+    send_hi, n_send, overflow, my_lo, my_hi)``.  Invalid send slots
+    carry inverted boxes (never accepted downstream), masked rows, and
+    INT32_MAX gids.  ``overflow`` counts boundary tiles dropped for
+    ``btcap`` — the driver's doubling ladder treats nonzero as a retry.
+    """
+    from ..ops.distances import cross_tile_live, tile_bounds
+
+    cap, k = owned.shape
+    nt = cap // gtile
+    tiles = owned.reshape(nt, gtile, k)
+    tmsk = mask.reshape(nt, gtile)
+    tgid = gid.reshape(nt, gtile)
+    lo, hi = tile_bounds(tiles.transpose(0, 2, 1), tmsk)  # (nt, k)
+
+    n_dev = (
+        jax.lax.axis_size(axis)
+        if hasattr(jax.lax, "axis_size")
+        else jax.lax.psum(1, axis)
+    )
+    all_lo = jax.lax.all_gather(lo, axis)  # (P, nt, k)
+    all_hi = jax.lax.all_gather(hi, axis)
+    me = jax.lax.axis_index(axis)
+    mine = (jnp.arange(n_dev) == me)[:, None, None]
+    # My own rows inverted: a tile is a BOUNDARY tile only if a REMOTE
+    # shard's box reaches it.
+    rem_lo = jnp.where(mine, _BOX_BIG, all_lo).reshape(n_dev * nt, k)
+    rem_hi = jnp.where(mine, -_BOX_BIG, all_hi).reshape(n_dev * nt, k)
+    live = cross_tile_live(lo, hi, rem_lo, rem_hi, eps)
+
+    order, valid, overflow = _keep_tiles(live, btcap)
+    send_pts = jnp.where(valid[:, None, None], tiles[order], 0.0)
+    send_msk = tmsk[order] & valid[:, None]
+    send_gid = jnp.where(valid[:, None], tgid[order], _INT32_MAX)
+    send_lo = jnp.where(valid[:, None], lo[order], _BOX_BIG)
+    send_hi = jnp.where(valid[:, None], hi[order], -_BOX_BIG)
+    n_send = jnp.sum(live.astype(jnp.int32))
+    return (
+        send_pts, send_msk, send_gid, send_lo, send_hi, n_send, overflow,
+        lo, hi,
+    )
+
+
+def ring_tile_round(
+    buf_pts, buf_msk, buf_gid, buf_lo, buf_hi,
+    recv_pts, recv_msk, recv_gid, recv_val, overflow,
+    my_lo, my_hi, eps, axis,
+):
+    """One ppermute step of the boundary-tile ring + tile-level accept.
+
+    Must run inside ``shard_map``.  The passing buffer (some sender's
+    compacted boundary tiles) moves one hop; each device then accepts
+    the tiles whose box lies within eps of any of ITS tile boxes and
+    merges them — stably, at tile granularity — into the fixed
+    ``recv``-capacity buffer.  Unaccepted tiles keep circulating.
+    Invalid/padding tiles carry inverted boxes and are never live.
+    ``overflow`` accumulates accepted tiles dropped for capacity.
+    """
+    from ..ops.distances import cross_tile_live
+
+    n_dev = (
+        jax.lax.axis_size(axis)
+        if hasattr(jax.lax, "axis_size")
+        else jax.lax.psum(1, axis)
+    )
+    perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+    buf_pts = jax.lax.ppermute(buf_pts, axis, perm)
+    buf_msk = jax.lax.ppermute(buf_msk, axis, perm)
+    buf_gid = jax.lax.ppermute(buf_gid, axis, perm)
+    buf_lo = jax.lax.ppermute(buf_lo, axis, perm)
+    buf_hi = jax.lax.ppermute(buf_hi, axis, perm)
+
+    acc = cross_tile_live(buf_lo, buf_hi, my_lo, my_hi, eps)
+    bcap = recv_val.shape[0]
+    cat_pts = jnp.concatenate([recv_pts, buf_pts])
+    cat_msk = jnp.concatenate([recv_msk, buf_msk & acc[:, None]])
+    cat_gid = jnp.concatenate(
+        [recv_gid, jnp.where(acc[:, None], buf_gid, _INT32_MAX)]
+    )
+    cat_val = jnp.concatenate([recv_val, acc])
+    order, kept, dropped = _keep_tiles(cat_val, bcap)
+    return (
+        buf_pts, buf_msk, buf_gid, buf_lo, buf_hi,
+        cat_pts[order], cat_msk[order], cat_gid[order], kept,
+        overflow + dropped,
+    )

@@ -511,6 +511,54 @@ def _cluster_local_partitions(
     return labels, core, pair_stats
 
 
+def _merge_round(lab_map, home_label, core_g, h_gid, h_lab, h_core, axis):
+    """ONE cross-device pmin label round of the bipartite merge.
+
+    The body of :func:`_merge_loop`, split out so the global-Morton
+    mode (:mod:`pypardis_tpu.parallel.global_morton`) can host-step the
+    identical round as its own program — per-round convergence probe +
+    trace span — while the fused while_loop path keeps byte-identical
+    semantics.  Returns ``(new_map, changed)``.
+    """
+    n1 = lab_map.shape[0]
+
+    def lookup(lm, lab):
+        safe = jnp.clip(lab, 0, n1 - 1)
+        return jnp.where(lab >= 0, lm[safe], _INT_INF)
+
+    # point_min[g]: min canonical label over g's occurrences (core only)
+    pm_home = jnp.where(core_g, lookup(lab_map, home_label), _INT_INF)
+    halo_vals = jnp.where(h_core, lookup(lab_map, h_lab), _INT_INF)
+    pm_halo = (
+        jnp.full((n1,), _INT_INF, jnp.int32).at[h_gid].min(halo_vals)
+    )
+    pm_halo = jax.lax.pmin(pm_halo, axis)
+    pm = jnp.minimum(pm_home, pm_halo)
+
+    # cluster_min[l]: min point_min over member occurrences
+    new_map = lab_map
+    home_tgt = jnp.where(core_g, home_label, n1 - 1)
+    new_map = new_map.at[jnp.clip(home_tgt, 0, n1 - 1)].min(
+        jnp.where(core_g & (home_label >= 0), pm, _INT_INF)
+    )
+    halo_tgt = jnp.where(h_core & (h_lab >= 0), h_lab, n1 - 1)
+    local = jnp.full((n1,), _INT_INF, jnp.int32).at[halo_tgt].min(
+        jnp.where(h_core & (h_lab >= 0), pm[h_gid], _INT_INF)
+    )
+    new_map = jnp.minimum(new_map, jax.lax.pmin(local, axis))
+
+    # pointer jump: chase canonical labels to a fixpoint
+    def jump_body(st):
+        m, _ = st
+        nxt = jnp.where(m != _INT_INF, m[jnp.clip(m, 0, n1 - 1)], m)
+        return nxt, jnp.any(nxt != m)
+
+    new_map, _ = jax.lax.while_loop(
+        lambda st: st[1], jump_body, (new_map, jnp.bool_(True))
+    )
+    return new_map, jnp.any(new_map != lab_map)
+
+
 def _merge_loop(lab_map, home_label, core_g, h_gid, h_lab, h_core, axis,
                 max_rounds):
     """Min-label propagation over the bipartite point<->cluster graph.
@@ -532,49 +580,12 @@ def _merge_loop(lab_map, home_label, core_g, h_gid, h_lab, h_core, axis,
     mesh (every update flows through pmin), so the flag is identical on
     every device and the while_loop steps in lockstep.
     """
-    n1 = lab_map.shape[0]
-
-    def lookup(lm, lab):
-        safe = jnp.clip(lab, 0, n1 - 1)
-        return jnp.where(lab >= 0, lm[safe], _INT_INF)
-
     def body(state):
         lab_map, _, rounds = state
-        # point_min[g]: min canonical label over g's occurrences (core only)
-        pm_home = jnp.where(
-            core_g, lookup(lab_map, home_label), _INT_INF
+        new_map, changed = _merge_round(
+            lab_map, home_label, core_g, h_gid, h_lab, h_core, axis
         )
-        halo_vals = jnp.where(h_core, lookup(lab_map, h_lab), _INT_INF)
-        pm_halo = (
-            jnp.full((n1,), _INT_INF, jnp.int32).at[h_gid].min(halo_vals)
-        )
-        pm_halo = jax.lax.pmin(pm_halo, axis)
-        pm = jnp.minimum(pm_home, pm_halo)
-
-        # cluster_min[l]: min point_min over member occurrences
-        new_map = lab_map
-        home_tgt = jnp.where(core_g, home_label, n1 - 1)
-        new_map = new_map.at[jnp.clip(home_tgt, 0, n1 - 1)].min(
-            jnp.where(core_g & (home_label >= 0), pm, _INT_INF)
-        )
-        halo_tgt = jnp.where(h_core & (h_lab >= 0), h_lab, n1 - 1)
-        local = jnp.full((n1,), _INT_INF, jnp.int32).at[halo_tgt].min(
-            jnp.where(h_core & (h_lab >= 0), pm[h_gid], _INT_INF)
-        )
-        new_map = jnp.minimum(new_map, jax.lax.pmin(local, axis))
-
-        # pointer jump: chase canonical labels to a fixpoint
-        def jump_body(st):
-            m, _ = st
-            nxt = jnp.where(
-                m != _INT_INF, m[jnp.clip(m, 0, n1 - 1)], m
-            )
-            return nxt, jnp.any(nxt != m)
-
-        new_map, _ = jax.lax.while_loop(
-            lambda st: st[1], jump_body, (new_map, jnp.bool_(True))
-        )
-        return new_map, jnp.any(new_map != lab_map), rounds + 1
+        return new_map, changed, rounds + 1
 
     lab_map, changed, rounds = jax.lax.while_loop(
         lambda st: st[1] & (st[2] < max_rounds),
@@ -1775,11 +1786,24 @@ def sharded_dbscan(
     stream: Optional[bool] = None,
     owner_computes: bool = True,
     overlap: Optional[bool] = None,
+    mode: str = "kd",
 ):
     """Cluster ``points`` over the device mesh.
 
     Returns ``(labels, core, stats)`` where labels are global root-gid
     labels (-1 noise) for the original point order.
+
+    ``mode``: ``"kd"`` (default) is the KD-partition + 2*eps-halo
+    family this function has always run, selected further by ``halo``/
+    ``merge``/``owner_computes``.  ``"global_morton"`` dispatches to
+    the zero-duplication global-Morton engine
+    (:func:`pypardis_tpu.parallel.global_morton.global_morton_dbscan`):
+    shards are contiguous ranges of the global Morton order — no
+    partitioner, no halo slabs, ``duplicated_work_factor == 1.0`` by
+    construction — and only boundary TILES ride the exchange ring.
+    Under that mode ``partitioner`` may be None and the KD-specific
+    knobs (``halo``/``hcap``/``stream``/``owner_computes``/``overlap``)
+    are ignored.
 
     ``owner_computes`` (default True) clusters each device's OWNED
     slots only: halo slots contribute neighbor counts and relay
@@ -1835,6 +1859,19 @@ def sharded_dbscan(
     from ..ops.distances import _norm_metric
     from .mesh import default_mesh
 
+    if mode == "global_morton":
+        from .global_morton import global_morton_dbscan
+
+        return global_morton_dbscan(
+            points, eps=eps, min_samples=min_samples, metric=metric,
+            block=block, mesh=mesh, precision=precision, backend=backend,
+            merge=merge, pair_budget=pair_budget,
+            merge_rounds=merge_rounds,
+        )
+    if mode != "kd":
+        raise ValueError(
+            f"mode must be 'kd' or 'global_morton', got {mode!r}"
+        )
     metric = _norm_metric(metric)
     if merge not in ("auto", "device", "host"):
         raise ValueError(f"merge must be auto|device|host, got {merge!r}")

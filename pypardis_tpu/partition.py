@@ -257,6 +257,175 @@ def spatial_order(points: np.ndarray) -> np.ndarray:
     return np.lexsort(words[::-1])  # np.lexsort: last key is primary
 
 
+def _morton_range_weights(sub: np.ndarray, order: np.ndarray,
+                          block: int, eps: float,
+                          max_cols: int = 4096) -> np.ndarray:
+    """Per-tile work estimate for the balanced range split: the number
+    of live (box-gap <= eps) column tiles each row tile of the sorted
+    layout sees — exactly the tiled kernels' cost model (work = live
+    tile pairs x block^2), computed on (nt, k) host boxes in
+    milliseconds.  Past ``max_cols`` tiles the column side is sampled
+    on an even stride (Morton-adjacent tiles are spatially redundant,
+    so a stride is representative) and the count scaled back up — the
+    estimate only has to RANK density, the split quantizes it anyway.
+    """
+    n, k = sub.shape
+    nt = -(-n // block)
+    lo = np.empty((nt, k), np.float32)
+    hi = np.empty((nt, k), np.float32)
+    step = max(1, (1 << 22) // max(block, 1))
+    for t0 in range(0, nt, step):
+        t1 = min(t0 + step, nt)
+        rows = sub[order[t0 * block:t1 * block]]
+        pad = (t1 - t0) * block - len(rows)
+        if pad:
+            rows = np.concatenate([rows, np.full((pad, k), rows[-1])])
+        tiles = rows.reshape(t1 - t0, block, k)
+        lo[t0:t1] = tiles.min(axis=1)
+        hi[t0:t1] = tiles.max(axis=1)
+    stride = max(1, -(-nt // max_cols))
+    clo, chi = lo[::stride], hi[::stride]
+    eps2 = np.float32(eps) ** 2
+    w = np.zeros(nt)
+    chunk = max(1, (1 << 26) // max(len(clo) * k, 1))
+    for s in range(0, nt, chunk):
+        e = min(s + chunk, nt)
+        gap = np.maximum(
+            0.0,
+            np.maximum(clo[None] - hi[s:e, None],
+                       lo[s:e, None] - chi[None]),
+        )
+        w[s:e] = (np.sum(gap * gap, axis=-1) <= eps2).sum(axis=1)
+    return w * stride
+
+
+def _balanced_starts(w: np.ndarray, n: int, block: int,
+                     n_ranges: int, slack: float = 1.5) -> np.ndarray:
+    """Range boundaries equalizing cumulative tile WORK, not rows.
+
+    Greedy prefix cuts at the per-tile weight's quantiles, clamped so
+    no range exceeds ``slack`` times the equal-rows share of tiles —
+    the row cap bounds every shard's slab capacity (the fused program
+    pads all shards to the LARGEST range), so a dense region can shed
+    work without a sparse shard's padding eating the win.  Cuts land
+    on tile boundaries: weights are per-tile, and sub-tile cuts would
+    buy nothing the kernels could see.
+    """
+    nt = len(w)
+    cw = np.concatenate([[0.0], np.cumsum(w)])
+    max_t = max(1, int(np.ceil(slack * nt / n_ranges)))
+    starts_t = np.zeros(n_ranges + 1, dtype=np.int64)
+    starts_t[n_ranges] = nt
+    prev = 0
+    for j in range(1, n_ranges):
+        tgt = cw[-1] * j / n_ranges
+        t = int(np.searchsorted(cw, tgt))
+        if t > 0 and cw[t] - tgt > tgt - cw[t - 1]:
+            t -= 1
+        t = max(t, prev, nt - (n_ranges - j) * max_t)
+        t = min(t, nt, prev + max_t)
+        starts_t[j] = prev = t
+    return np.minimum(starts_t * block, n)
+
+
+def morton_range_split(points: np.ndarray, n_ranges: int,
+                       chunk: int = 1 << 20, eps: float = None,
+                       block: int = None):
+    """Global Morton keying + contiguous range splitting.
+
+    The zero-duplication analogue of :class:`KDPartitioner` for the
+    ``mode="global_morton"`` distributed engine
+    (:mod:`pypardis_tpu.parallel.global_morton`): instead of KD boxes
+    whose 2*eps expansions overlap (and duplicate boundary points), the
+    WHOLE dataset is keyed by one global Morton order and each shard
+    owns a disjoint, contiguous row range of it — every point
+    clustered exactly once by construction.
+
+    With ``eps`` and ``block`` given, ranges equalize estimated WORK
+    rather than rows: per-tile live-column counts
+    (:func:`_morton_range_weights` — the tiled kernels' own cost
+    model) are prefix-split at their quantiles, cuts quantized to tile
+    boundaries and row counts capped at 1.5x the equal share (the
+    fused program pads every shard to the largest range).  Equal-row
+    ranges leave dense regions with up to ~1.2x the live pairs of
+    sparse ones, and the slowest device binds the whole fused program.
+    Without ``eps``/``block`` the split is plain equal rows.  EVERY
+    contiguous split yields identical labels — balance is purely a
+    performance property — so callers may cache one split across eps
+    values.
+
+    The order is computed in the recentred float32 frame (float64 mean
+    subtracted, cast to f32 — the exact frame the shard slabs are built
+    in, :func:`pypardis_tpu.parallel.sharded._recentre_rows`), so slab
+    rows and sort keys can never disagree about borderline ordering.
+
+    Requires an in-RAM row-indexable array: the keying materializes one
+    f32 copy of the dataset (the KD ring/streaming path remains the
+    memmap route).  Returns ``(order, starts, center)``: ``order`` the
+    (N,) int32 global Morton permutation, ``starts`` the
+    (n_ranges + 1,) int64 range boundaries (equal ``ceil(N /
+    n_ranges)``-row ranges, or work-balanced cuts when ``eps`` and
+    ``block`` are given), ``center`` the float64 dataset mean.
+    """
+    points = np.asarray(points)
+    n, k = points.shape
+    n_ranges = max(1, int(n_ranges))
+    center = points.mean(axis=0, dtype=np.float64)
+    sub = np.empty((n, k), np.float32)
+    for s in range(0, n, chunk):
+        e = min(s + chunk, n)
+        np.subtract(points[s:e], center, out=sub[s:e], casting="unsafe")
+    order = np.asarray(spatial_order(sub), dtype=np.int32)
+    if eps is not None and block is not None and n_ranges > 1 and n:
+        w = _morton_range_weights(sub, order, int(block), float(eps))
+        starts = _balanced_starts(w, n, int(block), n_ranges)
+    else:
+        per = -(-n // n_ranges)
+        starts = np.minimum(
+            np.arange(n_ranges + 1, dtype=np.int64) * per, n
+        )
+    del sub
+    return order, starts, center
+
+
+class MortonRangePartitioner:
+    """Parity-product shim for the global-Morton distributed mode.
+
+    Presents the :class:`KDPartitioner` product surface (``partitions``
+    / ``result`` / ``bounding_boxes`` / ``n_partitions``) over Morton
+    ranges, so ``DBSCAN``'s inspection attributes and
+    ``cluster_mapping()`` work identically across modes.  There is no
+    split tree (``tree == []``) and no ``route()``: Morton ranges are a
+    property of the fitted dataset's order, not a spatial predicate new
+    points can replay.
+    """
+
+    def __init__(self, order: np.ndarray, starts: np.ndarray,
+                 bounding_boxes: Dict[int, BoundingBox]):
+        order = np.asarray(order, dtype=np.int32)
+        starts = np.asarray(starts, dtype=np.int64)
+        self.tree: list = []
+        self.builder = "morton_range"
+        self.level_times_s: list = []
+        self.split_method = "morton_range"
+        self.bounding_boxes = dict(bounding_boxes)
+        self.partitions = {
+            s: order[starts[s]:starts[s + 1]].copy()
+            for s in range(len(starts) - 1)
+        }
+        self.result = np.empty(len(order), dtype=np.int32)
+        for s, idx in self.partitions.items():
+            self.result[idx] = s
+
+    @property
+    def n_partitions(self) -> int:
+        return len(self.partitions)
+
+    def partition_sizes(self) -> np.ndarray:
+        labels = sorted(self.partitions)
+        return np.array([len(self.partitions[l]) for l in labels])
+
+
 # Level-builder buffer pool: the two dataset-sized ping-pong buffers,
 # reused across builds of the same geometry (warm refits rebuild the
 # partitioner every fit — bench's host reps, eps sweeps).  Reuse also

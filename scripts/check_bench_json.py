@@ -65,6 +65,14 @@ def main() -> None:
     # present and finite on EVERY row (single-shard rows report 1.0/0).
     number("sharding", "duplicated_work_factor")
     number("sharding", "staged_bytes_reused")
+    # Honest-mode contract (ISSUE 5): every row says whether the
+    # owner-computes step actually ran — the 1-device chained route
+    # reports False (it runs the legacy step), never omits the field.
+    if not isinstance(tel["sharding"].get("owner_computes"), bool):
+        fail(
+            f"telemetry.sharding.owner_computes is "
+            f"{tel['sharding'].get('owner_computes')!r}, expected bool"
+        )
     # Host-pipeline contract (ISSUE 3): the chained-loop overlap gauge
     # and the partitioner's per-level build breakdown must be present
     # and finite on EVERY row (single-shard rows report 0.0 / []).
@@ -96,6 +104,49 @@ def main() -> None:
         fail("telemetry.phases is empty")
     if "points" not in tel["devices"]:
         fail("telemetry.devices missing per-device point counts")
+
+    # Global-Morton contract (ISSUE 5): a global_morton row must have
+    # actually run the morton-ring path — a silent fallback to the KD
+    # halo machinery (wrong halo_exchange, duplication above 1.0, or a
+    # missing boundary-tile gauge) fails CI here, and the boundary-tile
+    # traffic must undercut the legacy halo bytes on the same geometry.
+    if str(row["metric"]).startswith("global_morton"):
+        if tel["sharding"].get("mode") != "global_morton":
+            fail("global_morton row without sharding.mode=global_morton")
+        if tel["sharding"].get("halo_exchange") != "morton_ring":
+            fail(
+                f"global_morton row fell back to halo_exchange="
+                f"{tel['sharding'].get('halo_exchange')!r} (expected "
+                f"'morton_ring')"
+            )
+        if number("sharding", "duplicated_work_factor") != 1.0:
+            fail(
+                f"global_morton duplicated_work_factor is "
+                f"{tel['sharding']['duplicated_work_factor']!r}, "
+                f"expected exactly 1.0 (zero-duplication contract)"
+            )
+        if tel["sharding"].get("owner_computes") is not True:
+            fail("global_morton row must report owner_computes=True")
+        for key in ("boundary_tile_bytes", "boundary_tiles",
+                    "ring_rounds", "fixpoint_rounds"):
+            number("sharding", key)
+        legacy = row.get("legacy_halo_bytes")
+        if isinstance(legacy, (int, float)) and not isinstance(
+            legacy, bool
+        ):
+            bnd = tel["sharding"]["boundary_tile_bytes"]
+            if bnd >= legacy:
+                fail(
+                    f"boundary_tile_bytes {bnd} not below legacy "
+                    f"halo_bytes {legacy} on the same geometry"
+                )
+        for key in ("speedup_vs_oc", "fixpoint_rounds"):
+            v = row.get(key)
+            if v is not None and (
+                not isinstance(v, (int, float)) or isinstance(v, bool)
+                or v != v
+            ):
+                fail(f"row.{key} is {v!r}, expected a finite number")
 
     # Serving contract (ISSUE 4): serve_probe rows must carry the
     # ``serving`` block with finite QPS / latency-percentile /

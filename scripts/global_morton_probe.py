@@ -1,0 +1,175 @@
+#!/usr/bin/env python
+"""CI probe for the zero-duplication global-Morton distributed mode.
+
+Runs the SAME geometry through the owner-computes KD-halo mode and
+``mode="global_morton"`` on the 8-device CPU mesh (cold + warm fits),
+asserts label byte-parity (and, on the structured manifold row, label
+parity against the fused single-device engine plus ARI >= 0.99 against
+the generating assignment), and emits ONE bench-style JSON row:
+
+* ``metric="global_morton_probe"``, ``value`` = warm global-Morton
+  throughput (pts/s), ``telemetry`` = the global-Morton fit's
+  ``run_report@1`` — ``scripts/check_bench_json.py`` validates the row
+  and FAILS CI when ``sharding.halo_exchange != "morton_ring"`` or
+  ``duplicated_work_factor != 1.0`` (a silent fallback to the KD halo
+  path cannot pass) or when ``boundary_tile_bytes`` is not below the
+  legacy ``halo_bytes`` on the same geometry;
+* top-level comparison fields: ``legacy_halo_bytes``,
+  ``boundary_tile_bytes``, ``speedup_vs_oc`` (warm OC wall / warm GM
+  wall), ``fixpoint_rounds``, and the ``manifold`` block (structured
+  low-rank data: ARI + live-pair/pad-waste stats next to the isotropic
+  row).
+
+Geometry via env: GM_N (default 20000), GM_DIM (16), GM_EPS (2.4),
+GM_BLOCK (256 — the fastest kernel tile for BOTH modes on the
+single-core CI mesh, where wall tracks total work and finer tiles
+waste less of each live pair; hardware meshes want the MXU-width
+1024), GM_MP (16 KD partitions — the r5 halo-tax setup).  The
+acceptance-scale run is ``GM_N=200000 make global-morton-probe``.
+"""
+
+import json
+import os
+import sys
+import time
+
+_N_DEV = int(os.environ.get("PYPARDIS_PROBE_DEVICES", "8"))
+if os.environ.get("PYPARDIS_PROBE_PLATFORM") != "native":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={_N_DEV}"
+        ).strip()
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+
+if os.environ.get("PYPARDIS_PROBE_PLATFORM") != "native":
+    jax.config.update("jax_platforms", "cpu")
+    if "jax_num_cpu_devices" in jax.config._value_holders:
+        jax.config.update("jax_num_cpu_devices", _N_DEV)
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+from benchdata import (  # noqa: E402
+    ari_vs_truth, make_blob_data, make_manifold_data,
+)
+
+
+def _fit_twice(model, X):
+    t0 = time.perf_counter()
+    model.fit(X)
+    cold = time.perf_counter() - t0
+    labels_cold = model.labels_.copy()
+    t0 = time.perf_counter()
+    model.fit(X)
+    warm = time.perf_counter() - t0
+    assert np.array_equal(labels_cold, model.labels_), (
+        "warm refit changed labels"
+    )
+    return cold, warm
+
+
+def main() -> None:
+    from pypardis_tpu import DBSCAN
+    from pypardis_tpu.parallel import default_mesh
+
+    n = int(os.environ.get("GM_N", 20000))
+    dim = int(os.environ.get("GM_DIM", 16))
+    eps = float(os.environ.get("GM_EPS", 2.4))
+    block = int(os.environ.get("GM_BLOCK", 256))
+    mp = int(os.environ.get("GM_MP", 16))
+    min_samples = 10
+    n_dev = min(_N_DEV, jax.device_count())
+    mesh = default_mesh(n_dev)
+
+    X, truth = make_blob_data(n, dim, n_centers=64, std=0.4)
+
+    kw = dict(eps=eps, min_samples=min_samples, block=block, mesh=mesh)
+    oc = DBSCAN(max_partitions=mp, **kw)
+    oc_cold, oc_warm = _fit_twice(oc, X)
+    gm = DBSCAN(mode="global_morton", **kw)
+    gm_cold, gm_warm = _fit_twice(gm, X)
+
+    if not np.array_equal(oc.labels_, gm.labels_):
+        print(
+            "global_morton probe FAILED: labels diverge from the "
+            "owner-computes KD mode", file=sys.stderr,
+        )
+        sys.exit(1)
+
+    # Structured low-rank manifold data (VERDICT r5 Next #10): fused
+    # single-device engine vs the new mode, ARI pinned.  The fused
+    # path numbers clusters by Morton-first core point; canonicalize
+    # to the distributed family's min-core-gid numbering so the byte
+    # comparison means "identical clustering".
+    from pypardis_tpu.ops.labels import densify_labels
+    from pypardis_tpu.parallel.sharded import _canonicalize_roots
+
+    mn = min(n, int(os.environ.get("GM_MANIFOLD_N", 8000)))
+    Xm, tm = make_manifold_data(mn, dim, latent_dim=3)
+    fused = DBSCAN(eps=0.8, min_samples=min_samples, block=block,
+                   mesh=default_mesh(1))
+    fused.fit(Xm)
+    fused_canon = densify_labels(_canonicalize_roots(
+        np.asarray(fused.labels_), np.asarray(fused.core_sample_mask_)
+    ))
+    gmm = DBSCAN(eps=0.8, min_samples=min_samples, block=block,
+                 mesh=mesh, mode="global_morton")
+    gmm.fit(Xm)
+    ari_gm = ari_vs_truth(gmm.labels_, tm)
+    ari_fused = ari_vs_truth(fused.labels_, tm)
+    if not np.array_equal(fused_canon, gmm.labels_):
+        print(
+            "global_morton probe FAILED: manifold labels diverge from "
+            "the fused engine", file=sys.stderr,
+        )
+        sys.exit(1)
+    if ari_gm < 0.99:
+        print(
+            f"global_morton probe FAILED: manifold ari_vs_truth "
+            f"{ari_gm} < 0.99", file=sys.stderr,
+        )
+        sys.exit(1)
+
+    report = gm.report()
+    sh = report["sharding"]
+    oc_sh = oc.report()["sharding"]
+    row = {
+        "metric": "global_morton_probe",
+        "value": round(n / gm_warm, 1),
+        "unit": "pts/s",
+        "n": n,
+        "dim": dim,
+        "eps": eps,
+        "mesh_devices": n_dev,
+        "cold_fit_s": round(gm_cold, 3),
+        "warm_fit_s": round(gm_warm, 3),
+        "oc_cold_fit_s": round(oc_cold, 3),
+        "oc_warm_fit_s": round(oc_warm, 3),
+        "speedup_vs_oc": round(oc_warm / gm_warm, 3),
+        "duplicated_work_factor": sh["duplicated_work_factor"],
+        "oc_duplicated_work_factor": oc_sh["duplicated_work_factor"],
+        "boundary_tile_bytes": sh["boundary_tile_bytes"],
+        "legacy_halo_bytes": oc_sh["halo_bytes"],
+        "fixpoint_rounds": sh.get("fixpoint_rounds", 0),
+        "ring_rounds": sh.get("ring_rounds", 0),
+        "ari_vs_truth": round(ari_vs_truth(gm.labels_, truth), 4),
+        "manifold": {
+            "n": mn,
+            "latent_dim": 3,
+            "ari_gm": round(ari_gm, 4),
+            "ari_fused": round(ari_fused, 4),
+            "labels_match_fused": True,
+            "live_pairs": gmm.report()["compute"]["live_pairs"],
+            "pad_waste": gmm.report()["sharding"]["pad_waste"],
+        },
+        "telemetry": report,
+    }
+    print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -84,7 +84,8 @@ def main():
     ap.add_argument("n", type=int)
     ap.add_argument("mode",
                     choices=["device", "host", "ring", "ring_host",
-                             "auto_host", "device_input"])
+                             "auto_host", "device_input",
+                             "global_morton", "global_morton_host"])
     ap.add_argument("max_partitions", type=int, nargs="?", default=8)
     ap.add_argument("eps", type=float, nargs="?", default=0.3)
     ap.add_argument("--dim", type=int, default=4)
@@ -125,6 +126,12 @@ def main():
         # on device, no per-fit host layout or dataset transfer) — the
         # steady-state engine rate the r4 review asked to pin.
         "device_input": dict(),
+        # zero-duplication global-Morton mode (ISSUE 5): contiguous
+        # Morton ranges, boundary-TILE ring, pmin fixpoint merge — the
+        # KDPartitioner built above is unused by this engine (its build
+        # time still prints for comparability).
+        "global_morton": dict(mode="global_morton"),
+        "global_morton_host": dict(mode="global_morton", merge="host"),
     }[mode]
     if mode == "auto_host":
         sm.MERGE_HOST_AUTO = min(sm.MERGE_HOST_AUTO, max(1, n // 2))

@@ -1,0 +1,295 @@
+"""Global-Morton distributed mode (ISSUE 5).
+
+Shards are contiguous ranges of the global Morton order — zero
+duplicated rows by construction — with boundary TILES riding the
+ppermute ring and a host-stepped cross-device pmin fixpoint merge.
+Labels must be byte-identical to the fused single-device engine AND to
+the KD-halo family across both merge routes and 1/4/8-device CPU
+meshes, including clusters spanning many shard boundaries (multi-hop
+label propagation).
+"""
+
+import json
+
+import numpy as np
+import pytest
+from sklearn.datasets import make_blobs
+
+from pypardis_tpu import DBSCAN
+from pypardis_tpu.ops.labels import densify_labels
+from pypardis_tpu.parallel import default_mesh, sharded_dbscan
+from pypardis_tpu.parallel.global_morton import global_morton_dbscan
+from pypardis_tpu.partition import (
+    KDPartitioner,
+    MortonRangePartitioner,
+    morton_range_split,
+)
+
+KW = dict(eps=0.4, min_samples=5, block=128)
+
+
+def canon(labels, core):
+    """Dense labels under the distributed family's canonical numbering
+    (clusters keyed by their min core member, then densified).
+
+    The raw 1-device fused path numbers clusters by their Morton-FIRST
+    core point (kernel roots are min sorted-space indices mapped back
+    through the permutation), while every sharded mode canonicalizes to
+    the min core gid — identical clusterings, permuted dense ids.
+    Canonicalizing both sides makes byte-comparison mean exactly
+    "identical clustering"."""
+    from pypardis_tpu.parallel.sharded import _canonicalize_roots
+
+    return densify_labels(
+        _canonicalize_roots(np.asarray(labels), np.asarray(core))
+    )
+
+
+@pytest.fixture(scope="module")
+def blobs():
+    X, _ = make_blobs(
+        n_samples=2000, centers=6, n_features=3, cluster_std=0.3,
+        random_state=3,
+    )
+    return X
+
+
+@pytest.fixture(scope="module")
+def fused(blobs):
+    """The fused single-device engine's labels/core (canonical
+    numbering) — the byte-parity reference for every distributed
+    mode."""
+    model = DBSCAN(mesh=default_mesh(1), **KW)
+    model.fit(blobs)
+    return canon(model.labels_, model.core_sample_mask_), np.asarray(
+        model.core_sample_mask_
+    )
+
+
+def test_byte_parity_vs_fused_and_kd(blobs, fused):
+    """global_morton labels byte-match the fused engine AND the KD
+    owner-computes/legacy modes, on 1/4/8-device meshes, both merges."""
+    ref, ref_core = fused
+    part = KDPartitioner(blobs, max_partitions=8)
+    mesh8 = default_mesh(8)
+    for oc in (True, False):
+        l_kd, c_kd, _ = sharded_dbscan(
+            blobs, part, mesh=mesh8, owner_computes=oc, **KW
+        )
+        np.testing.assert_array_equal(
+            densify_labels(l_kd), ref, err_msg=f"kd oc={oc}"
+        )
+    for n_dev in (1, 4, 8):
+        mesh = default_mesh(n_dev)
+        for merge in ("device", "host"):
+            labels, core, stats = global_morton_dbscan(
+                blobs, mesh=mesh, merge=merge, **KW
+            )
+            tag = f"gm {n_dev}dev merge={merge}"
+            np.testing.assert_array_equal(
+                densify_labels(labels), ref, err_msg=tag
+            )
+            np.testing.assert_array_equal(core, ref_core, err_msg=tag)
+            assert stats["mode"] == "global_morton", tag
+            assert stats["halo_exchange"] == "morton_ring", tag
+            assert stats["duplicated_work_factor"] == 1.0, tag
+            assert stats["owner_computes"] is True, tag
+            assert stats["merge"] == merge, tag
+
+
+def test_cluster_spans_many_shard_boundaries():
+    """An elongated cluster threading ALL 8 Morton ranges: the eps
+    chain crosses >= 7 shard boundaries, so the fixpoint's multi-hop
+    label propagation is load-bearing — and must converge to ONE
+    cluster byte-identical to the fused engine."""
+    rng = np.random.default_rng(0)
+    n = 4096
+    t = np.linspace(0.0, 100.0, n)
+    X = np.stack([t, rng.normal(0.0, 0.01, n)], axis=1)
+    kw = dict(eps=0.1, min_samples=5, block=128)
+    fused_model = DBSCAN(mesh=default_mesh(1), **kw)
+    fused_model.fit(X)
+    ref = canon(fused_model.labels_, fused_model.core_sample_mask_)
+    labels, core, stats = global_morton_dbscan(
+        X, mesh=default_mesh(8), **kw
+    )
+    dense = densify_labels(labels)
+    np.testing.assert_array_equal(dense, ref)
+    assert dense.max() == 0  # one chain cluster across every shard
+    assert stats["merge_converged"] is True
+    # Propagating a min label across a multi-shard chain needs at
+    # least one changing round plus the convergence round.
+    assert stats["fixpoint_rounds"] >= 2
+    # Every interior shard both sends and receives boundary tiles.
+    assert stats["boundary_tiles"] >= 7
+
+
+def test_manifold_structured_data():
+    """Low-rank embedding-manifold mixture (VERDICT r5 Next #10):
+    correlated structure is the adversarial case for Morton-range
+    sharding — labels must still byte-match the fused engine and score
+    ARI >= 0.99 against the generating assignment."""
+    from benchdata import ari_vs_truth, make_manifold_data
+
+    X, truth = make_manifold_data(4000, 16, latent_dim=3)
+    kw = dict(eps=0.8, min_samples=10, block=128)
+    fm = DBSCAN(mesh=default_mesh(1), **kw)
+    fm.fit(X)
+    ref = canon(fm.labels_, fm.core_sample_mask_)
+    labels, _core, stats = global_morton_dbscan(
+        X, mesh=default_mesh(8), **kw
+    )
+    dense = densify_labels(labels)
+    np.testing.assert_array_equal(dense, ref)
+    assert ari_vs_truth(dense, truth) >= 0.99
+    # The live-pair / pad-waste stats ride next to the isotropic rows.
+    assert stats["live_pairs"] > 0
+    assert np.isfinite(stats["pad_waste"])
+
+
+def test_warm_refit_and_eps_sweep_reuse_staged_slabs(blobs):
+    from pypardis_tpu.parallel import staging
+
+    staging.clear()
+    mesh = default_mesh(8)
+    l1, _, s1 = global_morton_dbscan(blobs, mesh=mesh, **KW)
+    assert s1["staged_bytes_reused"] == 0
+    l2, _, s2 = global_morton_dbscan(blobs, mesh=mesh, **KW)
+    # Warm refit: owned slabs AND boundary tiles reuse.
+    assert s2["staged_bytes_reused"] > 0
+    np.testing.assert_array_equal(l1, l2)
+    # eps sweep: the owned slabs are keyed WITHOUT eps, so they reuse
+    # while the (eps-dependent) boundary tiles rebuild.
+    _, _, s3 = global_morton_dbscan(
+        blobs, mesh=mesh, eps=0.5, min_samples=5, block=128
+    )
+    assert s3["staged_bytes_reused"] > 0
+
+
+def test_explicit_btcap(blobs, fused):
+    from pypardis_tpu.parallel import staging
+
+    staging.clear()
+    labels, _, stats = global_morton_dbscan(
+        blobs, mesh=default_mesh(8), btcap=64, **KW
+    )
+    np.testing.assert_array_equal(densify_labels(labels), fused[0])
+    # An explicit too-small send capacity fails loudly (no silent
+    # dropped-tile results); the auto ladder would have retried.
+    staging.clear()
+    with pytest.raises(RuntimeError, match="boundary-tile"):
+        global_morton_dbscan(blobs, mesh=default_mesh(8), btcap=1, **KW)
+    staging.clear()
+
+
+def test_dbscan_mode_surface(blobs, fused, tmp_path):
+    model = DBSCAN(mode="global_morton", mesh=default_mesh(8), **KW)
+    model.fit(blobs)
+    np.testing.assert_array_equal(model.labels_, fused[0])
+    report = model.report()
+    assert report["params"]["mode"] == "global_morton"
+    sh = report["sharding"]
+    assert sh["mode"] == "global_morton"
+    assert sh["halo_exchange"] == "morton_ring"
+    assert sh["duplicated_work_factor"] == 1.0
+    assert sh["owner_computes"] is True
+    assert sh["boundary_tile_bytes"] > 0
+    assert sh["ring_rounds"] == 7
+    # Parity surface: Morton-range partitioner; work-balanced ranges
+    # stay within the documented 1.5x-of-equal-share row cap (in whole
+    # tiles of `block` rows).
+    part = model.partitioner_
+    assert isinstance(part, MortonRangePartitioner)
+    sizes = part.partition_sizes()
+    assert int(sizes.sum()) == len(blobs)
+    nt = -(-len(blobs) // KW["block"])
+    max_tiles = -(-int(np.ceil(1.5 * nt)) // 8)
+    assert int(sizes.max()) <= max_tiles * KW["block"]
+    assert set(np.unique(part.result)) <= set(range(8))
+    assert part.tree == []
+    # neighbors = OWNED rows only (zero duplication surface).
+    total = sum(len(v) for v in model.neighbors.values())
+    assert total == len(blobs)
+    assert model.cluster_dict  # partition:cluster parity codes exist
+    # The summary renders the boundary-tile line without raising.
+    assert "boundary" in model.summary()
+    # Trace spans: ring rounds + fixpoint rounds separate exchange
+    # time from compute time (ISSUE 5 telemetry satellite).
+    path = tmp_path / "gm_trace.json"
+    model.export_trace(str(path))
+    names = {
+        ev["name"] for ev in json.load(open(path))["traceEvents"]
+    }
+    assert "gm.exchange" in names
+    assert "gm.ring_round" in names
+    assert "gm.fixpoint_round" in names
+
+
+def test_sharded_dbscan_mode_dispatch(blobs, fused):
+    labels, _, stats = sharded_dbscan(
+        blobs, None, mode="global_morton", mesh=default_mesh(8), **KW
+    )
+    assert stats["mode"] == "global_morton"
+    np.testing.assert_array_equal(densify_labels(labels), fused[0])
+    with pytest.raises(ValueError, match="mode"):
+        sharded_dbscan(blobs, None, mode="bogus", **KW)
+
+
+def test_mode_input_validation(blobs, tmp_path):
+    import jax
+
+    with pytest.raises(ValueError, match="mode"):
+        DBSCAN(mode="bogus")
+    model = DBSCAN(mode="global_morton", mesh=default_mesh(8), **KW)
+    with pytest.raises(ValueError, match="host-resident"):
+        model.fit(jax.device_put(np.asarray(blobs)))
+    mm = np.memmap(
+        tmp_path / "x.dat", dtype=np.float32, mode="w+",
+        shape=blobs.shape,
+    )
+    mm[:] = blobs.astype(np.float32)
+    with pytest.raises(ValueError, match="memmap"):
+        DBSCAN(mode="global_morton", mesh=default_mesh(8), **KW).fit(mm)
+
+
+def test_1dev_chained_route_reports_honestly(blobs):
+    """ISSUE 5 satellite: the 1-device chained KD route runs the legacy
+    duplicate-and-recluster step — its report must SAY so
+    (owner_computes False) and still gauge the duplication, so every
+    mode's sharding block is comparable."""
+    part = KDPartitioner(blobs, max_partitions=8)
+    _, _, stats = sharded_dbscan(
+        blobs, part, mesh=default_mesh(1), owner_computes=True, **KW
+    )
+    assert stats["owner_computes"] is False
+    assert np.isfinite(stats["duplicated_work_factor"])
+    assert stats["duplicated_work_factor"] > 1.0
+
+
+def test_morton_range_split_products(blobs):
+    from pypardis_tpu.partition import spatial_order
+
+    order, starts, center = morton_range_split(blobs, 8)
+    assert sorted(order.tolist()) == list(range(len(blobs)))
+    assert starts[0] == 0 and starts[-1] == len(blobs)
+    per = -(-len(blobs) // 8)
+    assert all(
+        0 <= starts[i + 1] - starts[i] <= per for i in range(8)
+    )
+    # The order IS the recentred-f32 global Morton order — the same
+    # frame the shard slabs are built in.
+    sub = (blobs - center).astype(np.float32)
+    np.testing.assert_array_equal(order, spatial_order(sub))
+    # Work-balanced mode (eps + block given): same order, cuts on tile
+    # boundaries, every range within the 1.5x-of-equal-share row cap.
+    order_b, starts_b, _ = morton_range_split(
+        blobs, 8, eps=0.4, block=128
+    )
+    np.testing.assert_array_equal(order_b, order)
+    assert starts_b[0] == 0 and starts_b[-1] == len(blobs)
+    diffs = np.diff(starts_b)
+    assert (diffs >= 0).all()
+    nt = -(-len(blobs) // 128)
+    max_t = int(np.ceil(1.5 * nt / 8))
+    assert int(diffs.max()) <= max_t * 128
+    assert all(s % 128 == 0 for s in starts_b[:-1])
