@@ -75,6 +75,25 @@ def main():
     dt = min(samples)
     pts_per_sec_chip = n / dt / n_chips
 
+    # Flight-recorder overhead on the same warm device-path geometry
+    # (ISSUE 6 acceptance: <= 2% at the CI geometry, measured and
+    # stated in the row).  Best-of-2 with the JSONL sink on, against
+    # the best-of-N baseline above; BENCH_FLIGHT=0 skips.
+    flight_overhead = None
+    if os.environ.get("BENCH_FLIGHT", "1") != "0":
+        import tempfile
+
+        fdir = tempfile.mkdtemp(prefix="bench_flight_")
+        fl_samples = []
+        for i in range(2):
+            fpath = os.path.join(fdir, f"rep{i}.jsonl")
+            t0 = time.perf_counter()
+            DBSCAN(
+                eps=eps, min_samples=min_samples, block=2048, flight=fpath
+            ).fit_predict(Xd)
+            fl_samples.append(time.perf_counter() - t0)
+        flight_overhead = round(min(fl_samples) / dt - 1.0, 4)
+
     ari_truth = ari_vs_truth(labels, truth)
 
     # sklearn single-node baseline on the same data (subsampled if huge,
@@ -118,6 +137,12 @@ def main():
                 # question was undiagnosable from the archives alone).
                 "samples_s": [round(s, 4) for s in samples],
                 "host_samples_s": [round(s, 4) for s in host_samples],
+                # Relative cost of the always-flushing JSONL flight
+                # sink on this geometry (best-of-2 vs the best-of-N
+                # baseline; the ISSUE 6 acceptance bound is <= 2% at
+                # the 200k x 16-D CI geometry).  Negative values mean
+                # the delta drowned in run-to-run noise.
+                "flight_overhead": flight_overhead,
                 "ari_vs_truth": round(ari_truth, 4),
                 "ari_vs_sklearn": ari_sklearn,
                 # The same run_report@1 schema DBSCAN.report() returns:

@@ -480,6 +480,7 @@ class DBSCAN:
         owner_computes: bool = True,
         overlap: Optional[bool] = None,
         mode: str = "auto",
+        flight: Optional[str] = None,
     ):
         if mode not in ("auto", "kd", "global_morton"):
             raise ValueError(
@@ -510,6 +511,12 @@ class DBSCAN:
         # ranges of the global Morton order — zero duplicated rows,
         # boundary TILES ride the exchange ring (parallel.global_morton).
         self.mode = mode
+        # Crash-safe flight recorder (pypardis_tpu.obs.flight): a
+        # *.jsonl path or a directory for per-fit files; None defers to
+        # PYPARDIS_FLIGHT.  A killed run leaves a parseable JSONL
+        # post-mortem that obs.replay() turns back into a Chrome trace
+        # and a partial report.
+        self.flight = flight
         # Reference attribute surface (dbscan.py:93-102).
         self.data = None
         self._result_cache = None
@@ -580,7 +587,6 @@ class DBSCAN:
             }
             return self
 
-        _check_finite(points)
         timer = PhaseTimer()
         ctx = (
             trace(self.profile_dir)
@@ -589,35 +595,78 @@ class DBSCAN:
         )
         n_devices = self._n_devices()
         sharded = n_devices > 1 and len(points) >= 2 * n_devices
-        with obs.use_recorder(rec), ctx:
-            if sharded:
-                self._train_sharded(points, n_devices, timer)
-            else:
-                self._train_single(points, timer)
-            self.metrics_.update(timer.as_dict())
-            self.metrics_["total_s"] = time.perf_counter() - t0
-            self.metrics_["points_per_sec"] = len(points) / max(
-                self.metrics_["total_s"], 1e-9
+        # Crash-safe telemetry: the flight sink (opt-in) streams every
+        # span/gauge/event to disk, and the resource sampler thread
+        # tracks host-RSS / device-bytes / staging-pool watermarks.
+        # Both are torn down in the finally — a fit that raises still
+        # joins the sampler and seals the flight file with the error.
+        flight = obs.open_flight(self.flight)
+        if flight is not None:
+            rec.attach_flight(flight)
+            flight.header(
+                params={
+                    "eps": self.eps,
+                    "min_samples": self.min_samples,
+                    "mode": self.mode,
+                    "merge": self.merge,
+                    "block": self.block,
+                },
+                n_points=int(len(points)),
+                n_dims=int(points.shape[1]),
+                n_devices=int(n_devices if sharded else 1),
+                backend=jax_backend_name(),
             )
-            log_phase(
-                "train",
-                n=len(points),
-                clusters=int(self.labels_.max()) + 1 if len(points) else 0,
-                **{k: round(v, 4) for k, v in self.metrics_.items()
-                   if isinstance(v, float)},
-            )
-        self._fit_info = {
-            "n_dims": int(points.shape[1]),
-            "n_devices": int(n_devices if sharded else 1),
-        }
-        # Absorb the scalar metrics into the registry so the registry
-        # dump alone (counters/gauges/timings) is a complete record.
-        for k, v in self.metrics_.items():
-            if k.endswith("_s"):
-                continue
-            if isinstance(v, (bool, int, float, str, np.integer,
-                              np.floating)):
-                rec.metrics.set(f"run.{k}", v)
+        sampler = obs.ResourceSampler(rec).start()
+        try:
+            with obs.use_recorder(rec), ctx:
+                # Inside the recorded region: the finite check is a
+                # data-dependent streaming pass (seconds at 100M
+                # points), and a rejected input should seal the flight
+                # file with the error rather than leave no record.
+                _check_finite(points)
+                if sharded:
+                    self._train_sharded(points, n_devices, timer)
+                else:
+                    self._train_single(points, timer)
+                self.metrics_.update(timer.as_dict())
+                self.metrics_["total_s"] = time.perf_counter() - t0
+                self.metrics_["points_per_sec"] = len(points) / max(
+                    self.metrics_["total_s"], 1e-9
+                )
+                log_phase(
+                    "train",
+                    n=len(points),
+                    clusters=(
+                        int(self.labels_.max()) + 1 if len(points) else 0
+                    ),
+                    **{k: round(v, 4) for k, v in self.metrics_.items()
+                       if isinstance(v, float)},
+                )
+            self._fit_info = {
+                "n_dims": int(points.shape[1]),
+                "n_devices": int(n_devices if sharded else 1),
+            }
+            # Absorb the scalar metrics into the registry so the
+            # registry dump alone (counters/gauges/timings) is a
+            # complete record.
+            for k, v in self.metrics_.items():
+                if k.endswith("_s"):
+                    continue
+                if isinstance(v, (bool, int, float, str, np.integer,
+                                  np.floating)):
+                    rec.metrics.set(f"run.{k}", v)
+        except BaseException as e:
+            if flight is not None:
+                flight.finish(
+                    status="error",
+                    error=f"{type(e).__name__}: {str(e)[:300]}",
+                )
+            raise
+        finally:
+            sampler.stop()
+            if flight is not None:
+                flight.finish(status="ok")  # no-op after an error seal
+                flight.close()
         # The key-sorted ``result`` list (the reference's final
         # ``sortByKey()``, dbscan.py:164) materializes LAZILY on first
         # access: building N Python tuples costs real wall time at
@@ -761,6 +810,7 @@ class DBSCAN:
                 "owner_computes": self.owner_computes,
                 "overlap": self.overlap,
                 "mode": self.mode,
+                "flight": self.flight,
             },
             n_points=len(self.labels_),
             n_dims=self._fit_info.get("n_dims", 0),
@@ -780,14 +830,22 @@ class DBSCAN:
         """Write the fit's driver spans as Chrome-trace JSON (loads in
         chrome://tracing / ui.perfetto.dev).  Complements the
         ``profile_dir`` jax.profiler trace: this one is always recorded
-        and costs microseconds."""
-        self._require_fitted()
-        if self._recorder is None:
-            raise RuntimeError(
-                "no telemetry recorded for this model (loaded from a "
-                "checkpoint?) — export_trace needs an in-process fit"
-            )
-        return self._recorder.tracer.export_chrome_trace(path)
+        and costs microseconds.
+
+        Works on a FAILED or partial fit too: whatever spans the
+        recorder captured before the exception export fine — unlike
+        ``report()``/``summary()``, which need the fitted result.  (A
+        SIGKILLed process leaves no recorder at all; that case is the
+        flight recorder's: ``obs.replay(path)`` rebuilds the trace from
+        the on-disk JSONL.)
+        """
+        if self._recorder is not None:
+            return self._recorder.tracer.export_chrome_trace(path)
+        self._require_fitted()  # never fitted: the unified message
+        raise RuntimeError(
+            "no telemetry recorded for this model (loaded from a "
+            "checkpoint?) — export_trace needs an in-process fit"
+        )
 
     # -- internals --------------------------------------------------------
 

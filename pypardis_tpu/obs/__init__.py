@@ -19,18 +19,40 @@ schema or an export path.  This package is the single replacement:
   reach the active one via :func:`current` so no signature anywhere
   threads a telemetry handle;
 * :func:`build_run_report` / :func:`format_summary` — the schema'd
-  ``DBSCAN.report()`` dict and its one-screen human rendering.
+  ``DBSCAN.report()`` dict and its one-screen human rendering;
+* :class:`~pypardis_tpu.obs.flight.FlightRecorder` / :func:`replay` —
+  the crash-safe append-only JSONL sink (opt-in via
+  ``DBSCAN(flight=...)`` / ``PYPARDIS_FLIGHT``) and its post-mortem
+  reconstruction: a killed run's file still yields a Chrome trace and
+  a partial report (format ``pypardis_tpu/flight@1``);
+* :class:`~pypardis_tpu.obs.resources.ResourceSampler` — the per-fit
+  watermark thread behind ``report()["resources"]`` (peak host RSS /
+  device live bytes / staging-pool bytes);
+* :func:`heartbeat` — opt-in per-round progress + ETA lines
+  (``PYPARDIS_HEARTBEAT``) on the stepped / chained / global-Morton
+  round loops.
 
 Key schema: lowercase dotted segments ``[a-z0-9_]+(.[a-z0-9_]+)*``.
 Reserved prefixes: ``phase.`` (timings, seconds), ``events.`` (counters,
 one per recorded event kind), ``sharded.`` / ``run.`` (gauges from the
-execution paths), ``compile.`` (first-compile markers).
+execution paths), ``compile.`` (first-compile markers), ``resources.``
+(watermark gauges), ``gm.`` (global-Morton ring/fixpoint telemetry).
 """
 
 from .recorder import RunRecorder, current, event, span, use_recorder
 from .registry import MetricsRegistry
 from .report import REPORT_SCHEMA, build_run_report, format_summary
 from .trace import Tracer
+from .flight import (
+    FLIGHT_SCHEMA,
+    FlightRecorder,
+    FlightReplay,
+    flight_note,
+    heartbeat,
+    open_flight,
+    replay,
+)
+from .resources import ResourceSampler
 
 __all__ = [
     "MetricsRegistry",
@@ -43,4 +65,12 @@ __all__ = [
     "build_run_report",
     "format_summary",
     "REPORT_SCHEMA",
+    "FLIGHT_SCHEMA",
+    "FlightRecorder",
+    "FlightReplay",
+    "flight_note",
+    "heartbeat",
+    "open_flight",
+    "replay",
+    "ResourceSampler",
 ]

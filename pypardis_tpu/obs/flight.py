@@ -1,0 +1,483 @@
+"""Crash-safe flight recorder: an append-only JSONL telemetry sink.
+
+The rest of :mod:`pypardis_tpu.obs` is in-memory and post-hoc — a run
+that dies mid-fit (host OOM in the streaming sort, a too-small ring
+``btcap``, a hung fixpoint round, a SIGKILL from a watchdog) leaves
+*nothing*: ``report()``/``export_trace()`` need a live recorder in a
+live process.  The flight recorder is the durable complement, the same
+role Dask's performance-report/event-log machinery and Ray's timeline
+files play for their schedulers: every span open/close, phase timing,
+gauge write, ladder-retry event, heartbeat, staging note, and resource
+sample is appended to a JSONL file and flushed within one flush
+interval (``PYPARDIS_FLIGHT_FLUSH_S``, default 0.25s; span opens,
+closes, and events flush eagerly), so a killed run leaves a parseable
+post-mortem on disk.
+
+Crash semantics are deliberate:
+
+* a span an exception unwinds through is **left open in the file** (no
+  close record) — the same signature a SIGKILL leaves — so the last
+  open span marks where the run died; the in-memory tracer still
+  closes it, keeping ``export_trace()`` on the live model intact;
+* a run that ends (ok or error) appends one ``fin`` record; a file
+  without it was killed outright.
+
+:func:`replay` reconstructs the observable state from the file alone —
+a Chrome trace (open spans rendered to the last record's timestamp and
+tagged ``unclosed``), the metrics registry, the event log, and a
+partial ``run_report`` — which is what ``make flight-check`` exercises
+by SIGKILLing a fit mid-run.
+
+File format (one JSON object per line, format version
+``pypardis_tpu/flight@1``): ``k`` discriminates the record kind —
+``header`` (schema/pid/params), ``so``/``sc`` (span open/close by
+``id``), ``sx`` (pre-measured complete span), ``ev`` (recorder event),
+``g``/``c``/``tm`` (gauge/counter/timing write), ``rs`` (resource
+sample), ``hb`` (heartbeat), ``note`` (staging and other annotations),
+``fin`` (run end).  All ``t`` fields are seconds relative to the run
+recorder's tracer epoch.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .recorder import current
+from .trace import _jsonable
+
+FLIGHT_SCHEMA = "pypardis_tpu/flight@1"
+
+_FLUSH_DEFAULT_S = 0.25
+
+# Per-process sequence for directory-mode file names: two fits in the
+# same second must not collide.
+_seq_lock = threading.Lock()
+_seq = [0]
+
+
+def _next_seq() -> int:
+    with _seq_lock:
+        _seq[0] += 1
+        return _seq[0]
+
+
+class FlightRecorder:
+    """One append-only JSONL sink, attached to one :class:`RunRecorder`.
+
+    Thread-safe (the resource sampler writes from its own thread).
+    ``flush_interval_s`` bounds how stale the on-disk tail can be; a
+    plain ``flush()`` (user buffer -> OS) is enough for the SIGKILL
+    contract — the process dies, the kernel keeps the written bytes.
+    """
+
+    def __init__(self, path: str, flush_interval_s: Optional[float] = None):
+        self.path = path
+        if flush_interval_s is None:
+            flush_interval_s = float(
+                os.environ.get("PYPARDIS_FLIGHT_FLUSH_S", _FLUSH_DEFAULT_S)
+            )
+        self._flush_every = max(float(flush_interval_s), 0.0)
+        self._f = open(path, "a", encoding="utf-8")
+        self._lock = threading.Lock()
+        self._epoch = time.perf_counter()
+        self._last_flush = 0.0
+        self._finished = False
+        self.records = 0
+
+    # -- wiring ------------------------------------------------------------
+
+    def set_epoch(self, epoch_s: float) -> None:
+        """Adopt the attached tracer's epoch so span/record timestamps
+        share one clock."""
+        self._epoch = float(epoch_s)
+
+    def _t(self, abs_s: Optional[float] = None) -> float:
+        base = time.perf_counter() if abs_s is None else abs_s
+        return round(base - self._epoch, 6)
+
+    def _emit(self, rec: Dict, urgent: bool = False) -> None:
+        try:
+            line = json.dumps(rec, separators=(",", ":"), default=str)
+        except (TypeError, ValueError):
+            return  # a sink must never take the fit down
+        with self._lock:
+            f = self._f
+            if f is None or f.closed:
+                return
+            f.write(line + "\n")
+            self.records += 1
+            now = time.monotonic()
+            if urgent or now - self._last_flush >= self._flush_every:
+                f.flush()
+                self._last_flush = now
+
+    @staticmethod
+    def _attrs(attrs: Dict) -> Dict:
+        return {k: _jsonable(v) for k, v in attrs.items()}
+
+    # -- record kinds ------------------------------------------------------
+
+    def header(self, **fields) -> None:
+        self._emit(
+            {
+                "k": "header",
+                "schema": FLIGHT_SCHEMA,
+                "pid": os.getpid(),
+                "t_unix": round(time.time(), 3),
+                **self._attrs(fields),
+                **(
+                    {"params": fields["params"]}
+                    if isinstance(fields.get("params"), dict)
+                    else {}
+                ),
+            },
+            urgent=True,
+        )
+
+    def span_open(self, sid, name, t0_s, depth, attrs) -> None:
+        self._emit(
+            {
+                "k": "so",
+                "id": int(sid),
+                "name": name,
+                "t": self._t(t0_s),
+                "depth": int(depth),
+                "a": self._attrs(attrs),
+            },
+            urgent=True,
+        )
+
+    def span_close(self, sid, name, t0_s, dur_s, attrs) -> None:
+        self._emit(
+            {
+                "k": "sc",
+                "id": int(sid),
+                "name": name,
+                "t": self._t(t0_s),
+                "dur": round(float(dur_s), 6),
+                "a": self._attrs(attrs),
+            },
+            urgent=True,
+        )
+
+    def span_complete(self, name, t0_s, dur_s, attrs) -> None:
+        self._emit(
+            {
+                "k": "sx",
+                "name": name,
+                "t": self._t(t0_s),
+                "dur": round(float(dur_s), 6),
+                "a": self._attrs(attrs),
+            },
+            urgent=True,
+        )
+
+    def event(self, kind: str, fields: Dict) -> None:
+        self._emit(
+            {"k": "ev", "kind": kind, "t": self._t(),
+             "f": self._attrs(fields)},
+            urgent=True,
+        )
+
+    def gauge(self, key: str, value) -> None:
+        self._emit({"k": "g", "key": key, "v": _jsonable(value),
+                    "t": self._t()})
+
+    def count(self, key: str, value) -> None:
+        self._emit({"k": "c", "key": key, "v": _jsonable(value),
+                    "t": self._t()})
+
+    def timing(self, key: str, seconds: float) -> None:
+        self._emit({"k": "tm", "key": key, "s": round(float(seconds), 6),
+                    "t": self._t()})
+
+    def sample(self, **fields) -> None:
+        self._emit({"k": "rs", "t": self._t(), **self._attrs(fields)})
+
+    def heartbeat(self, stage: str, done: int, total: int,
+                  eta_s: float) -> None:
+        self._emit(
+            {"k": "hb", "stage": stage, "done": int(done),
+             "total": int(total), "eta_s": round(float(eta_s), 3),
+             "t": self._t()}
+        )
+
+    def note(self, kind: str, fields: Dict) -> None:
+        self._emit({"k": "note", "kind": kind, "t": self._t(),
+                    **self._attrs(fields)})
+
+    def finish(self, status: str, **fields) -> None:
+        """Terminal record — first call wins (the error path writes
+        ``status="error"`` before the generic close writes ``"ok"``)."""
+        if self._finished:
+            return
+        self._finished = True
+        self._emit(
+            {"k": "fin", "status": status, "t": self._t(),
+             **self._attrs(fields)},
+            urgent=True,
+        )
+
+    def close(self) -> None:
+        with self._lock:
+            f = self._f
+            if f is None or f.closed:
+                return
+            try:
+                f.flush()
+            finally:
+                f.close()
+
+
+def open_flight(spec=None) -> Optional[FlightRecorder]:
+    """Resolve the opt-in to a :class:`FlightRecorder`, or None.
+
+    ``spec``: a ``*.jsonl`` file path (appended to), any other string
+    (a directory — one fresh ``flight-<pid>-<stamp>-<seq>.jsonl`` per
+    fit), or None to defer to the ``PYPARDIS_FLIGHT`` env var (same
+    meanings; unset/empty disables).
+    """
+    if spec is None:
+        spec = os.environ.get("PYPARDIS_FLIGHT")
+    if not spec:
+        return None
+    spec = str(spec)
+    if spec.endswith(".jsonl"):
+        d = os.path.dirname(spec)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        return FlightRecorder(spec)
+    os.makedirs(spec, exist_ok=True)
+    name = "flight-%d-%s-%d.jsonl" % (
+        os.getpid(), time.strftime("%Y%m%d-%H%M%S"), _next_seq()
+    )
+    return FlightRecorder(os.path.join(spec, name))
+
+
+def flight_note(kind: str, **fields) -> None:
+    """Append an annotation record to the current fit's flight file, if
+    one is attached — the no-recorder/no-flight case is free (library
+    layers call this unconditionally, e.g. the staging economy)."""
+    fl = getattr(current(), "flight", None)
+    if fl is not None:
+        fl.note(kind, fields)
+
+
+# ---------------------------------------------------------------------------
+# heartbeats
+# ---------------------------------------------------------------------------
+
+_HB_LAST: Dict[str, float] = {}
+
+
+def heartbeat(stage: str, done: int, total: int, t0_s: float) -> None:
+    """Per-round progress with a rounds-remaining estimate.
+
+    Always lands in the flight file when one is attached; emits an
+    opt-in log line when ``PYPARDIS_HEARTBEAT`` is set (its float value
+    is the minimum seconds between lines per stage — ``1`` means at
+    most one line per second; the final round always logs).  Wired into
+    the stepped round batches, the chained partition loop, and the
+    global-Morton ring/fixpoint rounds.
+    """
+    now = time.perf_counter()
+    elapsed = now - t0_s
+    done, total = int(done), int(total)
+    remaining = max(total - done, 0)
+    eta = (elapsed / done) * remaining if done > 0 else -1.0
+    fl = getattr(current(), "flight", None)
+    if fl is not None:
+        fl.heartbeat(stage, done, total, eta)
+    env = os.environ.get("PYPARDIS_HEARTBEAT")
+    if not env or env in ("0", "false"):
+        return
+    try:
+        min_gap = float(env)
+    except ValueError:
+        min_gap = 0.0
+    last = _HB_LAST.get(stage)
+    if last is not None and now - last < min_gap and done < total:
+        return
+    _HB_LAST[stage] = now
+    from ..utils import log as _log
+
+    if not _log.get_logger().handlers:
+        _log.enable()
+    _log.get_logger().info(
+        "heartbeat %s %d/%d rounds, elapsed %.1fs, eta %.1fs",
+        stage, done, total, elapsed, max(eta, 0.0),
+    )
+
+
+# ---------------------------------------------------------------------------
+# replay
+# ---------------------------------------------------------------------------
+
+
+class FlightReplay:
+    """The observable state of a (possibly killed) run, reconstructed
+    from its flight file alone.
+
+    ``open_spans`` are the spans the run died inside (opened, never
+    closed — a SIGKILL or an exception unwinding); ``complete`` is True
+    iff a terminal ``fin`` record was written; ``status`` is its
+    ``ok``/``error`` value (None for a killed run).
+    """
+
+    def __init__(self, path: str):
+        from .recorder import RunRecorder
+
+        self.path = path
+        self.header: Dict = {}
+        self.status: Optional[str] = None
+        self.complete = False
+        self.records = 0
+        self.bad_lines = 0
+        self.open_spans: List[Dict] = []
+        rec = RunRecorder()
+        rec.tracer.epoch_s = 0.0
+        self.recorder = rec
+        open_map: Dict[int, Dict] = {}
+        last_t = 0.0
+        with open(path, "r", encoding="utf-8") as f:
+            lines = f.read().splitlines()
+        for i, line in enumerate(lines):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                r = json.loads(line)
+            except json.JSONDecodeError:
+                # A SIGKILL can truncate the final line mid-write; any
+                # earlier corruption is counted, not fatal — a
+                # post-mortem reader salvages what parses.
+                self.bad_lines += 1
+                continue
+            self.records += 1
+            t = float(r.get("t", last_t) or 0.0)
+            last_t = max(last_t, t)
+            k = r.get("k")
+            try:
+                if k == "header":
+                    self.header = r
+                elif k == "so":
+                    open_map[int(r["id"])] = r
+                elif k == "sc":
+                    open_map.pop(int(r["id"]), None)
+                    rec.tracer.add_complete(
+                        r.get("name", "?"), t, float(r.get("dur", 0.0)),
+                        **(r.get("a") or {})
+                    )
+                    last_t = max(last_t, t + float(r.get("dur", 0.0)))
+                elif k == "sx":
+                    rec.tracer.add_complete(
+                        r.get("name", "?"), t, float(r.get("dur", 0.0)),
+                        **(r.get("a") or {})
+                    )
+                    last_t = max(last_t, t + float(r.get("dur", 0.0)))
+                elif k == "ev":
+                    rec.metrics.inc("events." + str(r.get("kind")))
+                    if len(rec.events) < rec.MAX_EVENTS:
+                        rec.events.append(
+                            {"kind": r.get("kind"), "t_s": t,
+                             **(r.get("f") or {})}
+                        )
+                elif k == "g":
+                    rec.metrics.set(r["key"], r.get("v"))
+                elif k == "c":
+                    # events.* counter bumps are duplicates of the
+                    # (urgent, authoritative) "ev" records — skip them
+                    # so replayed event counts aren't doubled.
+                    if not str(r["key"]).startswith("events."):
+                        rec.metrics.inc(r["key"], r.get("v", 1))
+                elif k == "tm":
+                    rec.metrics.observe(r["key"], float(r.get("s", 0.0)))
+                elif k == "fin":
+                    self.complete = True
+                    self.status = r.get("status")
+            except (KeyError, TypeError, ValueError):
+                self.bad_lines += 1
+        self.last_t_s = last_t
+        # Spans the run died inside: render them to the last timestamp
+        # the file saw, tagged so the Chrome trace shows the death site.
+        for r in sorted(open_map.values(), key=lambda x: x.get("t", 0.0)):
+            t0 = float(r.get("t", 0.0) or 0.0)
+            dur = max(last_t - t0, 0.0)
+            attrs = dict(r.get("a") or {})
+            attrs["unclosed"] = True
+            sp = rec.tracer.add_complete(r.get("name", "?"), t0, dur,
+                                         **attrs)
+            self.open_spans.append(
+                {"name": sp.name, "t_s": t0, "attrs": attrs}
+            )
+
+    # -- export surfaces ---------------------------------------------------
+
+    def to_chrome_trace(self) -> dict:
+        return self.recorder.tracer.to_chrome_trace()
+
+    def export_chrome_trace(self, path: str) -> str:
+        return self.recorder.tracer.export_chrome_trace(path)
+
+    def report(self) -> Dict:
+        """A (possibly partial) ``run_report@1`` dict from the file
+        alone: phases from the flushed timing records, run gauges,
+        resources watermarks, event counts, and the registry dump; the
+        extra ``flight`` block says how complete the record is."""
+        from .report import build_run_report
+
+        metrics: Dict = {}
+        reg = self.recorder.metrics
+        for key, tdict in reg.as_dict()["timings"].items():
+            if key.startswith("phase."):
+                metrics[key[len("phase."):] + "_s"] = tdict["total_s"]
+        for key, v in reg.gauges_with_prefix("run.").items():
+            metrics[key[len("run."):]] = v
+        # Wall-clock absorbed into the registry only on fit completion;
+        # for a killed run the last on-disk timestamp is the honest
+        # lower bound.
+        metrics.setdefault("total_s", round(self.last_t_s, 6))
+        hdr = self.header
+        rep = build_run_report(
+            self.recorder,
+            params=hdr.get("params") or {},
+            n_points=int(hdr.get("n_points", 0) or 0),
+            n_dims=int(hdr.get("n_dims", 0) or 0),
+            n_devices=int(hdr.get("n_devices", 1) or 1),
+            backend=str(hdr.get("backend", "unknown")),
+            metrics=metrics,
+        )
+        rep["partial"] = not self.complete
+        rep["flight"] = {
+            "schema": hdr.get("schema", FLIGHT_SCHEMA),
+            "path": self.path,
+            "records": self.records,
+            "bad_lines": self.bad_lines,
+            "status": self.status,
+            "open_spans": [s["name"] for s in self.open_spans],
+            "last_t_s": round(self.last_t_s, 6),
+        }
+        return rep
+
+    def summary(self) -> str:
+        from .report import format_summary
+
+        s = format_summary(self.report())
+        if not self.complete:
+            inside = ", ".join(s_["name"] for s_ in self.open_spans)
+            s += (
+                "\n  flight: PARTIAL (run killed"
+                + (f" inside {inside}" if inside else "")
+                + f"; {self.records} records to t={self.last_t_s:.3f}s)"
+            )
+        return s
+
+
+def replay(path: str) -> FlightReplay:
+    """Reconstruct a run's observable state from its flight file — the
+    post-mortem path for killed runs (``make flight-check``)."""
+    return FlightReplay(path)

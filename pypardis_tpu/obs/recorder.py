@@ -32,6 +32,20 @@ class RunRecorder:
         self.metrics = MetricsRegistry()
         self.tracer = Tracer()
         self.events: List[Dict] = []
+        # Optional crash-safe JSONL sink (obs.flight.FlightRecorder);
+        # attach_flight wires it into the tracer and the registry.
+        self.flight = None
+
+    def attach_flight(self, flight) -> None:
+        """Stream this recorder's telemetry into ``flight``: span
+        opens/closes, events, gauge/counter writes, and phase timings
+        all land in the append-only JSONL file as they happen — the
+        durable complement of the in-memory state behind ``report()``.
+        """
+        flight.set_epoch(self.tracer.epoch_s)
+        self.flight = flight
+        self.tracer.sink = flight
+        self.metrics.sink = flight
 
     def span(self, name: str, sync: bool = False, **attrs):
         return self.tracer.span(name, sync=sync, **attrs)
@@ -51,6 +65,8 @@ class RunRecorder:
                     **{k: _py(v) for k, v in fields.items()},
                 }
             )
+        if self.flight is not None:
+            self.flight.event(kind, fields)
 
     def event_counts(self) -> Dict[str, int]:
         """{event kind -> count} from the counters."""

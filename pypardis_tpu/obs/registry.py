@@ -63,20 +63,30 @@ class MetricsRegistry:
         self._counters: Dict[str, Number] = {}
         self._gauges: Dict[str, object] = {}
         self._timings: Dict[str, Dict[str, float]] = {}
+        # Optional streaming sink (obs.flight.FlightRecorder): every
+        # write also lands in the JSONL file, so a killed run's gauges
+        # and phase timings are recoverable from disk.
+        self.sink = None
 
     # -- write surface ----------------------------------------------------
 
     def inc(self, key: str, value: Number = 1) -> None:
         validate_key(key)
         self._counters[key] = self._counters.get(key, 0) + _py(value)
+        if self.sink is not None:
+            self.sink.count(key, value)
 
     def set(self, key: str, value) -> None:
         validate_key(key)
         self._gauges[key] = _py(value)
+        if self.sink is not None:
+            self.sink.gauge(key, value)
 
     def observe(self, key: str, seconds: float) -> None:
         validate_key(key)
         s = float(_py(seconds))
+        if self.sink is not None:
+            self.sink.timing(key, s)
         t = self._timings.get(key)
         if t is None:
             self._timings[key] = {

@@ -5,6 +5,14 @@
 and interactive fits expose identical telemetry (the ``BENCH_*.json`` /
 ``MESHSCALE_*.json`` archives used to reconstruct this by hand from
 stderr scrapes).
+
+Schema: ``pypardis_tpu/run_report@1``.  Since the flight-recorder PR
+the report always carries a ``resources`` section (peak host RSS /
+device live bytes / staging-pool watermarks, finite on every route),
+and a report rebuilt by :func:`pypardis_tpu.obs.flight.replay` from an
+on-disk flight file (format version ``pypardis_tpu/flight@1``) adds
+``partial`` + ``flight`` blocks describing how complete the on-disk
+record is.
 """
 
 from __future__ import annotations
@@ -204,6 +212,30 @@ def build_run_report(
         devices["partition_sizes"] = [[int(n_points)]]
         devices["points"] = [int(n_points)]
 
+    # Resource watermarks (obs.resources.ResourceSampler gauges):
+    # always present, always finite — 0 means the sampler never ran
+    # (e.g. an empty fit), never NaN.  scripts/check_bench_json.py
+    # enforces the finiteness contract on every bench row.
+    res_g = (
+        recorder.metrics.gauges_with_prefix("resources.")
+        if recorder is not None
+        else {}
+    )
+
+    def _res(key):
+        try:
+            v = float(res_g.get(f"resources.{key}", 0) or 0)
+        except (TypeError, ValueError):
+            return 0
+        return int(v) if v == v and abs(v) != float("inf") else 0
+
+    resources = {
+        "peak_host_rss_bytes": _res("peak_host_rss_bytes"),
+        "peak_device_bytes": _res("peak_device_bytes"),
+        "staging_pool_bytes": _res("staging_pool_bytes"),
+        "samples": _res("samples"),
+    }
+
     ev = recorder.event_counts() if recorder is not None else {}
     events = {
         "restage": ev.get("retry.restage", 0),
@@ -247,6 +279,7 @@ def build_run_report(
         "phases": phases,
         "sharding": sharding,
         "compute": _compute_section(metrics, phases, n_dims),
+        "resources": resources,
         "devices": devices,
         "events": events,
         "metrics": (
@@ -304,6 +337,18 @@ def format_summary(report: Dict) -> str:
             f"({_fmt_bytes(sh.get('boundary_tile_bytes', 0))}, "
             f"{sh.get('fixpoint_rounds', 0)} fixpoint round(s))"
         )
+        # Ring-traffic counters (gm.ring_bytes_sent accumulates the
+        # actual bytes every ppermute circulation carried, ladder
+        # retries included; gm.ring_tiles_kept the tiles receivers
+        # accepted) — previously only trace spans existed, so ring
+        # traffic was invisible without exporting a trace.
+        ctr = report.get("metrics", {}).get("counters", {})
+        sent = ctr.get("gm.ring_bytes_sent", 0)
+        if sent:
+            shard_bits.append(
+                f"ring {_fmt_bytes(sent)} sent / "
+                f"{int(ctr.get('gm.ring_tiles_kept', 0))} tiles kept"
+            )
     elif "halo_bytes" in sh:
         shard_bits.append(f"halo {_fmt_bytes(sh['halo_bytes'])}")
     if "merge" in sh:
@@ -361,6 +406,16 @@ def format_summary(report: Dict) -> str:
             f"{srv.get('n_core', 0):,} cores / "
             f"{srv.get('n_leaves', 0)} leaves "
             f"({_fmt_bytes(srv.get('index_bytes', 0))})"
+        )
+    res = report.get("resources") or {}
+    if res.get("samples", 0) > 0:
+        pool = res.get("staging_pool_bytes", 0)
+        lines.append(
+            f"  resources: host rss peak "
+            f"{_fmt_bytes(res.get('peak_host_rss_bytes', 0))}, device "
+            f"peak {_fmt_bytes(res.get('peak_device_bytes', 0))}"
+            + (f", staging pool {_fmt_bytes(pool)}" if pool else "")
+            + f" ({res['samples']} samples)"
         )
     dev_pts = report["devices"].get("points")
     if dev_pts and len(dev_pts) > 1:

@@ -1,0 +1,166 @@
+"""Resource watermarks: host RSS, device live bytes, staging pool.
+
+The failure modes the north-star run actually risks — host OOM during
+the streaming Morton sort, HBM exhaustion from staged slab generations,
+a staging pool that quietly grows across fits — were invisible: nothing
+recorded memory over time, so a killed run said nothing about *why*.
+:class:`ResourceSampler` is a lightweight daemon thread (one per fit,
+started and ALWAYS joined by ``DBSCAN.train``) that samples
+
+* host RSS (``/proc/self/statm``; ``getrusage`` fallback),
+* per-device live bytes (``device.memory_stats()['bytes_in_use']``
+  summed over the mesh — 0 on backends that don't report, e.g. the CPU
+  CI platform),
+* the staging economy's pooled bytes
+  (:func:`pypardis_tpu.parallel.staging.pool_nbytes`),
+
+tracking peaks into the fit's registry as ``resources.*`` gauges
+(surfaced as ``report()["resources"]`` with guaranteed-finite
+watermarks on every route) and streaming raw samples into the flight
+file when one is attached — the OOM curve survives the kill.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+_INTERVAL_DEFAULT_S = 0.2
+_THREAD_NAME = "pypardis-resource-sampler"
+
+
+def host_rss_bytes() -> int:
+    """Current resident set size in bytes (0 if unreadable)."""
+    try:
+        with open("/proc/self/statm", "rb") as f:
+            pages = int(f.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE")
+    except Exception:  # noqa: BLE001 — sampling must never raise
+        try:
+            import resource
+
+            # ru_maxrss is a PEAK in KB on Linux — a usable fallback
+            # watermark even though it never decreases.
+            return int(
+                resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+            ) * 1024
+        except Exception:  # noqa: BLE001
+            return 0
+
+
+def device_live_bytes() -> int:
+    """Sum of live HBM bytes across devices (0 where unreported)."""
+    try:
+        import jax
+
+        total = 0
+        for d in jax.devices():
+            try:
+                ms = d.memory_stats()
+            except Exception:  # noqa: BLE001
+                ms = None
+            if ms:
+                total += int(ms.get("bytes_in_use", 0) or 0)
+        return total
+    except Exception:  # noqa: BLE001
+        return 0
+
+
+def staging_pool_bytes() -> int:
+    """Bytes held by the staging economy (host pool + device cache)."""
+    try:
+        from ..parallel import staging
+
+        return int(staging.pool_nbytes())
+    except Exception:  # noqa: BLE001
+        return 0
+
+
+class ResourceSampler:
+    """Background watermark sampler for one fit.
+
+    ``start()`` takes an immediate synchronous sample (so even a
+    sub-interval fit reports finite watermarks) then spawns the daemon
+    thread; ``stop()`` is idempotent, always joins the thread, and
+    takes one final sample after the fit's device work settled — the
+    no-leaked-threads contract is regression-tested (a fit that raises
+    still joins via ``DBSCAN.train``'s finally).
+    """
+
+    def __init__(self, recorder, interval_s: Optional[float] = None):
+        if interval_s is None:
+            interval_s = float(
+                os.environ.get(
+                    "PYPARDIS_RESOURCE_INTERVAL_S", _INTERVAL_DEFAULT_S
+                )
+            )
+        self._rec = recorder
+        self._interval = max(float(interval_s), 0.01)
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._peak_host = 0
+        self._peak_dev = 0
+        self._peak_pool = 0
+        self._samples = 0
+
+    def _sample(self) -> None:
+        host = host_rss_bytes()
+        dev = device_live_bytes()
+        pool = staging_pool_bytes()
+        self._samples += 1
+        grew = (
+            host > self._peak_host or dev > self._peak_dev
+            or pool > self._peak_pool
+        )
+        self._peak_host = max(self._peak_host, host)
+        self._peak_dev = max(self._peak_dev, dev)
+        self._peak_pool = max(self._peak_pool, pool)
+        m = self._rec.metrics
+        # Gauges only when a peak moved (each write also lands in the
+        # flight file via the registry sink; a flat hour-long run should
+        # not cost 18k redundant lines) — plus the first/final samples.
+        if grew or self._samples == 1:
+            m.set("resources.peak_host_rss_bytes", self._peak_host)
+            m.set("resources.peak_device_bytes", self._peak_dev)
+            m.set("resources.staging_pool_bytes", self._peak_pool)
+        m.set("resources.samples", self._samples)
+        fl = getattr(self._rec, "flight", None)
+        if fl is not None:
+            fl.sample(rss=host, dev=dev, pool=pool)
+
+    def _run(self) -> None:
+        while not self._stop_evt.wait(self._interval):
+            try:
+                self._sample()
+            except Exception:  # noqa: BLE001 — never take the fit down
+                pass
+
+    def start(self) -> "ResourceSampler":
+        try:
+            self._sample()
+        except Exception:  # noqa: BLE001
+            pass
+        self._thread = threading.Thread(
+            target=self._run, name=_THREAD_NAME, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=10.0)
+            self._thread = None
+        try:
+            self._sample()
+        except Exception:  # noqa: BLE001
+            pass
+        # Final watermarks are authoritative even if no peak "grew"
+        # relative to a stale first sample.
+        m = self._rec.metrics
+        m.set("resources.peak_host_rss_bytes", self._peak_host)
+        m.set("resources.peak_device_bytes", self._peak_dev)
+        m.set("resources.staging_pool_bytes", self._peak_pool)
+        m.set("resources.samples", self._samples)

@@ -62,13 +62,25 @@ class Tracer:
         self.spans: List[Span] = []
         self.dropped = 0
         self._stack: List[Span] = []
+        # Optional streaming sink (obs.flight.FlightRecorder): span
+        # opens/closes are appended to the JSONL file as they happen.
+        self.sink = None
+        self._next_id = 0
 
     @contextlib.contextmanager
     def span(self, name: str, sync: bool = False, **attrs):
         sp = Span(name, time.perf_counter(), len(self._stack), attrs)
+        sid = self._next_id
+        self._next_id += 1
         self._stack.append(sp)
+        if self.sink is not None:
+            self.sink.span_open(sid, name, sp.t0_s, sp.depth, attrs)
+        failed = False
         try:
             yield sp
+        except BaseException:
+            failed = True
+            raise
         finally:
             self._stack.pop()
             if sp._pending is not None:
@@ -83,6 +95,14 @@ class Tracer:
                     jax.device_put(0, dev).block_until_ready()
             sp.dur_s = time.perf_counter() - sp.t0_s
             self._keep(sp)
+            # A span an exception unwinds through stays OPEN in the
+            # flight file — the same on-disk signature a SIGKILL
+            # leaves, so the last open record marks where the run died
+            # (the in-memory span still closes; export_trace on the
+            # live model is unaffected).
+            if self.sink is not None and not failed:
+                self.sink.span_close(sid, name, sp.t0_s, sp.dur_s,
+                                     sp.attrs)
 
     def _keep(self, sp: Span) -> None:
         if len(self.spans) < self.MAX_SPANS:
@@ -97,6 +117,8 @@ class Tracer:
         sp = Span(name, t0_s, len(self._stack), attrs)
         sp.dur_s = dur_s
         self._keep(sp)
+        if self.sink is not None:
+            self.sink.span_complete(name, t0_s, dur_s, attrs)
         return sp
 
     def durations(self) -> Dict[str, float]:

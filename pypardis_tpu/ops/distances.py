@@ -26,11 +26,12 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 _INT_INF = jnp.iinfo(jnp.int32).max
 # Finite stand-in for +/-inf in tile bounding boxes: differences of two
 # bounds must not produce inf-inf NaNs.
-_BIG = jnp.float32(3e38)
+_BIG = np.float32(3e38)  # numpy scalar: trace-inert at import time
 
 _PRECISIONS = {
     "default": jax.lax.Precision.DEFAULT,

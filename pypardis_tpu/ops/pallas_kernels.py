@@ -75,6 +75,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -82,7 +83,7 @@ _INT_INF = jnp.iinfo(jnp.int32).max
 # Sentinel for empty-tile bounding boxes (_bounds_dn): inverted
 # (+BIG, -BIG) boxes put their gap to anything astronomically past any
 # eps, so empty tiles always prune.
-BIG = jnp.float32(2e19)
+BIG = np.float32(2e19)  # numpy scalar: trace-inert at import time
 
 _PRECISION_MODES = ("default", "high", "highest")
 

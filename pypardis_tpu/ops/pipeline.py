@@ -489,9 +489,14 @@ def _cluster_stepped(
     seconds, batch count and size, speculation stats) as ``stepped.*``
     gauges on the current telemetry recorder — surfaced as the
     ``stepped`` section of ``DBSCAN.report()``, so "bounded by the
-    tunnel, not compute" is a measurement, not an attribution.
+    tunnel, not compute" is a measurement, not an attribution.  Each
+    consumed batch also fires :func:`pypardis_tpu.obs.heartbeat`
+    (``stepped.rounds``): per-round progress + a rounds-remaining ETA
+    in the flight file, and opt-in log lines via PYPARDIS_HEARTBEAT —
+    a multi-hour 100M-point stepped run is no longer silent between
+    dispatch and convergence.
     """
-    from ..obs import current as obs_current
+    from ..obs import current as obs_current, heartbeat as obs_heartbeat
     from .labels import (
         dbscan_border_pallas,
         dbscan_prepare_pallas,
@@ -551,6 +556,7 @@ def _cluster_stepped(
 
             f, g, _, changed = _transient_retry("round", some_rounds)
             batches += 1
+            obs_heartbeat("stepped.rounds", batches, max_batches, t_rounds)
             if not changed:  # the last executed round was a fixpoint
                 converged = True
                 break
@@ -582,6 +588,7 @@ def _cluster_stepped(
 
             cur, pending, changed = _transient_retry("round", one_window)
             batches += 1
+            obs_heartbeat("stepped.rounds", batches, max_batches, t_rounds)
             f, g = cur[0], cur[1]
             if not changed:
                 converged = True

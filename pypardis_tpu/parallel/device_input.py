@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-_BIG = jnp.float32(3e38)
+_BIG = np.float32(3e38)  # numpy scalar: trace-inert at import time
 
 
 def tree_arrays(tree):

@@ -75,7 +75,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..obs import event as obs_event, span as obs_span
+from ..obs import (
+    current as obs_current,
+    event as obs_event,
+    heartbeat as obs_heartbeat,
+    span as obs_span,
+)
 from ..ops.labels import gm_backend, oc_counts, oc_extract, oc_propagate
 from ..partition import morton_range_split
 from ..utils import clamp_block, round_up, validate_params
@@ -392,6 +397,8 @@ def _gm_exchange(arrays, eps, *, mesh, axis, gtile, bt, bc):
     boundary tiles (so a send overflow retries with the exact
     capacity), ``recv_overflow`` the max tiles dropped for ``bc``.
     """
+    import time as _time
+
     owned, omsk, ogid = arrays
     n_dev = mesh.devices.size
     k = owned.shape[2]
@@ -405,6 +412,7 @@ def _gm_exchange(arrays, eps, *, mesh, axis, gtile, bt, bc):
          r_pts, r_msk, r_gid, r_val, r_ovf) = out
         state = (s_pts, s_msk, s_gid, s_lo, s_hi,
                  r_pts, r_msk, r_gid, r_val, r_ovf)
+        t_ring = _time.perf_counter()
         for r in range(n_dev - 1):
             with obs_span("gm.ring_round", round=r) as rs:
                 state = _gm_ring_step(
@@ -415,6 +423,7 @@ def _gm_exchange(arrays, eps, *, mesh, axis, gtile, bt, bc):
                 # — a scalar fetch, so the span measures the round's
                 # execution, not its dispatch.
                 rs.sync_on(state[-1])
+            obs_heartbeat("gm.ring", r + 1, n_dev - 1, t_ring)
         bnd, bmsk, bgid, tiles, rows, kept_tiles = _gm_flatten_step(
             state[5], state[6], state[7], state[8], my_lo, my_hi,
             np.float32(eps), mesh=mesh,
@@ -448,6 +457,17 @@ def _gm_exchange(arrays, eps, *, mesh, axis, gtile, bt, bc):
         }
         sp.set(boundary_tiles=xstats["boundary_tiles"],
                sent_tiles=sent_tiles)
+        # Ring-traffic counters (surfaced in summary(); previously
+        # only the trace spans existed, so ring traffic was invisible
+        # without exporting a trace).  Counters accumulate across
+        # capacity-ladder retries — the TRUE bytes every ppermute
+        # circulation carried, not just the final attempt's.
+        m = obs_current().metrics
+        m.inc(
+            "gm.ring_bytes_sent",
+            xstats["boundary_tile_bytes"] * max(n_dev - 1, 0),
+        )
+        m.inc("gm.ring_tiles_kept", xstats["boundary_tiles"])
     send_need = int(n_send_np.max()) if n_send_np.size else 0
     return (bnd, bmsk, bgid), xstats, send_need, int(
         recv_ovf_np.max() if recv_ovf_np.size else 0
@@ -624,10 +644,13 @@ def _gm_fixpoint(home_label, core_g, bgid, b_glab, *, mesh, axis,
     ``merge_rounds`` means possibly under-merged — the caller's ladder
     retries at 4x, never returns it silently.
     """
+    import time as _time
+
     rep = NamedSharding(mesh, P())
     lab_map = jax.device_put(np.arange(n_points + 1, dtype=np.int32), rep)
     rounds = 0
     converged = False
+    t0 = _time.perf_counter()
     while rounds < merge_rounds:
         with obs_span("gm.fixpoint_round", round=rounds):
             lab_map, changed = _gm_fixpoint_step(
@@ -636,6 +659,7 @@ def _gm_fixpoint(home_label, core_g, bgid, b_glab, *, mesh, axis,
             )
             ch = bool(np.asarray(changed))
         rounds += 1
+        obs_heartbeat("gm.fixpoint", rounds, merge_rounds, t0)
         if not ch:
             converged = True
             break

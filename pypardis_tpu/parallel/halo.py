@@ -156,8 +156,17 @@ def ring_halo_exchange_multi(
 # ring of ppermute steps over the compacted boundary-tile buffers only.
 # ---------------------------------------------------------------------------
 
-_INT32_MAX = jnp.int32(2**31 - 1)
-_BOX_BIG = jnp.float32(3e38)
+# NUMPY scalars, not jnp: this module's first import can happen inside
+# an active jit trace (sharded.ring_exchange_step used to import it
+# lazily from its traced body), and a module-level jnp constant created
+# under a trace is a DynamicJaxprTracer that outlives it — every later
+# use then dies with UnexpectedTracerError, depending purely on which
+# test/fit imported what first.  np scalars are trace-inert and behave
+# identically inside the kernels.
+import numpy as _np
+
+_INT32_MAX = _np.int32(2**31 - 1)
+_BOX_BIG = _np.float32(3e38)
 
 
 def _keep_tiles(cat_val, cap_tiles):

@@ -51,7 +51,11 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..geometry import BoxStack
-from ..obs import event as obs_event, span as obs_span
+from ..obs import (
+    event as obs_event,
+    heartbeat as obs_heartbeat,
+    span as obs_span,
+)
 from ..ops.labels import (
     dbscan_fixed_size,
     oc_counts,
@@ -62,6 +66,7 @@ from ..partition import spatial_order
 from ..utils import clamp_block, round_up
 from ..utils.budget import run_ladders
 from . import staging
+from .halo import ring_halo_exchange_multi
 from .mesh import shard_map
 
 _INT_INF = jnp.iinfo(jnp.int32).max
@@ -988,6 +993,10 @@ def _chained_tables_overlap(
         busy += (t_built if ready_early else t_done) - t_disp
         if ready_early:
             idle_overlaps += 1
+        # Per-partition progress + partitions-remaining ETA (flight
+        # file always, log lines via PYPARDIS_HEARTBEAT): a chained
+        # 100M-point run is hours of this loop — it must not be silent.
+        obs_heartbeat("chained.partitions", p + 1, p_total, t_loop)
     wall = _time.perf_counter() - t_loop
     if first:
         _chained_compiled.add(key)
@@ -1584,8 +1593,14 @@ def ring_exchange_step(
     :func:`sharded_step` the host-halo path uses.  The two programs
     chain asynchronously on device, so the split costs dispatch
     latency only.
+
+    NOTE: the halo import lives at module top, not in this traced
+    body — an import executed mid-trace runs halo.py's module body
+    under the trace, and any module-level jax constant it created
+    leaked as a tracer (order-dependent UnexpectedTracerError
+    depending on which fit imported what first; halo.py's constants
+    are now numpy scalars as a second line of defense).
     """
-    from .halo import ring_halo_exchange_multi
 
     def per_device(o, om, og, lo, hi):
         return ring_halo_exchange_multi(o, om, og, lo, hi, hcap, axis)

@@ -40,6 +40,8 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from ..obs import flight_note
+
 _CRC_CHUNK = 1 << 24
 
 # (shape, dtype-str) -> free numpy buffer.  Bounded: give_back keeps
@@ -69,6 +71,15 @@ def clear() -> None:
     and callers that need the HBM back between fits)."""
     _host_pool.clear()
     _device_cache.clear()
+
+
+def pool_nbytes() -> int:
+    """Total bytes the staging economy currently holds — pooled host
+    buffers plus cached device slabs (the resource sampler's
+    ``resources.staging_pool_bytes`` watermark)."""
+    host = sum(int(b.nbytes) for b in _host_pool.values())
+    dev = sum(int(e[3]) for e in _device_cache.values())
+    return host + dev
 
 
 def points_fingerprint(points) -> Tuple:
@@ -132,8 +143,10 @@ def device_get(route: str, key) -> Optional[tuple]:
     ekey, arrays, aux, nbytes = entry
     if ekey != key:
         del _device_cache[route]
+        flight_note("staging.evict", route=route, reason="key_miss")
         return None
     _fit_stats["reused"] += nbytes
+    flight_note("staging.reuse", route=route, nbytes=int(nbytes))
     return arrays, dict(aux)
 
 
@@ -157,7 +170,8 @@ def device_evict(route: str) -> None:
     """Drop one route's cached entry (restage paths: a transient
     device fault can delete cached buffers out from under the cache —
     the retry must rebuild, not re-serve dead handles)."""
-    _device_cache.pop(route, None)
+    if _device_cache.pop(route, None) is not None:
+        flight_note("staging.evict", route=route, reason="explicit")
 
 
 def device_put_cached(route: str, key, arrays: tuple, aux=None) -> tuple:
@@ -166,4 +180,5 @@ def device_put_cached(route: str, key, arrays: tuple, aux=None) -> tuple:
     nbytes = sum(int(np.prod(a.shape)) * a.dtype.itemsize for a in arrays)
     _fit_stats["staged"] += nbytes
     _device_cache[route] = (key, arrays, dict(aux or {}), nbytes)
+    flight_note("staging.device_put", route=route, nbytes=int(nbytes))
     return arrays

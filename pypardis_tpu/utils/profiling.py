@@ -6,7 +6,11 @@ SURVEY §5).  :class:`PhaseTimer` keeps its original API (the drivers and
 tests use it), but every phase now also lands in the unified telemetry
 layer: a span in the current :class:`~pypardis_tpu.obs.RunRecorder`'s
 tracer (Chrome-trace exportable) and a ``phase.<name>`` timing in its
-metrics registry.  :func:`trace` still wraps ``jax.profiler`` so a
+metrics registry — and, when the fit has a flight recorder attached
+(``DBSCAN(flight=...)`` / ``PYPARDIS_FLIGHT``), both stream to the
+crash-safe JSONL file as they happen: the span open lands on disk when
+the phase STARTS, so a killed run's post-mortem shows which phase it
+died in (:mod:`pypardis_tpu.obs.flight`).  :func:`trace` still wraps ``jax.profiler`` so a
 device-level trace of the whole pipeline is one context manager away
 (view in TensorBoard / Perfetto) — the obs tracer is the cheap,
 always-on driver's-eye complement.

@@ -7,6 +7,12 @@ line, and checks the benchmark row schema: the classic
 (``pypardis_tpu/run_report@1`` — the same dict ``DBSCAN.report()``
 returns).  Exits nonzero with a reason on any violation, so CI catches
 schema drift before a BENCH_*.json archive does.
+
+``--require-diff`` (the ``make bench-smoke`` pipe, downstream of
+``scripts/bench_diff.py --annotate``) additionally requires the row's
+``bench_diff`` verdict field and FAILS on a ``regression`` verdict —
+the cross-round perf trajectory is an enforced invariant, not an
+archive to eyeball.
 """
 
 import json
@@ -19,8 +25,10 @@ def fail(msg: str) -> None:
 
 
 def main() -> None:
-    if len(sys.argv) > 1:
-        data = open(sys.argv[1]).read()
+    args = [a for a in sys.argv[1:] if a != "--require-diff"]
+    require_diff = "--require-diff" in sys.argv[1:]
+    if args:
+        data = open(args[0]).read()
     else:
         data = sys.stdin.read()
     lines = [
@@ -96,6 +104,14 @@ def main() -> None:
     for key in ("live_pairs", "kernel_passes",
                 "achieved_flops_per_sec", "mfu"):
         number("compute", key)
+    # Resource-watermark contract (ISSUE 6): every row carries the
+    # sampler's peaks, finite on every route (0 is legal — e.g. device
+    # bytes on backends that don't report memory_stats — NaN never is).
+    if not isinstance(tel.get("resources"), dict):
+        fail("missing/invalid 'resources' block")
+    for key in ("peak_host_rss_bytes", "peak_device_bytes",
+                "staging_pool_bytes"):
+        number("resources", key)
     for key in ("restage", "pair_overflow", "halo_overflow",
                 "merge_unconverged", "compile"):
         if key not in tel["events"]:
@@ -169,6 +185,23 @@ def main() -> None:
         if serving["queries"] > 0 and serving["qps"] <= 0:
             fail("telemetry.serving.qps is 0 with queries > 0")
 
+    # Regression-gate contract (ISSUE 6): rows produced under `make
+    # bench-smoke` ride through bench_diff --annotate first; the
+    # verdict must be present and must not be a real regression.
+    diff_note = ""
+    if require_diff:
+        bd = row.get("bench_diff")
+        if not isinstance(bd, dict) or bd.get("verdict") not in (
+            "regression", "noise", "improved", "no_baseline"
+        ):
+            fail(
+                f"--require-diff: missing/invalid bench_diff verdict "
+                f"({bd!r}); pipe through scripts/bench_diff.py --annotate"
+            )
+        if bd["verdict"] == "regression":
+            fail(f"bench_diff verdict is 'regression': {bd}")
+        diff_note = f", bench_diff={bd['verdict']}"
+
     serve_note = (
         f", serving: {serving['queries']}q @ {serving['qps']}q/s "
         f"p50={serving['p50_ms']}ms p99={serving['p99_ms']}ms "
@@ -179,8 +212,10 @@ def main() -> None:
         f"bench JSON OK: {row['metric']} = {row['value']} {row['unit']} "
         f"(dup_work={tel['sharding']['duplicated_work_factor']}, "
         f"staged_reuse={tel['sharding']['staged_bytes_reused']}, "
-        f"mfu={tel['compute']['mfu']}, events: {tel['events']}"
-        f"{serve_note})"
+        f"mfu={tel['compute']['mfu']}, "
+        f"rss_peak={tel['resources']['peak_host_rss_bytes']}, "
+        f"events: {tel['events']}"
+        f"{diff_note}{serve_note})"
     )
 
 

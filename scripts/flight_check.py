@@ -1,0 +1,180 @@
+#!/usr/bin/env python
+"""Kill-a-fit-mid-run flight-recorder smoke (``make flight-check``).
+
+Proves the crash-safety contract end to end, the way the north-star
+run will actually need it: a child process fit-loops with the flight
+recorder enabled; the parent SIGKILLs it the moment the on-disk JSONL
+shows an in-flight span (opened, not yet closed — i.e. the kill lands
+*inside* device work, with no atexit/finally able to run); then the
+parent, from the file alone, asserts
+
+* every surviving line parses (a truncated final line is tolerated),
+* the opened-but-unclosed span is visible (the death site),
+* ``obs.replay`` reconstructs a Chrome trace and a partial report,
+* no terminal ``fin`` record exists (the run really was killed).
+
+Geometry via ``FLIGHT_N`` (default 40000 x 8-D on the faked 8-device
+CPU mesh — a few seconds per fit, so the kill window is wide).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+
+def _force_cpu_mesh() -> None:
+    # Same discipline as tests/conftest.py: the deployment image's
+    # sitecustomize may pre-import jax pinned to another platform, so
+    # env vars alone can be too late — override via jax.config too.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if "jax_num_cpu_devices" in jax.config._value_holders:
+        jax.config.update("jax_num_cpu_devices", 8)
+
+
+def child(path: str) -> None:
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    _force_cpu_mesh()
+    import numpy as np
+
+    from pypardis_tpu import DBSCAN
+
+    n = int(os.environ.get("FLIGHT_N", 40000))
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(n, 8)).astype(np.float32) * 3.0
+    # Fit forever: the parent kills us mid-fit.  Flight appends to one
+    # file, so records accumulate across iterations and the parent's
+    # open-span poll converges on whichever fit the kill interrupts.
+    while True:
+        DBSCAN(
+            eps=0.5, min_samples=5, block=256, flight=path
+        ).fit(X)
+
+
+def _kill_window(path: str) -> bool:
+    """True when the child is inside driver/device work right now
+    (more span opens than closes among the parseable lines) and the
+    file already carries enough records for a meaningful post-mortem."""
+    opens = closes = records = 0
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                try:
+                    r = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                records += 1
+                if r.get("k") == "so":
+                    opens += 1
+                elif r.get("k") == "sc":
+                    closes += 1
+    except OSError:
+        return False
+    return opens > closes and records >= 20
+
+
+def check(msg: str, ok: bool) -> None:
+    status = "ok" if ok else "FAILED"
+    print(f"flight-check: {msg}: {status}")
+    if not ok:
+        sys.exit(1)
+
+
+def main() -> None:
+    if len(sys.argv) > 2 and sys.argv[1] == "--child":
+        child(sys.argv[2])
+        return
+    tmp = tempfile.mkdtemp(prefix="flight_check_")
+    path = os.path.join(tmp, "flight.jsonl")
+    env = dict(os.environ)
+    deadline = time.time() + float(os.environ.get("FLIGHT_TIMEOUT_S", 300))
+    proc = None
+    killed_mid_span = False
+    for attempt in range(5):
+        if os.path.exists(path):
+            os.unlink(path)
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--child", path],
+            env=env,
+        )
+        try:
+            while time.time() < deadline:
+                if proc.poll() is not None:
+                    print(
+                        f"flight-check: child exited rc={proc.returncode} "
+                        f"before the kill", file=sys.stderr,
+                    )
+                    sys.exit(1)
+                if _kill_window(path):
+                    break
+                time.sleep(0.05)
+        finally:
+            proc.send_signal(signal.SIGKILL)
+            proc.wait()
+        # Post-kill ground truth: the file may have gained records
+        # between our poll and the kill — re-check from the replay.
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        _force_cpu_mesh()
+        from pypardis_tpu import obs
+
+        rep = obs.replay(path)
+        if rep.open_spans and not rep.complete:
+            killed_mid_span = True
+            break
+        print(
+            f"flight-check: attempt {attempt}: kill landed between spans "
+            f"(open={len(rep.open_spans)}, complete={rep.complete}); "
+            f"retrying", file=sys.stderr,
+        )
+    check("SIGKILL landed inside an open span", killed_mid_span)
+
+    from pypardis_tpu import obs
+
+    rep = obs.replay(path)
+    check(f"JSONL parses ({rep.records} records, "
+          f"{rep.bad_lines} truncated/bad)", rep.records > 0)
+    check(
+        f"no terminal record (really killed; open spans: "
+        f"{[s['name'] for s in rep.open_spans]})",
+        not rep.complete and len(rep.open_spans) > 0,
+    )
+    trace_path = os.path.join(tmp, "post_mortem_trace.json")
+    rep.export_chrome_trace(trace_path)
+    doc = json.load(open(trace_path))
+    names = [e.get("name") for e in doc.get("traceEvents", [])
+             if e.get("ph") == "X"]
+    unclosed = [
+        e["name"] for e in doc.get("traceEvents", [])
+        if e.get("ph") == "X" and e.get("args", {}).get("unclosed")
+    ]
+    check(
+        f"Chrome trace reconstructs ({len(names)} spans, death site(s) "
+        f"{unclosed})", len(names) > 0 and len(unclosed) > 0,
+    )
+    report = rep.report()
+    check(
+        "partial report builds (partial=True, resources finite)",
+        report.get("partial") is True
+        and isinstance(
+            report["resources"]["peak_host_rss_bytes"], int
+        ),
+    )
+    print(rep.summary())
+    print(f"flight-check OK: post-mortem at {path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,357 @@
+"""Flight recorder, resource watermarks, and the bench regression gate
+(ISSUE 6).
+
+Unit/integration: the JSONL flight file is written incrementally and
+parseable after both a clean fit and an injected mid-fit exception
+(spans an exception unwinds through stay OPEN in the file — the
+post-mortem death-site marker); ``obs.replay`` reconstructs a Chrome
+trace and a partial report from the file alone; the resource-sampler
+thread always joins (no leaks across fits, error paths included) and
+``report()["resources"]`` carries finite watermarks on every route;
+``export_trace`` works on a failed/partial fit.  Gate: bench_diff
+reproduces the committed r4->r5 'noise' verdict and exits nonzero on a
+synthetic 20% slowdown; check_bench_json enforces the resources block
+and the bench_diff verdict field.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+from sklearn.datasets import make_blobs
+
+from pypardis_tpu import DBSCAN, obs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def blobs():
+    # Distinct seed from every other module's fixture: the staging
+    # device cache is CONTENT-keyed, so sharing another module's exact
+    # dataset would warm its cold-fit assertions from here.
+    X, _ = make_blobs(
+        n_samples=2000, centers=8, n_features=4, cluster_std=0.3,
+        random_state=11,
+    )
+    return X
+
+
+def _parse_lines(path):
+    recs = []
+    for line in open(path, encoding="utf-8").read().splitlines():
+        if line.strip():
+            recs.append(json.loads(line))  # every line must parse
+    return recs
+
+
+def _no_sampler_threads():
+    return not [
+        t for t in threading.enumerate()
+        if t.name.startswith("pypardis-resource-sampler") and t.is_alive()
+    ]
+
+
+# ---------------------------------------------------------------------------
+# flight file: clean fit
+# ---------------------------------------------------------------------------
+
+
+def test_flight_file_written_and_replayable(tmp_path, blobs):
+    path = str(tmp_path / "flight.jsonl")
+    m = DBSCAN(eps=0.4, min_samples=5, block=64, flight=path).fit(blobs)
+    recs = _parse_lines(path)
+    kinds = {r["k"] for r in recs}
+    # header, span open/close, gauges, timings, resource samples,
+    # staging notes, terminal record — all flushed to disk.
+    assert {"header", "so", "sc", "g", "tm", "rs", "fin"} <= kinds
+    hdr = next(r for r in recs if r["k"] == "header")
+    assert hdr["schema"] == "pypardis_tpu/flight@1"
+    assert hdr["n_points"] == 2000 and hdr["n_dims"] == 4
+    assert isinstance(hdr["params"], dict) and hdr["params"]["eps"] == 0.4
+    fin = [r for r in recs if r["k"] == "fin"]
+    assert len(fin) == 1 and fin[0]["status"] == "ok"
+
+    rep = obs.replay(path)
+    assert rep.complete and rep.status == "ok"
+    assert rep.open_spans == [] and rep.bad_lines == 0
+
+    # The replayed Chrome trace carries the same closed spans the live
+    # model exports.
+    live = {
+        e["name"]
+        for e in json.load(
+            open(m.export_trace(str(tmp_path / "live.json")))
+        )["traceEvents"]
+        if e.get("ph") == "X"
+    }
+    replayed = {
+        e["name"] for e in rep.to_chrome_trace()["traceEvents"]
+        if e.get("ph") == "X"
+    }
+    assert "cluster" in replayed
+    assert replayed == live
+
+    # Partial-report surface from the file alone.
+    r = rep.report()
+    assert r["schema"] == "pypardis_tpu/run_report@1"
+    assert r["partial"] is False
+    assert r["phases"]["cluster"] > 0
+    assert r["run"]["n_points"] == 2000
+    assert r["resources"]["peak_host_rss_bytes"] > 0
+    assert r["flight"]["status"] == "ok"
+    json.dumps(r)
+    assert "resources:" in rep.summary()
+
+
+def test_flight_env_opt_in_directory_mode(tmp_path, blobs, monkeypatch):
+    monkeypatch.setenv("PYPARDIS_FLIGHT", str(tmp_path))
+    DBSCAN(eps=0.4, min_samples=5, block=64).fit(blobs)
+    files = list(tmp_path.glob("flight-*.jsonl"))
+    assert len(files) == 1
+    assert obs.replay(str(files[0])).complete
+
+
+def test_no_flight_by_default(tmp_path, blobs):
+    m = DBSCAN(eps=0.4, min_samples=5, block=64).fit(blobs)
+    assert m._recorder.flight is None
+    assert list(tmp_path.iterdir()) == []
+
+
+# ---------------------------------------------------------------------------
+# flight file: injected mid-fit failure
+# ---------------------------------------------------------------------------
+
+
+def test_injected_midfit_exception_leaves_open_span(
+    tmp_path, blobs, monkeypatch
+):
+    """The satellite contract: a fit killed by an exception leaves a
+    parseable flight file whose opened-but-unclosed span marks the
+    death site, and obs.replay reconstructs a partial report from it."""
+
+    def boom(*a, **kw):
+        raise RuntimeError("injected cluster-step failure")
+
+    monkeypatch.setattr(
+        "pypardis_tpu.parallel.sharded.sharded_dbscan", boom
+    )
+    path = str(tmp_path / "flight.jsonl")
+    m = DBSCAN(eps=0.4, min_samples=5, block=64, flight=path)
+    with pytest.raises(RuntimeError, match="injected"):
+        m.fit(blobs)
+    assert _no_sampler_threads()  # error path still joins the sampler
+
+    recs = _parse_lines(path)  # parseable end to end
+    fin = [r for r in recs if r["k"] == "fin"]
+    assert len(fin) == 1 and fin[0]["status"] == "error"
+    assert "injected" in fin[0]["error"]
+    # The cluster phase span opened but its close never hit the file.
+    open_ids = {r["id"] for r in recs if r["k"] == "so"}
+    closed_ids = {r["id"] for r in recs if r["k"] == "sc"}
+    open_names = {
+        r["name"] for r in recs
+        if r["k"] == "so" and r["id"] in (open_ids - closed_ids)
+    }
+    assert "cluster" in open_names
+
+    rep = obs.replay(path)
+    assert rep.status == "error"
+    assert "cluster" in [s["name"] for s in rep.open_spans]
+    r = rep.report()
+    assert "cluster" in r["flight"]["open_spans"]
+    assert "partition" in r["phases"]  # the phase that DID complete
+    trace = rep.to_chrome_trace()
+    unclosed = [
+        e["name"] for e in trace["traceEvents"]
+        if e.get("ph") == "X" and e.get("args", {}).get("unclosed")
+    ]
+    assert "cluster" in unclosed
+    assert "PARTIAL" not in rep.summary()  # fin record = not killed
+    # The live model still exports its (in-memory, closed) spans even
+    # though the fit failed — export_trace no longer needs _require_fitted.
+    assert m.labels_ is None
+    out = m.export_trace(str(tmp_path / "failed_fit.json"))
+    names = {
+        e["name"] for e in json.load(open(out))["traceEvents"]
+        if e.get("ph") == "X"
+    }
+    assert "cluster" in names
+    # report()/summary() keep the unified not-fitted contract.
+    with pytest.raises(RuntimeError, match="not fitted"):
+        m.report()
+
+
+def test_export_trace_surface_still_guards_unfitted():
+    m = DBSCAN()
+    with pytest.raises(
+        RuntimeError, match=r"not fitted; call fit\(\)/train\(\) first"
+    ):
+        m.export_trace("/tmp/never.json")
+
+
+# ---------------------------------------------------------------------------
+# resource watermarks
+# ---------------------------------------------------------------------------
+
+
+def test_sampler_never_leaks_threads(blobs):
+    for _ in range(2):
+        DBSCAN(eps=0.4, min_samples=5, block=64).fit(blobs)
+        assert _no_sampler_threads()
+
+
+def test_resources_finite_on_all_routes(blobs):
+    import math
+
+    from pypardis_tpu.parallel import default_mesh
+
+    routes = {
+        "fused": DBSCAN(eps=0.4, min_samples=5, block=64,
+                        mesh=default_mesh(1)),
+        "kd_halo": DBSCAN(eps=0.4, min_samples=5, block=64),
+        "global_morton": DBSCAN(eps=0.4, min_samples=5, block=64,
+                                mode="global_morton",
+                                mesh=default_mesh(8)),
+    }
+    for name, model in routes.items():
+        res = model.fit(blobs).report()["resources"]
+        for key in ("peak_host_rss_bytes", "peak_device_bytes",
+                    "staging_pool_bytes", "samples"):
+            assert math.isfinite(float(res[key])), (name, key)
+        assert res["peak_host_rss_bytes"] > 0, name
+        assert res["samples"] >= 1, name
+
+
+def test_gm_ring_counters_surfaced_in_summary(blobs):
+    """ISSUE 6 satellite: ring traffic visible without a trace export."""
+    from pypardis_tpu.parallel import default_mesh, staging
+
+    # A warm gm_boundary cache (an earlier test fitting the same
+    # data/eps) would skip the exchange entirely — force the ring.
+    staging.clear()
+    m = DBSCAN(
+        eps=0.4, min_samples=5, block=64, mode="global_morton",
+        mesh=default_mesh(8),
+    ).fit(blobs)
+    ctr = m.report()["metrics"]["counters"]
+    assert ctr.get("gm.ring_bytes_sent", 0) > 0
+    assert ctr.get("gm.ring_tiles_kept", 0) > 0
+    assert "ring " in m.summary()
+
+
+# ---------------------------------------------------------------------------
+# bench_diff regression gate
+# ---------------------------------------------------------------------------
+
+
+def _run(args, **kw):
+    return subprocess.run(
+        [sys.executable] + args, cwd=REPO, capture_output=True,
+        text=True, **kw,
+    )
+
+
+def test_bench_diff_reproduces_r4_r5_noise_verdict():
+    """The PR 2 manual diagnosis, automated: overlapping raw sample
+    ranges -> 'noise', exit 0 — straight from the committed archives."""
+    p = _run([
+        "scripts/bench_diff.py", "--prior", "BENCH_r04.json",
+        "--current", "BENCH_r05.json", "--expect", "noise",
+    ])
+    assert p.returncode == 0, p.stderr
+    out = json.loads(p.stdout.strip().splitlines()[-1])
+    assert out["verdict"] == "noise"
+    dev = out["metrics"]["device"]
+    assert dev["ranges_overlap"] is True
+    assert dev["delta_best"] == pytest.approx(0.047, abs=0.01)
+
+
+def test_bench_diff_fails_on_synthetic_slowdown(tmp_path):
+    doc = json.load(open(os.path.join(REPO, "BENCH_r05.json")))
+    row = dict(doc["parsed"])
+    import re
+
+    samples = [
+        float(x) for x in re.search(
+            r"samples=\[([^\]]+)\]", doc["tail"]
+        ).group(1).split(",")
+    ]
+    row["samples_s"] = [round(s * 1.2, 4) for s in samples]
+    slow = tmp_path / "slow_row.json"
+    slow.write_text(json.dumps(row))
+    p = _run([
+        "scripts/bench_diff.py", "--prior", "BENCH_r04.json",
+        "--current", str(slow),
+    ])
+    assert p.returncode == 1, (p.stdout, p.stderr)
+    out = json.loads(p.stdout.strip().splitlines()[-1])
+    assert out["verdict"] == "regression"
+    assert out["metrics"]["device"]["ranges_overlap"] is False
+
+
+def test_bench_diff_annotate_mode(tmp_path):
+    """The bench-smoke pipe: a row with no matching archived metric is
+    annotated 'no_baseline' (exit 0) and passes --require-diff."""
+    row = {"metric": "points_per_sec_tiny_ci_geometry", "value": 1.0,
+           "unit": "points/sec/chip", "samples_s": [0.1, 0.11]}
+    p = _run(
+        ["scripts/bench_diff.py", "--annotate", "--baseline-dir", "."],
+        input=json.dumps(row),
+    )
+    assert p.returncode == 0, p.stderr
+    out = json.loads(p.stdout.strip().splitlines()[-1])
+    assert out["bench_diff"]["verdict"] == "no_baseline"
+
+
+# ---------------------------------------------------------------------------
+# check_bench_json schema extensions
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def bench_row(blobs):
+    model = DBSCAN(eps=0.4, min_samples=5, block=64).fit(blobs)
+    return {
+        "metric": "test_row", "value": 1.0, "unit": "points/sec/chip",
+        "telemetry": model.report(),
+    }
+
+
+def test_check_bench_json_accepts_report_with_resources(bench_row):
+    p = _run(["scripts/check_bench_json.py"], input=json.dumps(bench_row))
+    assert p.returncode == 0, p.stderr
+
+
+def test_check_bench_json_requires_resources(bench_row):
+    row = json.loads(json.dumps(bench_row))
+    del row["telemetry"]["resources"]
+    p = _run(["scripts/check_bench_json.py"], input=json.dumps(row))
+    assert p.returncode == 1
+    assert "resources" in p.stderr
+
+
+def test_check_bench_json_require_diff_flag(bench_row):
+    # Without the verdict field: --require-diff fails, plain mode passes.
+    p = _run(
+        ["scripts/check_bench_json.py", "--require-diff"],
+        input=json.dumps(bench_row),
+    )
+    assert p.returncode == 1 and "bench_diff" in p.stderr
+    row = json.loads(json.dumps(bench_row))
+    row["bench_diff"] = {"verdict": "noise"}
+    p = _run(
+        ["scripts/check_bench_json.py", "--require-diff"],
+        input=json.dumps(row),
+    )
+    assert p.returncode == 0, p.stderr
+    row["bench_diff"] = {"verdict": "regression"}
+    p = _run(
+        ["scripts/check_bench_json.py", "--require-diff"],
+        input=json.dumps(row),
+    )
+    assert p.returncode == 1 and "regression" in p.stderr

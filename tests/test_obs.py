@@ -226,7 +226,8 @@ def test_summary_one_screen(fitted_model):
     assert "2,000 pts x 4D" in s
     assert "halo_factor" in s and "pad_waste" in s
     assert "events:" in s
-    assert len(s.splitlines()) <= 8  # one screen, not a dump
+    assert "resources:" in s  # watermark line (ISSUE 6)
+    assert len(s.splitlines()) <= 9  # one screen, not a dump
 
 
 def test_report_compute_and_perf_contract_sections(fitted_model):
