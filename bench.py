@@ -38,9 +38,16 @@ def main():
     import jax
 
     n_chips = jax.device_count()
+    # Kernel precision under test (BENCH_PRECISION=mixed benches the
+    # banded fast-pass mode; labels are byte-identical to high by
+    # contract, so rows stay comparable across modes).
+    precision = os.environ.get("BENCH_PRECISION", "high")
 
     def run(data):
-        model = DBSCAN(eps=eps, min_samples=min_samples, block=2048)
+        model = DBSCAN(
+            eps=eps, min_samples=min_samples, block=2048,
+            precision=precision,
+        )
         labels = model.fit_predict(data)
         return labels, model
 
@@ -68,10 +75,19 @@ def main():
     run(Xd)  # device-path warm-up
     dev_reps = int(os.environ.get("BENCH_DEV_REPS", max(5, reps)))
     samples = []
+    band_stats = []
     for _ in range(dev_reps):
         t0 = time.perf_counter()
         labels, model = run(Xd)
         samples.append(time.perf_counter() - t0)
+        # Per-rep band stats (zeros off precision=mixed): archived raw
+        # like samples_s, so cross-round bench_diff verdicts on a mixed
+        # row can tell a band-fraction drift (data/layout change) from
+        # timing noise without rerunning.
+        band_stats.append([
+            int(model.metrics_.get("band_pairs", 0) or 0),
+            int(model.metrics_.get("rescored_tiles", 0) or 0),
+        ])
     dt = min(samples)
     pts_per_sec_chip = n / dt / n_chips
 
@@ -137,6 +153,12 @@ def main():
                 # question was undiagnosable from the archives alone).
                 "samples_s": [round(s, 4) for s in samples],
                 "host_samples_s": [round(s, 4) for s in host_samples],
+                # Kernel precision mode of this row and the raw
+                # per-rep [band_pairs, rescored_tiles] (all-zero off
+                # precision=mixed) — the mixed-mode analogue of the
+                # raw samples archive.
+                "precision_mode": precision,
+                "band_stats": band_stats,
                 # Relative cost of the always-flushing JSONL flight
                 # sink on this geometry (best-of-2 vs the best-of-N
                 # baseline; the ISSUE 6 acceptance bound is <= 2% at

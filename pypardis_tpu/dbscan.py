@@ -347,8 +347,9 @@ def _pad_and_run(
         def run_step(pb, _mr):
             packed = run_with_restage(be, pair_budget=pb)
             # In-band [total, budget] stats ride in the packed row's
-            # tail (the last entry is the kernel pass count).
-            return packed, packed[-3:-1], True
+            # tail (then the kernel pass count and the two mixed-mode
+            # band columns).
+            return packed, packed[-5:-3], True
 
         return run_ladders(run_step, budget_key, None, 1)[0]
 
@@ -373,7 +374,9 @@ def _pad_and_run(
         # The pipeline's host fetch has completed, so the input
         # transfer is long since consumed — safe to recycle the buffer.
         _staging_return(staged)
-    roots, core, total, _budget, passes = unpack_pipeline_result(packed)
+    roots, core, total, _budget, passes, band_pairs, rescored = (
+        unpack_pipeline_result(packed)
+    )
     from .ops.pallas_kernels import _norm_precision_mode, effective_tile
 
     reused, shipped = _dev_staging.fit_stats()
@@ -384,6 +387,11 @@ def _pad_and_run(
             effective_tile(block, cap, k, _norm_precision_mode(precision))
             or block
         ),
+        # Mixed-precision band telemetry (zeros off precision="mixed"):
+        # pairs whose fast-pass d^2 landed in the rescore band, and
+        # tile-pair visits re-run at high precision.
+        "band_pairs": int(band_pairs),
+        "rescored_tiles": int(rescored),
         # Layout-cache economy (route "pipeline_layout"): a warm repeat
         # fit reuses the sorted device arrays and ships nothing.
         "staged_bytes_reused": int(reused),
@@ -486,6 +494,15 @@ class DBSCAN:
             raise ValueError(
                 f"mode must be auto|kd|global_morton, got {mode!r}"
             )
+        # Construction-time validation (the sklearn input contract): a
+        # typo'd precision/backend/eps used to surface only when the
+        # first fit hit a jit trace or a kernel dispatch, as an opaque
+        # deep-stack error.  check_precision also canonicalizes
+        # jax.lax.Precision spellings to the mode strings, so report()
+        # params and cache keys are stable.
+        from .utils.validate import check_kernel_backend, check_precision
+
+        validate_params(eps, min_samples)
         self.eps = float(eps)
         self.min_samples = int(min_samples)
         self.metric = metric
@@ -493,8 +510,8 @@ class DBSCAN:
         self.split_method = split_method
         self.block = int(block)
         self.mesh = mesh
-        self.precision = precision
-        self.kernel_backend = kernel_backend
+        self.precision = check_precision(precision)
+        self.kernel_backend = check_kernel_backend(kernel_backend)
         self.merge = merge
         self.profile_dir = profile_dir
         # Owned-block clustering + edge-table merge on the sharded

@@ -99,7 +99,9 @@ def _peak_flops():
     return _PEAK_FLOPS_DEFAULT, "default"
 
 
-def _compute_section(metrics: Dict, phases: Dict, n_dims: int) -> Dict:
+def _compute_section(
+    metrics: Dict, phases: Dict, n_dims: int, precision=None
+) -> Dict:
     """Achieved-FLOP/s and MFU from the kernels' in-band pair stats.
 
     The tiled kernels' work model: every live (row, col) tile pair
@@ -113,14 +115,47 @@ def _compute_section(metrics: Dict, phases: Dict, n_dims: int) -> Dict:
     binding serial path), so the figure is per-chip.  All fields are
     always present and finite — 0.0 means the fit carried no pair
     telemetry (e.g. an empty dataset), never NaN.
+
+    Mixed-precision fields (always present; zero off
+    ``precision="mixed"``): band stats are PER-PASS quantities
+    measured on the counts pass — classification is deterministic per
+    (points, eps, layout), so every pass over the same live pairs
+    classifies identically and one measurement covers them all.
+    ``precision_mode`` is the canonical mode string; ``band_pairs``
+    counts pairs whose fast-pass d^2 landed in the rescore band (pairs
+    whose verdict REQUIRED the exact pass); ``band_fraction`` =
+    band_pairs / pairs examined per pass (live tile visits x block^2)
+    — the <5% acceptance gauge of ROADMAP item 3; ``rescored_pairs``
+    = rescored tile visits x block^2 (the extra high-precision FLOPs
+    the tile-granular rescore pays per pass) with
+    ``rescored_visit_fraction`` its per-visit rate.  MFU is reported
+    against BOTH peaks: ``mfu`` keeps its historical
+    denominator (the chip's bf16 matmul peak — the single-pass rate
+    mixed mode's bulk runs at), and ``mfu_f32_synth`` divides by
+    peak/3, the effective ceiling of the bf16_3x f32-synthesizing
+    ``high`` mode — the yardstick a mixed-vs-high MFU jump is measured
+    against.
     """
     pairs = int(metrics.get("live_pairs", 0) or 0)
     block = int(metrics.get("kernel_block", 0) or 0)
     passes = int(metrics.get("kernel_passes", 0) or 0)
+    band_pairs = int(metrics.get("band_pairs", 0) or 0)
+    rescored_tiles = int(metrics.get("rescored_tiles", 0) or 0)
     cluster_s = float(phases.get("cluster", 0.0) or 0.0)
     flops = float(pairs) * block * block * (n_dims + 2) * 2.0 * passes
     achieved = flops / cluster_s if cluster_s > 0 else 0.0
     peak, source = _peak_flops()
+    # Band stats are per-pass (counts-pass measurement), so the
+    # fraction denominators are one pass's visits, not passes x pairs.
+    visits = float(pairs)
+    try:
+        from ..ops.precision import norm_precision_mode
+
+        mode = norm_precision_mode(
+            "high" if precision is None else precision
+        )
+    except ValueError:
+        mode = str(precision)
     return {
         "live_pairs": pairs,
         "kernel_block": block,
@@ -130,6 +165,19 @@ def _compute_section(metrics: Dict, phases: Dict, n_dims: int) -> Dict:
         "peak_flops": peak,
         "peak_source": source,
         "mfu": round(achieved / peak, 8) if peak > 0 else 0.0,
+        "mfu_f32_synth": (
+            round(achieved / (peak / 3.0), 8) if peak > 0 else 0.0
+        ),
+        "precision_mode": mode,
+        "band_pairs": band_pairs,
+        "rescored_pairs": rescored_tiles * block * block,
+        "band_fraction": (
+            round(band_pairs / (visits * block * block), 8)
+            if visits * block > 0 else 0.0
+        ),
+        "rescored_visit_fraction": (
+            round(rescored_tiles / visits, 8) if visits > 0 else 0.0
+        ),
     }
 
 
@@ -278,7 +326,9 @@ def build_run_report(
         },
         "phases": phases,
         "sharding": sharding,
-        "compute": _compute_section(metrics, phases, n_dims),
+        "compute": _compute_section(
+            metrics, phases, n_dims, precision=params.get("precision")
+        ),
         "resources": resources,
         "devices": devices,
         "events": events,
@@ -386,13 +436,21 @@ def format_summary(report: Dict) -> str:
         )
     comp = report.get("compute", {})
     if comp.get("live_pairs", 0) > 0:
+        mixed_bit = ""
+        if comp.get("precision_mode") == "mixed":
+            mixed_bit = (
+                f", mixed: {comp.get('band_fraction', 0):.2%} of pairs "
+                f"in-band, "
+                f"{comp.get('rescored_visit_fraction', 0):.0%} of tile "
+                f"visits rescored"
+            )
         lines.append(
             f"  compute: {comp['live_pairs']:,} live pairs x "
             f"{comp['kernel_passes']} pass(es) @ block "
             f"{comp['kernel_block']} -> "
             f"{comp['achieved_flops_per_sec'] / 1e9:,.1f} GFLOP/s "
             f"(mfu {comp['mfu']:.2%} of {comp['peak_flops'] / 1e12:.0f} "
-            f"TFLOP/s {comp['peak_source']} peak)"
+            f"TFLOP/s {comp['peak_source']} peak{mixed_bit})"
         )
     srv = report.get("serving")
     if srv:

@@ -41,20 +41,28 @@ _PRECISIONS = {
 
 
 def _norm_precision(precision):
-    """MXU precision for the distance matmul.
+    """MXU precision for a SINGLE distance matmul.
 
     fp32 matmuls on TPU are synthesized from bfloat16 passes: ``high``
     (bf16_3x, ~fp32-accurate, 2x faster than ``highest``) is the default;
     ``highest`` is the exact fp32 fallback for adversarially scaled data.
+    Normalization delegates to the shared mode ladder
+    (:mod:`pypardis_tpu.ops.precision`) so the accepted surface cannot
+    drift between backends; ``"mixed"`` is a TWO-pass discipline and is
+    dispatched above this level — a mixed mode reaching a single dot is
+    a plumbing bug, reported as such.
     """
-    if isinstance(precision, jax.lax.Precision):
-        return precision
-    try:
-        return _PRECISIONS[str(precision).lower()]
-    except KeyError:
+    from .precision import norm_precision_mode
+
+    mode = norm_precision_mode(precision)
+    if mode == "mixed":
         raise ValueError(
-            f"precision must be one of {sorted(_PRECISIONS)}, got {precision!r}"
+            "precision='mixed' is a banded two-pass mode and cannot "
+            "select a single matmul precision; use neighbor_counts / "
+            "min_neighbor_label with precision='mixed' (internal "
+            "dispatch error if you did)"
         )
+    return _PRECISIONS[mode]
 
 
 def _norm_metric(metric) -> str:
@@ -107,20 +115,124 @@ def pairwise_sq_dists(
     return jnp.maximum(d2, 0.0)
 
 
+def _tile_d2_t(xi, yj, precision):
+    """(d, br) x (d, bc) transposed tiles → (br, bc) f32 squared
+    distances via the |x|^2+|y|^2-2xy matmul expansion at the given
+    single-dot precision."""
+    xx = jnp.sum(xi * xi, axis=0)[:, None]
+    yy = jnp.sum(yj * yj, axis=0)[None, :]
+    return xx + yy - 2.0 * jax.lax.dot_general(
+        xi, yj, (((0,), (0,)), ((), ())),
+        precision=_norm_precision(precision),
+        preferred_element_type=jnp.float32,
+    )
+
+
 def _tile_adjacency_t(xi, yj, eps, metric, precision):
     """(d, br) x (d, bc) transposed tiles → (br, bc) bool: within eps."""
     if metric == "euclidean":
-        xx = jnp.sum(xi * xi, axis=0)[:, None]
-        yy = jnp.sum(yj * yj, axis=0)[None, :]
-        d2 = xx + yy - 2.0 * jax.lax.dot_general(
-            xi, yj, (((0,), (0,)), ((), ())),
-            precision=_norm_precision(precision),
-            preferred_element_type=jnp.float32,
-        )
-        return d2 <= eps * eps
+        return _tile_d2_t(xi, yj, precision) <= eps * eps
     # cityblock: no matmul decomposition; broadcast |xi - yj| sum on VPU.
     d1 = jnp.sum(jnp.abs(xi[:, :, None] - yj[:, None, :]), axis=0)
     return d1 <= eps
+
+
+def _fast_is_exact() -> bool:
+    """Whether Precision.DEFAULT already IS the exact f32 dot on this
+    backend — true on CPU, where XLA ignores the precision config and
+    every dot runs one f32 pass.  The mixed rescore is then provably a
+    bitwise no-op (same dot, same schedule), so the XLA kernels skip
+    dispatching it; ``rescored_tiles`` still counts the tiles whose
+    pairs REQUIRED exact verdicts (the classification is backend-
+    independent, which keeps CI band telemetry predictive of the
+    chip).  GPU stays conservative: DEFAULT may run TF32 there."""
+    return jax.default_backend() == "cpu"
+
+
+def _nmax_t(pts, valid):
+    """Masked per-tile norm maximum: max Euclidean point norm of the
+    (d, block) tile over ``valid`` slots."""
+    return jnp.sqrt(jnp.max(jnp.where(
+        valid, jnp.sum(pts * pts, axis=0), 0.0
+    )))
+
+
+def _mixed_band_t(xi, yj, c, row_valid, col_valid):
+    """The mixed-mode classification band for one tile pair: the
+    shared bf16 fast-pass bound at the masked RECENTRED norm maxima
+    (padding slots — zeros, which sit at global-frame magnitude after
+    recentring — are masked out of the bounds) plus the global-frame
+    slack of the uncentred high rescore."""
+    from .precision import band_halfwidth, exact_slack
+
+    return band_halfwidth(
+        _nmax_t(xi - c, row_valid), _nmax_t(yj - c, col_valid)
+    ) + exact_slack(
+        _nmax_t(xi, row_valid), _nmax_t(yj, col_valid)
+    )
+
+
+def _tile_adjacency_mixed_t(xi, yj, eps2, c, row_valid, col_valid,
+                            collect_stats=True):
+    """Banded mixed-precision adjacency for one tile pair.
+
+    On a lossy-DEFAULT backend (TPU): the fast pass recentres both
+    tiles on ``c`` (the row tile's box center, (d, 1)) so bf16 operand
+    magnitudes are tile-local — the same trick the Mosaic kernels
+    apply — and classifies every pair against ``eps2 +- band``
+    (:func:`_mixed_band_t`).  Only a tile containing an in-band
+    ("ambiguous") valid pair recomputes at ``high`` (bf16_3x, in the
+    ORIGINAL frame — bitwise the same arithmetic the plain
+    ``precision="high"`` pass runs) and uses those distances for the
+    WHOLE tile.  Out-of-band fast verdicts provably match the high
+    verdicts (:mod:`pypardis_tpu.ops.precision`), so the returned
+    adjacency is byte-identical to ``_tile_adjacency_t(...,
+    precision="high")`` on every valid element — the exactness
+    contract of ``precision="mixed"``.
+
+    On an exact-DEFAULT backend (CPU — :func:`_fast_is_exact`): the
+    single uncentred DEFAULT dot already IS the high pass bitwise, so
+    verdicts come straight from it and the band machinery runs only
+    when ``collect_stats`` asks for telemetry — classification is
+    identical either way, the pair verdicts never depend on it.
+
+    ``collect_stats``: band stats are deterministic per (points, eps,
+    layout) — every pass over the same live pairs classifies them
+    identically — so the drivers measure them ONCE, on the counts
+    pass, and the propagation passes skip the bookkeeping
+    (``collect_stats=False``); on lossy backends those passes still
+    compute the in-band test, because it gates their rescore.
+
+    Returns ``(adj & col_valid, n_band_pairs, rescored)``; stats and
+    the rescore decision are masked to valid rows x valid cols, so
+    padding slots can neither inflate the band telemetry nor force a
+    rescore.
+    """
+    stat_mask = row_valid[:, None] & col_valid[None, :]
+    if _fast_is_exact():
+        d2 = _tile_d2_t(xi, yj, "default")  # == the high pass, bitwise
+        n_band = resc = jnp.int32(0)
+        if collect_stats:
+            band = _mixed_band_t(xi, yj, c, row_valid, col_valid)
+            ambig = (jnp.abs(d2 - eps2) <= band) & stat_mask
+            n_band = jnp.sum(ambig, dtype=jnp.int32)
+            resc = (n_band > 0).astype(jnp.int32)
+        return (d2 <= eps2) & col_valid[None, :], n_band, resc
+
+    d2f = _tile_d2_t(xi - c, yj - c, "default")
+    band = _mixed_band_t(xi, yj, c, row_valid, col_valid)
+    ambig = (jnp.abs(d2f - eps2) <= band) & stat_mask
+    if collect_stats:
+        n_band = jnp.sum(ambig, dtype=jnp.int32)
+        need = n_band > 0
+    else:
+        n_band = jnp.int32(0)
+        need = jnp.any(ambig)
+    d2 = jax.lax.cond(
+        need, lambda: _tile_d2_t(xi, yj, "high"), lambda: d2f
+    )
+    resc = need.astype(jnp.int32) if collect_stats else jnp.int32(0)
+    return (d2 <= eps2) & col_valid[None, :], n_band, resc
 
 
 def _tiles_t(points, mask, block, layout):
@@ -520,33 +632,64 @@ def neighbor_counts(
     columns still cover all N — the owner-computes primitive: owned
     slots occupy the slab prefix, and their counts need halo columns
     as evidence without ever counting the halo rows themselves.
+
+    With ``precision="mixed"`` the return widens to ``(counts,
+    band_stats)`` — band_stats a (2,) int32 ``[band_pairs,
+    rescored_tiles]`` from the banded single-bf16-pass classification
+    (:func:`_tile_adjacency_mixed_t`); counts are byte-identical to
+    ``precision="high"``.
     """
+    from .precision import norm_precision_mode
+
     metric = _norm_metric(metric)
     layout = _norm_layout(layout)
+    mixed = norm_precision_mode(precision) == "mixed"
+    if mixed and metric != "euclidean":
+        raise ValueError(
+            "precision='mixed' supports only the euclidean metric (the "
+            "banded pass is a matmul discipline); use 'high'/'highest'"
+        )
     nt, pts, msk = _tiles_t(points, mask, block, layout)
     lo, hi = tile_bounds(pts, msk)
     rt = nt if row_tiles is None else min(row_tiles, nt)
+    eps2 = jnp.float32(eps) ** 2
 
     def row_tile(xi, mi, lo_i, hi_i):
         skip = tile_skip_mask(lo_i, hi_i, lo, hi, eps, metric)
+        ctr = (0.5 * (lo_i + hi_i))[:, None]
 
-        def col_step(acc, jc):
-            def compute(a):
+        def col_step(carry, jc):
+            def compute(c):
+                a, bp, rs = c
                 yj, mj = pts[jc], msk[jc]
-                adj = _tile_adjacency_t(xi, yj, eps, metric, precision)
-                adj &= mj[None, :]
-                return a + jnp.sum(adj, axis=1, dtype=jnp.int32)
+                if mixed:
+                    adj, n_band, resc = _tile_adjacency_mixed_t(
+                        xi, yj, eps2, ctr, mi, mj,
+                    )
+                else:
+                    adj = _tile_adjacency_t(xi, yj, eps, metric, precision)
+                    adj &= mj[None, :]
+                    n_band = resc = jnp.int32(0)
+                return (
+                    a + jnp.sum(adj, axis=1, dtype=jnp.int32),
+                    bp + n_band, rs + resc,
+                )
 
-            return jax.lax.cond(skip[jc], lambda a: a, compute, acc), None
+            return jax.lax.cond(skip[jc], lambda c: c, compute, carry), None
 
-        acc0 = jnp.zeros((block,), jnp.int32)
-        counts, _ = jax.lax.scan(col_step, acc0, jnp.arange(nt))
-        return jnp.where(mi, counts, 0)
+        acc0 = (
+            jnp.zeros((block,), jnp.int32), jnp.int32(0), jnp.int32(0)
+        )
+        (counts, bp, rs), _ = jax.lax.scan(col_step, acc0, jnp.arange(nt))
+        return jnp.where(mi, counts, 0), bp, rs
 
-    counts = jax.lax.map(
+    counts, bps, rss = jax.lax.map(
         lambda args: row_tile(*args), (pts[:rt], msk[:rt], lo[:rt], hi[:rt])
     )
-    return counts.reshape(-1)
+    counts = counts.reshape(-1)
+    if not mixed:
+        return counts
+    return counts, jnp.stack([jnp.sum(bps), jnp.sum(rss)])
 
 
 @functools.partial(
@@ -584,41 +727,77 @@ def min_neighbor_label(
     skipped outright.  Halo slots then exchange labels with owned slots
     only — the owner-computes adjacency rule, where halo-halo edges are
     each some partition's owned-halo edge and are recovered there.
+
+    With ``precision="mixed"`` the return widens to ``(best,
+    band_stats)`` — see :func:`neighbor_counts`; labels are
+    byte-identical to ``precision="high"``.
     """
+    from .precision import norm_precision_mode
+
     metric = _norm_metric(metric)
     layout = _norm_layout(layout)
+    mixed = norm_precision_mode(precision) == "mixed"
+    if mixed and metric != "euclidean":
+        raise ValueError(
+            "precision='mixed' supports only the euclidean metric (the "
+            "banded pass is a matmul discipline); use 'high'/'highest'"
+        )
     nt, pts, smsk = _tiles_t(points, src_mask, block, layout)
     lab = labels.reshape(nt, block)
     lo, hi = tile_bounds(pts, smsk)
     if row_mask is None:
         # Full coverage: row bounds over every row (padding included —
         # only a pruning-tightness cost, never a correctness one).
-        row_lo, row_hi = tile_bounds(pts, jnp.ones_like(smsk))
+        rmsk = jnp.ones_like(smsk)
     else:
-        row_lo, row_hi = tile_bounds(pts, row_mask.reshape(nt, block))
+        rmsk = row_mask.reshape(nt, block)
+    row_lo, row_hi = tile_bounds(pts, rmsk)
     col_ids = jnp.arange(nt, dtype=jnp.int32)
+    eps2 = jnp.float32(eps) ** 2
 
-    def row_tile(ri, xi, lo_i, hi_i):
+    def row_tile(ri, xi, mi, lo_i, hi_i):
         skip = tile_skip_mask(lo_i, hi_i, lo, hi, eps, metric)
         if owned_tiles is not None:
             skip = skip | ((ri >= owned_tiles) & (col_ids >= owned_tiles))
+        ctr = (0.5 * (lo_i + hi_i))[:, None]
 
-        def col_step(acc, jc):
-            def compute(a):
+        def col_step(carry, jc):
+            def compute(c):
+                a, bp, rs = c
                 yj, mj, lj = pts[jc], smsk[jc], lab[jc]
-                adj = _tile_adjacency_t(xi, yj, eps, metric, precision)
-                adj &= mj[None, :]
+                if mixed:
+                    # Propagation passes skip the band bookkeeping —
+                    # stats are deterministic per pass and the counts
+                    # pass already measured them (on lossy backends
+                    # the in-band test still runs: it gates the
+                    # rescore).
+                    adj, n_band, resc = _tile_adjacency_mixed_t(
+                        xi, yj, eps2, ctr, mi, mj, collect_stats=False,
+                    )
+                else:
+                    adj = _tile_adjacency_t(xi, yj, eps, metric, precision)
+                    adj &= mj[None, :]
+                    n_band = resc = jnp.int32(0)
                 cand = jnp.where(adj, lj[None, :], _INT_INF)
-                return jnp.minimum(a, jnp.min(cand, axis=1))
+                return (
+                    jnp.minimum(a, jnp.min(cand, axis=1)),
+                    bp + n_band, rs + resc,
+                )
 
-            return jax.lax.cond(skip[jc], lambda a: a, compute, acc), None
+            return jax.lax.cond(skip[jc], lambda c: c, compute, carry), None
 
-        acc0 = jnp.full((block,), _INT_INF, jnp.int32)
-        best, _ = jax.lax.scan(col_step, acc0, jnp.arange(nt))
-        return best
+        acc0 = (
+            jnp.full((block,), _INT_INF, jnp.int32),
+            jnp.int32(0), jnp.int32(0),
+        )
+        (best, bp, rs), _ = jax.lax.scan(col_step, acc0, jnp.arange(nt))
+        return best, bp, rs
 
-    best = jax.lax.map(
+    best, bps, rss = jax.lax.map(
         lambda args: row_tile(*args),
-        (jnp.arange(nt, dtype=jnp.int32), pts, row_lo, row_hi),
+        (jnp.arange(nt, dtype=jnp.int32), pts, rmsk, row_lo, row_hi),
     )
-    return best.reshape(-1)
+    best = best.reshape(-1)
+    if not mixed:
+        return best
+    return best, jnp.stack([jnp.sum(bps), jnp.sum(rss)])

@@ -29,6 +29,29 @@ from .distances import min_neighbor_label, neighbor_counts
 _INT_INF = jnp.iinfo(jnp.int32).max
 
 
+def _is_mixed(precision) -> bool:
+    from .precision import norm_precision_mode
+
+    return norm_precision_mode(precision) == "mixed"
+
+
+def _band_zeros():
+    return jnp.zeros(2, jnp.int32)
+
+
+def _split_band(out, mixed: bool):
+    """Normalize a kernel result to ``(result, band_stats)``.
+
+    The kernel entry points return ``(result, (2,) int32)`` under
+    ``precision="mixed"`` and the bare result otherwise; every
+    band-stats consumer in this module goes through this one helper so
+    the convention cannot be half-applied.
+    """
+    if mixed:
+        return out
+    return out, _band_zeros()
+
+
 def resolve_backend(
     backend: str, metric: str, n: int = 0, block: int = 1,
     d: int = 2, precision: str = "high",
@@ -164,10 +187,16 @@ def dbscan_fixed_size(
     """Validating entry point for :func:`_dbscan_fixed_size_jit` (the
     jitted body, where ``eps`` may be a tracer and cannot be checked).
     Concrete hyperparameters reject here — ``eps=-0.3`` used to behave
-    exactly like ``eps=0.3`` through the squared-distance kernels."""
-    from ..utils.validate import validate_params
+    exactly like ``eps=0.3`` through the squared-distance kernels, and
+    a typo'd ``precision``/``backend`` used to surface as an opaque
+    error from deep inside the jit trace."""
+    from ..utils.validate import (
+        check_kernel_backend, check_precision, validate_params,
+    )
 
     validate_params(eps, min_samples)
+    check_precision(precision)
+    check_kernel_backend(backend)
     return _dbscan_fixed_size_jit(
         points, eps, min_samples, mask, metric=metric, block=block,
         max_rounds=max_rounds, precision=precision, backend=backend,
@@ -210,8 +239,15 @@ def _dbscan_fixed_size_jit(
     ``block``; ``mask``: (N,) bool validity.  Returns ``(labels, core,
     pair_stats)``:
 
-    * ``pair_stats``: (3,) int32 ``[live_pairs_total, budget,
-      kernel_passes]``.  On the Pallas path, the first two come from
+    * ``pair_stats``: (5,) int32 ``[live_pairs_total, budget,
+      kernel_passes, band_pairs, rescored_tiles]`` (width pinned by
+      ``ops.precision.PAIR_STATS_WIDTH``).  The last two are the
+      ``precision="mixed"`` COUNTS-PASS band telemetry (pairs whose
+      fast-pass d^2 landed inside the rescore band, and tile-pair
+      visits marked for the ``high`` rescore; classification is
+      deterministic per pass, so one pass's measurement covers all —
+      the propagation passes skip the bookkeeping) and are zero on
+      every other precision.  On the Pallas path, the first two come from
       the tile-pair extraction: when ``total > budget`` the labels are
       INVALID — pairs were dropped — and the caller must rerun with
       ``pair_budget >= total`` (``pair_budget`` is static; the
@@ -238,6 +274,7 @@ def _dbscan_fixed_size_jit(
         raise ValueError(f"layout must be 'nd' or 'dn', got {layout!r}")
     n = points.shape[0] if layout == "nd" else points.shape[1]
     d = points.shape[1] if layout == "nd" else points.shape[0]
+    mixed = _is_mixed(precision)
     if resolve_backend(backend, metric, n, block, d, precision) == "pallas":
         from .pallas_kernels import (
             _check_mosaic_tile,
@@ -308,7 +345,7 @@ def _dbscan_fixed_size_jit(
                 jnp.int32(0 if pair_budget is None else pair_budget),
             ]
         )
-    counts = count_fn(points, eps, mask)
+    counts, band = _split_band(count_fn(points, eps, mask), mixed)
     # A valid point always counts itself (distance 0 <= eps), but the
     # f32 |x|^2+|y|^2-2xy expansion can compute the self-pair a few ULP
     # above 0 and miss it once eps^2 sinks below that noise floor
@@ -319,12 +356,17 @@ def _dbscan_fixed_size_jit(
     idx = jnp.arange(n, dtype=jnp.int32)
     f0 = jnp.where(core, idx, _INT_INF)
 
+    def minlab_band(f):
+        return _split_band(
+            minlab_fn(points, f, eps, core, row_mask=mask), mixed
+        )
+
     def cond(state):
-        f, g, changed, rounds = state
+        f, g, changed, rounds, _band = state
         return changed & (rounds < max_rounds)
 
     def body(state):
-        f, _, _, rounds = state
+        f, _, _, rounds, bacc = state
         # Hook: min label among core eps-neighbors (self included).
         # Rows cover the full valid mask (not just core) so the final
         # round's g doubles as the border-attach pass: at convergence g
@@ -335,14 +377,14 @@ def _dbscan_fixed_size_jit(
         # Morton-sorted layout (noise sits near its cluster, and column
         # tiles are core-masked, so noise-only row tiles still prune
         # everything) and repaid by dropping the whole post-loop pass.
-        g = minlab_fn(points, f, eps, core, row_mask=mask)
+        g, b = minlab_band(f)
         f_new = jnp.where(core, jnp.minimum(f, g), f)
         # Shortcut: chase pointers to the current root.
         f_new = _pointer_jump(f_new, core)
-        return f_new, g, jnp.any(f_new != f), rounds + 1
+        return f_new, g, jnp.any(f_new != f), rounds + 1, bacc + b
 
-    f, g, changed, rounds = jax.lax.while_loop(
-        cond, body, (f0, f0, jnp.bool_(True), 0)
+    f, g, changed, rounds, band = jax.lax.while_loop(
+        cond, body, (f0, f0, jnp.bool_(True), 0, band)
     )
 
     # Border points: nearest-core-label attach; noise: no core neighbor.
@@ -351,10 +393,10 @@ def _dbscan_fixed_size_jit(
     # vmap — the multi-partition-per-device layout — cond lowers to
     # select and both branches run, costing what the old unconditional
     # pass did; no worse, and the common one-partition path wins.)
-    border = jax.lax.cond(
+    border, b_border = jax.lax.cond(
         changed,
-        lambda: minlab_fn(points, f, eps, core, row_mask=mask),
-        lambda: g,
+        lambda: minlab_band(f),
+        lambda: (g, _band_zeros()),
     )
     labels = jnp.where(
         core, f, jnp.where(mask & (border != _INT_INF), border, -1)
@@ -362,7 +404,9 @@ def _dbscan_fixed_size_jit(
     # Tiled passes executed: the counts pass, one minlab per round, and
     # the border recompute when the loop exited at max_rounds.
     passes = 1 + rounds + changed.astype(jnp.int32)
-    pair_stats = jnp.concatenate([pair_stats[:2], passes[None]])
+    pair_stats = jnp.concatenate(
+        [pair_stats[:2], passes[None], band + b_border]
+    )
     return labels, core, pair_stats
 
 
@@ -481,8 +525,11 @@ def oc_counts(
 
     ``owned`` (static) is the slab prefix length holding owned slots;
     halo columns contribute to the counts (exactness under the 2*eps
-    halo) but no halo row is ever counted.  Returns (owned,) bool.
+    halo) but no halo row is ever counted.  Returns (owned,) bool —
+    widened to ``(core, band_stats)`` under ``precision="mixed"`` (the
+    kernel convention, see :func:`neighbor_counts`).
     """
+    mixed = _is_mixed(precision)
     if kind == "pallas":
         from .pallas_kernels import (
             _norm_precision_mode, _pallas_block, neighbor_counts_pallas,
@@ -492,19 +539,30 @@ def oc_counts(
         d = points.shape[1] if layout == "nd" else points.shape[0]
         pb = _pallas_block(block, n, d, _norm_precision_mode(precision))
         nt, ont = n // pb, owned // pb
-        counts = neighbor_counts_pallas(
-            points, eps, mask, block=block, precision=precision,
-            layout=layout,
-            pairs=_oc_sorted_pairs(pairs, pairs[0] < ont, nt),
-        )[:owned]
+        counts, band = _split_band(
+            neighbor_counts_pallas(
+                points, eps, mask, block=block, precision=precision,
+                layout=layout,
+                pairs=_oc_sorted_pairs(pairs, pairs[0] < ont, nt),
+            ),
+            mixed,
+        )
+        counts = counts[:owned]
     else:
-        counts = neighbor_counts(
-            points, eps, mask, metric=metric, block=block,
-            precision=precision, layout=layout, row_tiles=owned // block,
+        counts, band = _split_band(
+            neighbor_counts(
+                points, eps, mask, metric=metric, block=block,
+                precision=precision, layout=layout,
+                row_tiles=owned // block,
+            ),
+            mixed,
         )
     # Same self-count clamp as dbscan_fixed_size: a valid point is
     # always within eps of itself, whatever the f32 expansion says.
-    return (jnp.maximum(counts, 1) >= min_samples) & mask[:owned]
+    core = (jnp.maximum(counts, 1) >= min_samples) & mask[:owned]
+    if mixed:
+        return core, band
+    return core
 
 
 def oc_propagate(
@@ -521,8 +579,10 @@ def oc_propagate(
     provably too weak — a bridging halo point must link EVERY adjacent
     owned cluster).  Returns ``(labels, passes)``: per-slot root local
     indices (-1 noise; halo slots carry their edge-table labels), and
-    the number of minlab passes executed.
+    the number of minlab passes executed — widened to ``(labels,
+    passes, band_stats)`` under ``precision="mixed"``.
     """
+    mixed = _is_mixed(precision)
     n = points.shape[0] if layout == "nd" else points.shape[1]
     if kind == "pallas":
         from .pallas_kernels import (
@@ -547,32 +607,57 @@ def oc_propagate(
             owned_tiles=owned // block,
         )
 
+    def minlab_band(f):
+        return _split_band(
+            minlab_fn(points, f, eps, core_all, row_mask=mask), mixed
+        )
+
     idx = jnp.arange(n, dtype=jnp.int32)
     f0 = jnp.where(core_all, idx, _INT_INF)
 
     def cond(state):
-        f, g, changed, rounds = state
+        f, g, changed, rounds, _band = state
         return changed & (rounds < max_rounds)
 
     def body(state):
-        f, _, _, rounds = state
-        g = minlab_fn(points, f, eps, core_all, row_mask=mask)
+        f, _, _, rounds, bacc = state
+        g, b = minlab_band(f)
         f_new = jnp.where(core_all, jnp.minimum(f, g), f)
         f_new = _pointer_jump(f_new, core_all)
-        return f_new, g, jnp.any(f_new != f), rounds + 1
+        return f_new, g, jnp.any(f_new != f), rounds + 1, bacc + b
 
-    f, g, changed, rounds = jax.lax.while_loop(
-        cond, body, (f0, f0, jnp.bool_(True), 0)
+    f, g, changed, rounds, band = jax.lax.while_loop(
+        cond, body, (f0, f0, jnp.bool_(True), 0, _band_zeros())
     )
-    border = jax.lax.cond(
+    border, b_border = jax.lax.cond(
         changed,
-        lambda: minlab_fn(points, f, eps, core_all, row_mask=mask),
-        lambda: g,
+        lambda: minlab_band(f),
+        lambda: (g, _band_zeros()),
     )
     labels = jnp.where(
         core_all, f, jnp.where(mask & (border != _INT_INF), border, -1)
     ).astype(jnp.int32)
-    return labels, rounds + changed.astype(jnp.int32)
+    passes = rounds + changed.astype(jnp.int32)
+    if mixed:
+        return labels, passes, band + b_border
+    return labels, passes
+
+
+def oc_counts_banded(*args, **kw):
+    """:func:`oc_counts` with a UNIFORM ``(core, band_stats)`` return
+    on every precision — the distributed drivers call this so their
+    pair-stats rows always carry the (possibly zero) band columns."""
+    out = oc_counts(*args, **kw)
+    return _split_band(out, _is_mixed(kw.get("precision", "high")))
+
+
+def oc_propagate_banded(*args, **kw):
+    """:func:`oc_propagate` with a uniform ``(labels, passes,
+    band_stats)`` return on every precision."""
+    out = oc_propagate(*args, **kw)
+    if _is_mixed(kw.get("precision", "high")):
+        return out
+    return out[0], out[1], _band_zeros()
 
 
 # ---------------------------------------------------------------------------
@@ -613,15 +698,18 @@ def _prepare_counts(points, eps, min_samples, mask, pairs, *, block,
     from .pallas_kernels import neighbor_counts_pallas
 
     n = points.shape[0] if layout == "nd" else points.shape[1]
-    counts = neighbor_counts_pallas(
-        points, eps, mask, block=block, precision=precision, layout=layout,
-        pairs=pairs,
+    counts, band = _split_band(
+        neighbor_counts_pallas(
+            points, eps, mask, block=block, precision=precision,
+            layout=layout, pairs=pairs,
+        ),
+        _is_mixed(precision),
     )
     # Same self-count clamp as dbscan_fixed_size (a valid point is
     # always within eps of itself, whatever the f32 expansion says).
     core = (jnp.maximum(counts, 1) >= min_samples) & mask
     f0 = jnp.where(core, jnp.arange(n, dtype=jnp.int32), _INT_INF)
-    return core, f0
+    return core, f0, band
 
 
 _compiled_prepare_keys: set = set()
@@ -662,14 +750,14 @@ def dbscan_prepare_pallas(
     )
     if first:
         _np.asarray(pair_stats)
-    core, f0 = _prepare_counts(
+    core, f0, band = _prepare_counts(
         points, eps, min_samples, mask, pairs, block=block,
         precision=precision, layout=layout,
     )
     if first:
         _np.asarray(core[:1])
         _compiled_prepare_keys.add(key)
-    return pairs, pair_stats, core, f0
+    return pairs, pair_stats, core, f0, band
 
 
 @functools.partial(
@@ -690,29 +778,35 @@ def dbscan_rounds_pallas(
     each call stays seconds-long (bounded by k passes), far below the
     worker watchdog that motivates host stepping in the first place.
 
-    Returns ``(f, g, changed)``: ``changed`` False means the LAST
-    executed round was a fixpoint — ``g`` is then the valid
+    Returns ``(f, g, changed, band_stats)``: ``changed`` False means
+    the LAST executed round was a fixpoint — ``g`` is then the valid
     border-attach pass (min root among core eps-neighbors at the
-    converged labels).
+    converged labels); ``band_stats`` accumulates this call's mixed-
+    precision band telemetry (zeros on other precisions).
     """
     from .pallas_kernels import min_neighbor_label_pallas
 
+    mixed = _is_mixed(precision)
+
     def body(state):
-        f, _g, _changed, i = state
-        g = min_neighbor_label_pallas(
-            points, f, eps, core, block=block, precision=precision,
-            layout=layout, row_mask=mask, pairs=(rows, cols),
+        f, _g, _changed, i, bacc = state
+        g, b = _split_band(
+            min_neighbor_label_pallas(
+                points, f, eps, core, block=block, precision=precision,
+                layout=layout, row_mask=mask, pairs=(rows, cols),
+            ),
+            mixed,
         )
         f_new = jnp.where(core, jnp.minimum(f, g), f)
         f_new = _pointer_jump(f_new, core)
-        return f_new, g, jnp.any(f_new != f), i + 1
+        return f_new, g, jnp.any(f_new != f), i + 1, bacc + b
 
-    f, g, changed, _ = jax.lax.while_loop(
+    f, g, changed, _, band = jax.lax.while_loop(
         lambda st: st[2] & (st[3] < k_rounds),
         body,
-        (f, f, jnp.bool_(True), 0),
+        (f, f, jnp.bool_(True), 0, _band_zeros()),
     )
-    return f, g, changed
+    return f, g, changed, band
 
 
 @functools.partial(
@@ -721,12 +815,16 @@ def dbscan_rounds_pallas(
 def dbscan_border_pallas(
     points, f, eps, core, mask, rows, cols, *, block, precision, layout,
 ):
-    """The final border-attach pass for a non-converged exit."""
+    """The final border-attach pass for a non-converged exit.
+    Returns ``(border, band_stats)`` uniformly."""
     from .pallas_kernels import min_neighbor_label_pallas
 
-    return min_neighbor_label_pallas(
-        points, f, eps, core, block=block, precision=precision,
-        layout=layout, row_mask=mask, pairs=(rows, cols),
+    return _split_band(
+        min_neighbor_label_pallas(
+            points, f, eps, core, block=block, precision=precision,
+            layout=layout, row_mask=mask, pairs=(rows, cols),
+        ),
+        _is_mixed(precision),
     )
 
 

@@ -85,23 +85,12 @@ _INT_INF = jnp.iinfo(jnp.int32).max
 # eps, so empty tiles always prune.
 BIG = np.float32(2e19)  # numpy scalar: trace-inert at import time
 
-_PRECISION_MODES = ("default", "high", "highest")
-
-
-def _norm_precision_mode(precision) -> str:
-    """Normalize to one of the kernel's static precision modes."""
-    if isinstance(precision, jax.lax.Precision):
-        return {
-            jax.lax.Precision.DEFAULT: "default",
-            jax.lax.Precision.HIGH: "high",
-            jax.lax.Precision.HIGHEST: "highest",
-        }[precision]
-    p = str(precision).lower()
-    if p not in _PRECISION_MODES:
-        raise ValueError(
-            f"precision must be one of {_PRECISION_MODES}, got {precision!r}"
-        )
-    return p
+# One normalizer for BOTH backends (pypardis_tpu.ops.precision) — the
+# kernel name is kept for its existing callers.
+from .precision import (  # noqa: E402  (import placement is historical)
+    band_halfwidth as _band_halfwidth,
+    norm_precision_mode as _norm_precision_mode,
+)
 
 
 def _dot_t(a, b, mode):
@@ -152,8 +141,66 @@ def _first_visit(rows_ref):
     return (p == 0) | (rows_ref[p] != prev)
 
 
+def _mixed_classify(x, y, c, eps2, src_valid):
+    """Banded classification for one Mosaic tile pair.
+
+    One bf16 pass (``"default"`` dot of the augmented recentred
+    operands) puts every pair definitely-in, definitely-out, or
+    in-band against ``eps2 +- band`` — the band from the shared bf16
+    error bound (:func:`pypardis_tpu.ops.precision.band_halfwidth`)
+    at the tiles' recentred NORM maxima (the source side masked by
+    ``src_valid``, a (block, 1) validity column, so sentinel/pad slots
+    cannot blow the bound up to their global-frame magnitude; the
+    output side has no in-kernel mask — a pad-bearing row tile's
+    looser band only costs extra rescores, never correctness).
+    Returns ``(d2f, xa, ya, n_band_pairs, need_rescore)``: a tile
+    containing an in-band valid pair must emit verdicts from a
+    bf16_3x (``"high"``) recompute of the whole tile — the callers
+    guard that dot behind ``pl.when(need)`` so a clean tile really
+    does run at the single-pass bf16 peak.  The rescore shares this
+    recentred frame (it IS the plain ``"high"`` kernel arithmetic),
+    so out-of-band fast verdicts provably match it and the combined
+    output is byte-identical to a full ``"high"`` run.
+    """
+    xa = _aug_out(x, c)
+    ya = _aug_src(y, c)
+    d2f = _dot_t(ya, xa, "default")
+    xc = x - c
+    yc = y - c
+    # keepdims reductions: Mosaic prefers >=2-D intermediates (the
+    # same discipline as _aug_out/_aug_src).
+    nx = jnp.sqrt(jnp.max(jnp.sum(xc * xc, axis=0, keepdims=True)))
+    ny = jnp.sqrt(jnp.max(jnp.where(
+        jnp.transpose(src_valid, (1, 0)),
+        jnp.sum(yc * yc, axis=0, keepdims=True),
+        0.0,
+    )))
+    band = _band_halfwidth(nx, ny)
+    ambig = (jnp.abs(d2f - eps2) <= band) & src_valid
+    n_band = jnp.sum(ambig, dtype=jnp.int32)
+    return d2f, xa, ya, n_band, n_band > 0
+
+
+def _stats_init(stats_ref, block):
+    """Zero the per-call band-stats block on the first grid step."""
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        stats_ref[0] = jnp.zeros_like(stats_ref[0])
+
+
+def _stats_add(stats_ref, block, n_band, rescored):
+    """Accumulate ``[band_pairs, rescored_tiles]`` into slots 0/1 of
+    the (1, block) stats block (vector add — Mosaic-friendlier than a
+    scalar VMEM store)."""
+    iota = jax.lax.broadcasted_iota(jnp.int32, (1, block), 1)
+    stats_ref[0] += (
+        jnp.where(iota == 0, n_band, 0)
+        + jnp.where(iota == 1, rescored, 0)
+    )
+
+
 def _count_pairs_kernel(rows_ref, cols_ref, eps2_ref, c_ref, x_ref, y_ref,
-                        m_ref, out_ref, *, mode, nt):
+                        m_ref, out_ref, stats_ref=None, *, mode, nt):
     eps2 = eps2_ref[0]
     # Recentre the pair on the output tile's box center: operand
     # magnitudes become tile-local, keeping the matmul expansion's
@@ -163,6 +210,8 @@ def _count_pairs_kernel(rows_ref, cols_ref, eps2_ref, c_ref, x_ref, y_ref,
     # pl.when branch is invisible to the Pallas interpreter's grid env.
     real = rows_ref[pl.program_id(0)] < nt
     first = _first_visit(rows_ref)
+    if stats_ref is not None:
+        _stats_init(stats_ref, out_ref.shape[-1])
 
     # First visit of a row within this call: start from the identity.
     # Rows a call never visits keep uninitialized garbage — callers
@@ -178,22 +227,48 @@ def _count_pairs_kernel(rows_ref, cols_ref, eps2_ref, c_ref, x_ref, y_ref,
     def _():
         # x/y are (d, block) blocks indexed straight out of the (d, N)
         # operand — no tile-transposed copy exists anywhere.
-        d2 = _dot_t(_aug_src(y_ref[...], c), _aug_out(x_ref[...], c), mode)
         # Column validity rides as a tiny int32 block applied HERE, in
         # VMEM, instead of as a full-size masked copy of the
         # coordinates in HBM (the r4 50M compile-OOM).  Invalid ROW
         # points produce garbage counts; callers mask rows anyway.
         valid_col = jnp.transpose(m_ref[0], (1, 0)) > 0
-        adj = ((d2 <= eps2) & valid_col).astype(jnp.int32)
-        out_ref[0] += jnp.sum(adj, axis=0, keepdims=True)
+
+        def emit(d2):
+            adj = ((d2 <= eps2) & valid_col).astype(jnp.int32)
+            out_ref[0] += jnp.sum(adj, axis=0, keepdims=True)
+
+        if mode == "mixed":
+            d2f, xa, ya, n_band, need = _mixed_classify(
+                x_ref[...], y_ref[...], c, eps2, valid_col
+            )
+            _stats_add(
+                stats_ref, out_ref.shape[-1], n_band,
+                need.astype(jnp.int32),
+            )
+
+            # The rescore dot only RUNS for tiles with an in-band pair
+            # — a clean tile stays at the single-pass bf16 peak.
+            @pl.when(need)
+            def _():
+                emit(_dot_t(ya, xa, "high"))
+
+            @pl.when(~need)
+            def _():
+                emit(d2f)
+        else:
+            emit(_dot_t(
+                _aug_src(y_ref[...], c), _aug_out(x_ref[...], c), mode
+            ))
 
 
 def _minlab_pairs_kernel(rows_ref, cols_ref, eps2_ref, c_ref, x_ref, y_ref,
-                         lab_ref, out_ref, *, mode, nt):
+                         lab_ref, out_ref, stats_ref=None, *, mode, nt):
     eps2 = eps2_ref[0]
     c = c_ref[0]
     real = rows_ref[pl.program_id(0)] < nt
     first = _first_visit(rows_ref)
+    if stats_ref is not None:
+        _stats_init(stats_ref, out_ref.shape[-1])
 
     @pl.when(real & first)
     def _():
@@ -201,12 +276,36 @@ def _minlab_pairs_kernel(rows_ref, cols_ref, eps2_ref, c_ref, x_ref, y_ref,
 
     @pl.when(real)
     def _():
-        d2 = _dot_t(_aug_src(y_ref[...], c), _aug_out(x_ref[...], c), mode)
         lab_col = jnp.transpose(lab_ref[0], (1, 0))
-        cand = jnp.where(d2 <= eps2, lab_col, _INT_INF)
-        out_ref[0] = jnp.minimum(
-            out_ref[0], jnp.min(cand, axis=0, keepdims=True)
-        )
+
+        def emit(d2):
+            cand = jnp.where(d2 <= eps2, lab_col, _INT_INF)
+            out_ref[0] = jnp.minimum(
+                out_ref[0], jnp.min(cand, axis=0, keepdims=True)
+            )
+
+        if mode == "mixed":
+            # Source restriction/validity ride on the label sentinel;
+            # the same mask keeps sentinel columns out of the rescore
+            # decision.  No stats output here: band stats are
+            # deterministic per pass, and the counts kernel already
+            # measured them — the in-band test below exists only to
+            # gate the rescore.
+            d2f, xa, ya, _n_band, need = _mixed_classify(
+                x_ref[...], y_ref[...], c, eps2, lab_col != _INT_INF,
+            )
+
+            @pl.when(need)
+            def _():
+                emit(_dot_t(ya, xa, "high"))
+
+            @pl.when(~need)
+            def _():
+                emit(d2f)
+        else:
+            emit(_dot_t(
+                _aug_src(y_ref[...], c), _aug_out(x_ref[...], c), mode
+            ))
 
 
 def _points_dn(points, layout):
@@ -299,6 +398,10 @@ def _pallas_block(block: int, n: int, d: int, mode: str = "high") -> int:
     b = min(block, n)
     if mode == "high":
         tile_words, opnd_words = 4, 8
+    elif mode == "mixed":
+        # Worst case is the rescored tile: the bf16_3x budget PLUS the
+        # live fast-pass tile and the band/classification temps.
+        tile_words, opnd_words = 6, 8
     else:
         tile_words, opnd_words = 2, 4
     while b > 128 and (
@@ -390,7 +493,7 @@ CHUNK_PAIRS = 48 * 1024
 
 
 def _pair_call(kernel, nt, d, block, n_extra_in, interpret, identity,
-               combine):
+               combine, band_stats=False):
     """Common pallas_call plumbing for the two pair-list kernels.
 
     Grid = one program per pair-list entry; the row/col tile index
@@ -403,6 +506,13 @@ def _pair_call(kernel, nt, d, block, n_extra_in, interpret, identity,
     / minimum).  Rows a chunk never visits hold uninitialized memory in
     its partial; the visited-rows mask keeps them out of the merge, and
     rows no chunk visits come back as ``identity``.
+
+    ``band_stats`` (the ``mode="mixed"`` kernels): adds a second
+    (1, 1, block) int32 output whose constant index map keeps the
+    block live in VMEM across the whole sequential grid — the standard
+    full-reduction idiom — holding ``[band_pairs, rescored_tiles]`` in
+    slots 0/1.  Chunked runs sum the per-chunk partials.  The call
+    then returns ``(acc, (2,) int32)``.
     """
 
     def specs(n_pairs):
@@ -443,20 +553,42 @@ def _pair_call(kernel, nt, d, block, n_extra_in, interpret, identity,
             # per-point int32 rows keyed by the col tile (labels/masks)
             pl.BlockSpec((1, 1, block), cclamp, memory_space=pltpu.VMEM)
         ] * n_extra_in
+        out_specs = row_keyed_out
+        if band_stats:
+            # Constant-index-map stats block: lives in VMEM across the
+            # whole sequential grid (the standard full-reduction idiom)
+            # so the mixed kernels accumulate [band_pairs,
+            # rescored_tiles] without touching HBM per pair.
+            out_specs = (
+                row_keyed_out,
+                pl.BlockSpec(
+                    (1, 1, block), lambda p, r, c, e: (0, 0, 0),
+                    memory_space=pltpu.VMEM,
+                ),
+            )
         return pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=3,
             grid=(n_pairs,),
             in_specs=in_specs,
-            out_specs=row_keyed_out,
+            out_specs=out_specs,
         )
 
     def one_call(rows, cols, eps2, arrays):
-        return pl.pallas_call(
+        out_shape = jax.ShapeDtypeStruct((nt + 1, 1, block), jnp.int32)
+        if band_stats:
+            out_shape = (
+                out_shape,
+                jax.ShapeDtypeStruct((1, 1, block), jnp.int32),
+            )
+        out = pl.pallas_call(
             kernel,
             grid_spec=specs(rows.shape[0]),
-            out_shape=jax.ShapeDtypeStruct((nt + 1, 1, block), jnp.int32),
+            out_shape=out_shape,
             interpret=interpret,
         )(rows, cols, eps2, *arrays)
+        if band_stats:
+            return out[0], out[1][0, 0, :2]
+        return out, jnp.zeros(2, jnp.int32)
 
     def merge(acc, partial, rows):
         visited = jnp.zeros(nt + 1, bool).at[rows].set(True)
@@ -468,25 +600,29 @@ def _pair_call(kernel, nt, d, block, n_extra_in, interpret, identity,
         n_pairs = rows.shape[0]
         acc0 = jnp.full((nt + 1, 1, block), identity, jnp.int32)
         if n_pairs <= CHUNK_PAIRS:
-            return merge(acc0, one_call(rows, cols, eps2, arrays), rows)
+            partial, st = one_call(rows, cols, eps2, arrays)
+            out = merge(acc0, partial, rows)
+            return (out, st) if band_stats else out
         nch = -(-n_pairs // CHUNK_PAIRS)
         pad = nch * CHUNK_PAIRS - n_pairs
         rows = jnp.concatenate([rows, jnp.full(pad, nt, jnp.int32)])
         cols = jnp.concatenate([cols, jnp.zeros(pad, jnp.int32)])
 
         def body(carry, rc):
+            acc, st_acc = carry
             r, c = rc
-            return merge(carry, one_call(r, c, eps2, arrays), r), None
+            partial, st = one_call(r, c, eps2, arrays)
+            return (merge(acc, partial, r), st_acc + st), None
 
-        acc, _ = jax.lax.scan(
+        (acc, st), _ = jax.lax.scan(
             body,
-            acc0,
+            (acc0, jnp.zeros(2, jnp.int32)),
             (
                 rows.reshape(nch, CHUNK_PAIRS),
                 cols.reshape(nch, CHUNK_PAIRS),
             ),
         )
-        return acc
+        return (acc, st) if band_stats else acc
 
     return call
 
@@ -551,9 +687,16 @@ def neighbor_counts_pallas(
     one list across all of them, and own overflow handling.  ``None``
     extracts here; if the default budget truncates the list, every
     count comes back -1 (loudly invalid, never silently low).
+
+    With ``precision="mixed"`` the return widens to ``(counts,
+    band_stats)`` — band_stats (2,) int32 ``[band_pairs,
+    rescored_tiles]``; counts byte-identical to ``precision="high"``
+    (the banded-rescore contract, see
+    :mod:`pypardis_tpu.ops.precision`).
     """
     n, d = _shape_nd(points, layout)
     mode = _norm_precision_mode(precision)
+    mixed = mode == "mixed"
     block = _pallas_block(block, n, d, mode)
     _check_mosaic_tile(block, n, interpret)
     nt = n // block
@@ -574,14 +717,17 @@ def neighbor_counts_pallas(
     # clamped real blocks and skip compute).  No dump-block concats,
     # no masked copy, no tile-transposed copy: the kernel program
     # carries NO dataset-sized temps at all.
-    counts = _pair_call(
+    out = _pair_call(
         functools.partial(_count_pairs_kernel, mode=mode, nt=nt),
         nt, d, block, 1, interpret,
-        identity=0, combine=jnp.add,
+        identity=0, combine=jnp.add, band_stats=mixed,
     )(rows, cols, eps2, centers, pts_dn, pts_dn, mask_t.astype(jnp.int32))
+    counts, band = out if mixed else (out, None)
     counts = jnp.where(mask, counts[:nt].reshape(-1), 0)
     if poison is not None:
         counts = jnp.where(poison, -1, counts)
+    if mixed:
+        return counts, band
     return counts
 
 
@@ -616,9 +762,17 @@ def min_neighbor_label_pallas(
     list covering validity boxes is a superset of any src subset, so
     sharing one list is sound); a truncated self-extracted list poisons
     every row to INT32_MIN.
+
+    With ``precision="mixed"`` the return widens to ``(best,
+    band_stats)`` for signature uniformity with
+    :func:`neighbor_counts_pallas` — but the stats here are always
+    zeros: band telemetry is deterministic per pass and measured once,
+    by the counts kernel; this kernel's in-band test only gates its
+    own tile rescores.
     """
     n, d = _shape_nd(points, layout)
     mode = _norm_precision_mode(precision)
+    mixed = mode == "mixed"
     block = _pallas_block(block, n, d, mode)
     _check_mosaic_tile(block, n, interpret)
     nt = n // block
@@ -644,6 +798,9 @@ def min_neighbor_label_pallas(
     # return garbage callers mask anyway.  No masked coordinate copy,
     # no dump-block concats (clamped index maps) — see
     # neighbor_counts_pallas.
+    # No stats output on the propagation kernel: band stats come from
+    # the counts pass (they are deterministic per pass); the minlab
+    # kernel's in-band test only gates its rescore.
     best = _pair_call(
         functools.partial(_minlab_pairs_kernel, mode=mode, nt=nt),
         nt, d, block, 1, interpret,
@@ -652,14 +809,16 @@ def min_neighbor_label_pallas(
     best = best[:nt].reshape(-1)
     if poison is not None:
         best = jnp.where(poison, jnp.iinfo(jnp.int32).min, best)
+    if mixed:
+        return best, jnp.zeros(2, jnp.int32)
     return best
 
 
 # -- serving: out-of-sample query kernel ---------------------------------
 
 
-def _query_leaf_kernel(leaf_ref, zero_ref, q_ref, c_ref, lab_ref,
-                       out_lab_ref, out_d2_ref, *, d):
+def _query_leaf_kernel(leaf_ref, zero_ref, eps2_ref, q_ref, c_ref, lab_ref,
+                       out_lab_ref, out_d2_ref, *, d, mode):
     """Grid (nqt, nb): query tile i folds column block j of its leaf's
     core slab into the running per-row (min d2, min label among ties).
 
@@ -668,12 +827,19 @@ def _query_leaf_kernel(leaf_ref, zero_ref, q_ref, c_ref, lab_ref,
     square sealed against FMA contraction with the prefetched runtime
     zero (``ops.query.seal_f32``) — so the result is bit-identical to
     the XLA path and the numpy oracle (the serving exactness contract).
-    The MXU decomposition is deliberately not used: its accumulation
-    order is backend-scheduled.  Pad core slots carry PAD_COORD (d^2
-    overflows to +inf) and INT32_MAX labels, so no mask enters the
-    kernel at all.
+    The MXU decomposition is deliberately not used for the SCORING
+    pass: its accumulation order is backend-scheduled.  Pad core slots
+    carry PAD_COORD (d^2 overflows to +inf) and INT32_MAX labels, so
+    no mask enters the kernel at all.
+
+    ``mode="mixed"`` adds the bf16-peak block pre-filter
+    (:func:`pypardis_tpu.ops.query._fast_block_keep`): one DEFAULT MXU
+    dot lower-bounds every pair's d^2 against the prefetched eps^2,
+    and the expensive sealed VPU pass runs only for blocks that could
+    hold a within-eps candidate — the final verdict is bitwise
+    unchanged (a pruned block provably cannot contribute one).
     """
-    from .query import seal_f32
+    from .query import _fast_block_keep, seal_f32
 
     j = pl.program_id(1)
 
@@ -685,28 +851,47 @@ def _query_leaf_kernel(leaf_ref, zero_ref, q_ref, c_ref, lab_ref,
     z = zero_ref[0]
     q = q_ref[0]  # (d, qb)
     c = c_ref[...]  # (d, block)
-    diff = q[0][:, None] - c[0][None, :]
-    acc = seal_f32(diff * diff, z)
-    for a in range(1, d):
-        diff = q[a][:, None] - c[a][None, :]
-        acc = acc + seal_f32(diff * diff, z)
-    lb = lab_ref[0, 0, :]
-    m = jnp.min(acc, axis=1)
-    cand = jnp.min(
-        jnp.where(acc == m[:, None], lb[None, :], _INT_INF), axis=1
-    )
-    bd2 = out_d2_ref[0, 0, :]
-    bl = out_lab_ref[0, 0, :]
-    take = (m < bd2) | ((m == bd2) & (cand < bl))
-    out_d2_ref[0, 0, :] = jnp.where(take, m, bd2)
-    out_lab_ref[0, 0, :] = jnp.where(take, cand, bl)
+
+    def score():
+        diff = q[0][:, None] - c[0][None, :]
+        acc = seal_f32(diff * diff, z)
+        for a in range(1, d):
+            diff = q[a][:, None] - c[a][None, :]
+            acc = acc + seal_f32(diff * diff, z)
+        lb = lab_ref[0, 0, :]
+        m = jnp.min(acc, axis=1)
+        cand = jnp.min(
+            jnp.where(acc == m[:, None], lb[None, :], _INT_INF), axis=1
+        )
+        bd2 = out_d2_ref[0, 0, :]
+        bl = out_lab_ref[0, 0, :]
+        take = (m < bd2) | ((m == bd2) & (cand < bl))
+        out_d2_ref[0, 0, :] = jnp.where(take, m, bd2)
+        out_lab_ref[0, 0, :] = jnp.where(take, cand, bl)
+
+    if mode == "mixed":
+        # Pad-robust block center: PAD_COORD slots (2e19) would poison
+        # a plain max, so real slots are selected by magnitude first.
+        # An all-pad block yields a NaN center -> NaN fast distances ->
+        # keep is False, which is correct (pads can never win a min).
+        real = c < jnp.float32(1e18)
+        cmax = jnp.max(jnp.where(real, c, -jnp.inf), axis=1)
+        cmin = jnp.min(jnp.where(real, c, jnp.inf), axis=1)
+        ctr = (0.5 * (cmax + cmin))[:, None]
+
+        @pl.when(_fast_block_keep(q, c, eps2_ref[0], ctr))
+        def _():
+            score()
+    else:
+        score()
 
 
 @functools.partial(
-    jax.jit, static_argnames=("block", "nb", "interpret")
+    jax.jit, static_argnames=("block", "nb", "interpret", "precision")
 )
 def query_min_core_pallas(
-    q, tile_leaf, coords, labels, zero_i32, *, block, nb, interpret=False
+    q, tile_leaf, coords, labels, zero_i32, eps2_f, *, block, nb,
+    interpret=False, precision="high",
 ):
     """Pallas twin of :func:`pypardis_tpu.ops.query.query_min_core`.
 
@@ -716,51 +901,56 @@ def query_min_core_pallas(
     block-sparse idiom of the fit kernels).  ``zero_i32``: a (1,) int32
     zero ARRAY from the caller — it must reach the kernel as a traced
     runtime value for the anti-FMA seal (``ops.query.seal_f32``) to
-    survive compilation.  No box pruning inside — every block of the
-    leaf's slab is visited, which is semantically identical (pruning
-    only skips provably-losing blocks) and keeps the kernel a pure
-    reduction.
+    survive compilation.  ``eps2_f``: a (1,) float32 eps^2 array
+    (prefetched; consumed only by ``precision="mixed"``'s block
+    pre-filter).  No box pruning inside — every block of the leaf's
+    slab is visited in the non-mixed modes, which is semantically
+    identical (pruning only skips provably-losing blocks) and keeps
+    the kernel a pure reduction; ``"mixed"`` prunes blocks with one
+    bf16 dot and rescores survivors through the identical sealed path,
+    preserving the bitwise oracle contract.
     """
+    mode = _norm_precision_mode(precision)
     nqt, d, qb = q.shape
     lab3 = labels.reshape(-1, 1, block)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(nqt, nb),
         in_specs=[
             pl.BlockSpec(
-                (1, d, qb), lambda i, j, leaf, z: (i, 0, 0),
+                (1, d, qb), lambda i, j, leaf, z, e: (i, 0, 0),
                 memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec(
-                (d, block), lambda i, j, leaf, z: (0, leaf[i] * nb + j),
+                (d, block), lambda i, j, leaf, z, e: (0, leaf[i] * nb + j),
                 memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec(
                 (1, 1, block),
-                lambda i, j, leaf, z: (leaf[i] * nb + j, 0, 0),
+                lambda i, j, leaf, z, e: (leaf[i] * nb + j, 0, 0),
                 memory_space=pltpu.VMEM,
             ),
         ],
         out_specs=(
             pl.BlockSpec(
-                (1, 1, qb), lambda i, j, leaf, z: (i, 0, 0),
+                (1, 1, qb), lambda i, j, leaf, z, e: (i, 0, 0),
                 memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec(
-                (1, 1, qb), lambda i, j, leaf, z: (i, 0, 0),
+                (1, 1, qb), lambda i, j, leaf, z, e: (i, 0, 0),
                 memory_space=pltpu.VMEM,
             ),
         ),
     )
     labs, d2 = pl.pallas_call(
-        functools.partial(_query_leaf_kernel, d=d),
+        functools.partial(_query_leaf_kernel, d=d, mode=mode),
         grid_spec=grid_spec,
         out_shape=(
             jax.ShapeDtypeStruct((nqt, 1, qb), jnp.int32),
             jax.ShapeDtypeStruct((nqt, 1, qb), jnp.float32),
         ),
         interpret=interpret,
-    )(tile_leaf, zero_i32, q, coords, lab3)
+    )(tile_leaf, zero_i32, eps2_f, q, coords, lab3)
     return jnp.stack([
         labs[:, 0, :],
         jax.lax.bitcast_convert_type(d2[:, 0, :], jnp.int32),

@@ -325,8 +325,8 @@ def _pipeline_pack(roots_s, core_s, pair_stats, owner, *, cap):
     back to input order.  ``owner`` sends pad slots to the dump row
     ``cap`` of a (cap+1,)-sized scatter target.
 
-    Output is ONE (cap + 3,) int32 row — ``(root + 1) | core << 30``
-    per point plus the three pair stats — rather than separate root/core
+    Output is ONE (cap + 5,) int32 row — ``(root + 1) | core << 30``
+    per point plus the five pair stats — rather than separate root/core
     rows: the device->host result transfer runs at single-digit MB/s on
     degraded tunnel sessions, so halving its bytes is wall-clock that
     matters.  Roots are < cap <= 2^30 (checked at trace time), so bit
@@ -348,14 +348,19 @@ def _pipeline_pack(roots_s, core_s, pair_stats, owner, *, cap):
 def unpack_pipeline_result(packed):
     """Host-side decode of :func:`_pipeline_pack`'s single int32 row.
 
-    Returns ``(roots, core, total, budget, passes)`` — roots in input
-    order (-1 noise), core as bool, plus the live tile-pair stats and
-    the kernel pass count (the FLOP-model ``passes`` term).
+    Returns ``(roots, core, total, budget, passes, band_pairs,
+    rescored_tiles)`` — roots in input order (-1 noise), core as bool,
+    plus the live tile-pair stats, the kernel pass count (the
+    FLOP-model ``passes`` term), and the mixed-precision band
+    telemetry (zeros on non-mixed fits).
     """
-    body = packed[:-3]
+    body = packed[:-5]
     roots = (body & 0x3FFFFFFF) - 1
     core = (body >> 30) > 0
-    return roots, core, int(packed[-3]), int(packed[-2]), int(packed[-1])
+    return (
+        roots, core, int(packed[-5]), int(packed[-4]), int(packed[-3]),
+        int(packed[-2]), int(packed[-1]),
+    )
 
 
 @functools.partial(
@@ -518,9 +523,15 @@ def _cluster_stepped(
             xs, eps, min_samples, mask_k, pair_budget=pair_budget, **kw
         )
 
-    (rows, cols), pair_stats, core, f = _transient_retry(
+    (rows, cols), pair_stats, core, f, band0 = _transient_retry(
         "prepare", run_prepare
     )
+    # Mixed-precision band telemetry accumulates host-side across the
+    # stepped dispatches (each device call reports its own batch; the
+    # convergence-flag fetch is already a sync point, so the extra
+    # tiny fetch rides the same round trip).  Zeros on other modes.
+    band_acc = np.zeros(2, np.int64)
+    band_acc += np.asarray(band0, np.int64)
     prepare_s = _time.perf_counter() - t0
     g = None
     converged = False
@@ -554,7 +565,10 @@ def _cluster_stepped(
                 out = dispatch(f)
                 return out + (bool(out[2]),)  # sync inside retry scope
 
-            f, g, _, changed = _transient_retry("round", some_rounds)
+            f, g, _, band_b, changed = _transient_retry(
+                "round", some_rounds
+            )
+            band_acc += np.asarray(band_b, np.int64)
             batches += 1
             obs_heartbeat("stepped.rounds", batches, max_batches, t_rounds)
             if not changed:  # the last executed round was a fixpoint
@@ -590,6 +604,7 @@ def _cluster_stepped(
             batches += 1
             obs_heartbeat("stepped.rounds", batches, max_batches, t_rounds)
             f, g = cur[0], cur[1]
+            band_acc += np.asarray(cur[3], np.int64)
             if not changed:
                 converged = True
     rounds_s = _time.perf_counter() - t_rounds
@@ -603,12 +618,13 @@ def _cluster_stepped(
     border_s = 0.0
     if not converged:
         t_b = _time.perf_counter()
-        g = _transient_retry(
+        g, band_b = _transient_retry(
             "border",
             lambda: dbscan_border_pallas(
                 xs, f, eps, core, mask_k, rows, cols, **kw
             ),
         )
+        band_acc += np.asarray(band_b, np.int64)
         border_s = _time.perf_counter() - t_b
     # Kernel passes for the FLOP model: one counts pass, batch_k minlab
     # rounds per DISPATCHED batch (the speculative post-fixpoint batch
@@ -617,7 +633,12 @@ def _cluster_stepped(
     # border pass on a non-converged exit.
     passes = 1 + dispatched * batch_k + (0 if converged else 1)
     pair_stats = jnp.concatenate(
-        [pair_stats[:2], jnp.asarray([passes], jnp.int32)]
+        [
+            pair_stats[:2], jnp.asarray([passes], jnp.int32),
+            jnp.asarray(
+                np.minimum(band_acc, np.iinfo(np.int32).max), jnp.int32
+            ),
+        ]
     )
     t_p = _time.perf_counter()
     out = _transient_retry(
@@ -655,12 +676,13 @@ def dbscan_device_pipeline(
     """points_t: (d, cap) float32, centered, zero-padded past ``n``
     (traced) — or a ZERO-ARG CALLABLE producing it, evaluated only
     when the layout actually runs (see ``layout_key``).  Returns a
-    host (cap + 2,) int32 array: per point the packed ``(root + 1) |
+    host (cap + 5,) int32 array: per point the packed ``(root + 1) |
     core << 30`` value (input order; decode via
     :func:`unpack_pipeline_result`), then ``[live_pairs_total,
-    budget]`` from the Pallas tile-pair extraction (rides in-band so
+    budget, passes, band_pairs, rescored_tiles]`` (rides in-band so
     the driver gets results and overflow status in ONE device->host
-    transfer; zeros on XLA).  Materialized on host here so the bulk
+    transfer; budget zeros on XLA, band columns zero off
+    ``precision="mixed"``).  Materialized on host here so the bulk
     transfer doubles as the execution-fault sync inside the retry
     scope.
 

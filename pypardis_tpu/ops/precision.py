@@ -1,0 +1,142 @@
+"""The single source of truth for kernel precision modes.
+
+Both backends map the same user surface — ``precision=`` on
+``DBSCAN`` / ``dbscan_fixed_size`` / the serving engine — onto their
+kernels, and until this module they normalized it independently
+(``ops.distances._norm_precision`` vs
+``ops.pallas_kernels._norm_precision_mode``), which is exactly how a
+new mode could silently drift between them.  Everything precision-
+related that must agree across backends lives here:
+
+* the mode ladder and its normalizer (strings and
+  ``jax.lax.Precision`` spellings);
+* the bf16 single-pass error bound behind ``precision="mixed"``'s
+  band classification — the one constant both the XLA scan kernels,
+  the Mosaic pair-list kernels, and the serving query kernels must
+  derive their rescore band from, or the "byte-identical to high"
+  contract silently breaks on one backend only.
+
+The ladder (fastest → most exact):
+
+``"default"``
+    One bf16 MXU pass.  ~2^-8-relative d^2 error — opt-in lossy.
+``"mixed"``
+    One bf16 MXU pass PLUS an exact rescore of every tile containing a
+    pair whose fast d^2 lands within the conservative error band of
+    eps^2 (:func:`band_halfwidth`).  Labels are byte-identical to
+    ``"high"`` by construction — the band bound guarantees every
+    fast-pass verdict outside the band matches the high-precision
+    verdict, and in-band tiles recompute at ``"high"`` outright.
+``"high"``
+    bf16_3x (three bf16 passes synthesizing ~fp32).  The default.
+``"highest"``
+    Native fp32 — the exact fallback for adversarially scaled data.
+"""
+
+from __future__ import annotations
+
+PRECISION_MODES = ("default", "high", "highest", "mixed")
+
+# bf16 has 8 explicit mantissa bits: unit roundoff 2^-9 under
+# round-to-nearest; a product of two rounded operands carries
+# <= (2*2^-9 + 2^-18) ~ 2^-8 relative error per term.
+BF16_EPS = 2.0 ** -8
+
+# Safety margin on the analytic fast-pass bound (band_halfwidth): the
+# analytic terms are already worst-case (every rounding conspiring in
+# one direction, Cauchy-Schwarz at the per-tile maxima), so 25% slack
+# is generous; it also absorbs the bf16_3x rescore's own dropped-term
+# error (~2^-18-relative — 500x below the fast band) when the rescore
+# runs in the same recentred frame.
+_BAND_SAFETY = 1.25
+
+# Width of the in-band pair-stats row every kernel route emits:
+# [live_pairs_total, budget, kernel_passes, band_pairs, rescored_tiles].
+# The last two are zero on every non-mixed precision mode.
+PAIR_STATS_WIDTH = 5
+
+
+def norm_precision_mode(precision) -> str:
+    """Normalize any accepted precision spelling to a canonical mode.
+
+    Accepts the mode strings (any case) and the three
+    ``jax.lax.Precision`` enum values (which map onto the non-mixed
+    rungs).  Raises ValueError otherwise — this is the error message
+    every entry point shows, so the accepted surface cannot drift
+    between backends.
+    """
+    import jax
+
+    if isinstance(precision, jax.lax.Precision):
+        return {
+            jax.lax.Precision.DEFAULT: "default",
+            jax.lax.Precision.HIGH: "high",
+            jax.lax.Precision.HIGHEST: "highest",
+        }[precision]
+    p = str(precision).lower()
+    if p not in PRECISION_MODES:
+        raise ValueError(
+            f"precision must be one of {PRECISION_MODES} (or a "
+            f"jax.lax.Precision), got {precision!r}"
+        )
+    return p
+
+
+def band_halfwidth(nx, ny):
+    """Conservative bound on ``|d2_fast - d2_true|`` for one bf16 pass.
+
+    ``nx``/``ny``: EUCLIDEAN NORM bounds of the two operand point sets
+    *in the frame the fast pass computes in* — per-tile maxima of
+    ``|x - c|`` after recentring in the fit kernels, per-point norms
+    in the serving kernels (pad slots there carry astronomically large
+    coordinates, and a per-element band keeps one pad from poisoning a
+    whole tile's bound).  Broadcasting follows the operands.
+
+    Derivation.  Both single-pass forms — the plain ``|x|^2 + |y|^2 -
+    2 x.y`` (norms in f32, only the dot in bf16) and the Mosaic
+    kernels' augmented-operand dot ``[-2(y-c); 1; |y-c|^2]^T [x-c;
+    |x-c|^2; 1]`` — lose accuracy to bf16 operand rounding:
+
+    * coordinate products: each operand entry rounds with relative
+      error <= 2^-9, so a product term carries <= ~2^-8 |x_a||y_a|;
+      summed over axes, Cauchy-Schwarz gives ``sum_a |x_a||y_a| <=
+      |x||y| <= nx*ny`` — with the 2x coefficient of the cross term
+      that is ``2^-7 * nx * ny`` (NOT d * max-coordinate^2: the norm
+      bound is a factor ~d tighter on isotropic data, which is what
+      keeps the band a few percent of eps^2 instead of covering it);
+    * the augmented form's |.|^2 rows round once each:
+      ``<= 2^-9 * (nx^2 + ny^2)`` (the paired "1" entries are exact
+      in bf16, so these terms never multiply each other);
+    * f32 MXU accumulation adds ~2^-23-relative dust.
+
+    The returned bound covers both forms with _BAND_SAFETY margin::
+
+        band = 1.25 * (2^-7 * nx * ny + 2^-9 * (nx^2 + ny^2))
+
+    Any pair whose fast d^2 lands further than ``band`` (plus
+    :func:`exact_slack` when the rescore runs in a different frame)
+    from eps^2 provably has the same within-eps verdict as the exact
+    pass — that is the entire exactness argument of
+    ``precision="mixed"``.
+    """
+    return _BAND_SAFETY * (
+        2.0 * BF16_EPS * nx * ny
+        + 0.5 * BF16_EPS * (nx * nx + ny * ny)
+    )
+
+
+def exact_slack(nx, ny):
+    """Error bound of the EXACT pass itself, in its own frame.
+
+    Added to :func:`band_halfwidth` when the rescore pass computes in
+    a different coordinate frame than the fast pass (the XLA fit
+    kernels rescore in the global dataset frame while the fast pass is
+    tile-recentred; the serving kernels rescore through the sealed
+    axis-ordered f32 sum in the index frame).  Covers both the bf16_3x
+    dropped-term error (~2^-17 nx ny) and f32 cancellation in
+    ``|x|^2+|y|^2-2xy`` at frame magnitudes (~2^-21 (nx+ny)^2)::
+
+        slack = 2^-16 * (nx + ny)^2
+    """
+    s = nx + ny
+    return (2.0 ** -16) * s * s

@@ -137,6 +137,50 @@ def brute_force_query(queries, cores, labels, eps):
     return out_lab, out_d2
 
 
+def _fast_block_keep(q_t, c_t, eps2, center):
+    """bf16-peak pre-filter for one (d, m) x (d, n) query/core block:
+    True iff SOME pair's exact d^2 could lie within eps.
+
+    Both sides recentre on ``center`` ((d, 1) — the core block's box
+    midpoint) so bf16 operand magnitudes are block-local, then one
+    DEFAULT-precision (bf16 on TPU) MXU dot gives fast squared
+    distances; subtracting the shared per-ELEMENT error bound
+    (:func:`pypardis_tpu.ops.precision.band_halfwidth` at recentred
+    per-point norms, plus :func:`~pypardis_tpu.ops.precision.
+    exact_slack` at the index-frame norms the sealed rescore computes
+    in) yields a sound lower bound on the exact d^2.  A block whose
+    every lower bound clears eps^2 cannot contain a within-eps
+    candidate and is skipped — the same soundness argument as the
+    box-gap pruning, so the final within-eps verdict (and therefore
+    ``predict``'s bitwise-exact contract) is untouched; surviving
+    blocks rescore through the UNCHANGED sealed exact path.
+
+    Pad slots carry ``PAD_COORD``: their recentred norms and fast d^2
+    are inf/NaN, the per-element band goes non-finite, and ``NaN <=
+    x`` is False — so pad entries can never force a keep.  (A
+    tile-max band would instead be blown to +inf by one pad slot and
+    keep everything; per-element is what makes the filter effective
+    on padded slabs.)
+    """
+    from .precision import band_halfwidth, exact_slack
+
+    qc = q_t - center
+    cc_ = c_t - center
+    qq = jnp.sum(qc * qc, axis=0)[:, None]
+    cc = jnp.sum(cc_ * cc_, axis=0)[None, :]
+    d2f = qq + cc - 2.0 * jax.lax.dot_general(
+        qc, cc_, (((0,), (0,)), ((), ())),
+        precision=jax.lax.Precision.DEFAULT,
+        preferred_element_type=jnp.float32,
+    )
+    nq = jnp.sqrt(qq)
+    nc = jnp.sqrt(cc)
+    gq = jnp.sqrt(jnp.sum(q_t * q_t, axis=0))[:, None]
+    gc = jnp.sqrt(jnp.sum(c_t * c_t, axis=0))[None, :]
+    band = band_halfwidth(nq, nc) + exact_slack(gq, gc)
+    return jnp.any(d2f - band <= eps2)
+
+
 def _block_best(d2, lab_block, best_d2, best_lab):
     """Fold one (qb, block) distance tile into the per-row running
     ``(min d2, min label among ties)`` — the deterministic assignment
@@ -150,13 +194,22 @@ def _block_best(d2, lab_block, best_d2, best_lab):
     return jnp.where(take, m, best_d2), jnp.where(take, cand, best_lab)
 
 
-@functools.partial(jax.jit, static_argnames=("block", "nb"))
+@functools.partial(jax.jit, static_argnames=("block", "nb", "precision"))
 def query_min_core(
     q, qmask, tile_leaf, coords, labels, blo, bhi, eps2, zero_i32,
-    *, block, nb
+    *, block, nb, precision="high"
 ):
     """XLA query kernel: per query row, ``(min d2, min label)`` over its
     leaf's core slab.
+
+    ``precision="mixed"`` inserts the bf16-peak block pre-filter
+    (:func:`_fast_block_keep`) between the box-gap prune and the exact
+    sealed pass: blocks provably outside eps skip the expensive
+    axis-ordered VPU accumulation entirely, surviving candidates
+    rescore through the UNCHANGED ``seal_f32`` path — so the bitwise
+    numpy-oracle contract holds in every mode.  Any other value keeps
+    today's behavior (the exact pass has a single precision; the knob
+    exists so the serving surface shares the fit's precision ladder).
 
     ``q``: (nqt, d, qb) float32 centered query tiles (pad rows at
     ``PAD_COORD``); ``qmask``: (nqt, qb) bool row validity (tightens
@@ -175,6 +228,9 @@ def query_min_core(
     bitcast(d2)]`` — so the engine fetches results in a single
     device->host transfer (:func:`unpack_query_result` decodes).
     """
+    from .precision import norm_precision_mode
+
+    mixed = norm_precision_mode(precision) == "mixed"
     nqt, d, qb = q.shape
 
     def tile(args):
@@ -195,8 +251,19 @@ def query_min_core(
                     coords, (0, cb * block), (d, block)
                 )
                 lb = jax.lax.dynamic_slice(labels, (cb * block,), (block,))
-                d2 = _axis_sq_dists_t(qi, cols, zero_i32)
-                return _block_best(d2, lb, c[0], c[1])
+
+                def exact(c):
+                    d2 = _axis_sq_dists_t(qi, cols, zero_i32)
+                    return _block_best(d2, lb, c[0], c[1])
+
+                if not mixed:
+                    return exact(c)
+                # Block box midpoint as the recentring frame (empty
+                # blocks carry inverted boxes, but the box-gap test
+                # above already skipped them).
+                ctr = (0.5 * (blo[cb] + bhi[cb]))[:, None]
+                keep = _fast_block_keep(qi, cols, eps2, ctr)
+                return jax.lax.cond(keep, exact, lambda c: c, c)
 
             return jax.lax.cond(skip, lambda c: c, compute, carry), None
 

@@ -81,7 +81,12 @@ from ..obs import (
     heartbeat as obs_heartbeat,
     span as obs_span,
 )
-from ..ops.labels import gm_backend, oc_counts, oc_extract, oc_propagate
+from ..ops.labels import (
+    gm_backend,
+    oc_counts_banded,
+    oc_extract,
+    oc_propagate_banded,
+)
 from ..partition import morton_range_split
 from ..utils import clamp_block, round_up, validate_params
 from ..utils.budget import run_ladders
@@ -558,7 +563,7 @@ def _gm_cluster_step(
     boundary-slot flags, relay-only propagation emits the occurrence
     tables, and the replicated home-label table is built in-graph.
     Returns ``(home_label (N+1,) replicated, core_g (N+1,) replicated,
-    b_glab (P, brows) sharded, pair_stats (P, 3))`` — everything the
+    b_glab (P, brows) sharded, pair_stats (P, 5))`` — everything the
     host-stepped fixpoint consumes.
     """
     n1 = n_points + 1
@@ -572,7 +577,7 @@ def _gm_cluster_step(
             pts, eps, msk, owned=cap, metric=metric, block=block,
             precision=precision, backend=backend, pair_budget=pair_budget,
         )
-        own_core = oc_counts(
+        own_core, counts_band = oc_counts_banded(
             pts, eps, min_samples, msk, owned=cap, metric=metric,
             block=block, precision=precision, kind=kind, pairs=pairs,
         )
@@ -581,7 +586,7 @@ def _gm_cluster_step(
             core_g[jnp.clip(bg[0], 0, n_points)]
             & (bg[0] < n_points) & bm[0]
         )
-        labels, passes = oc_propagate(
+        labels, passes, prop_band = oc_propagate_banded(
             pts, eps, msk, jnp.concatenate([own_core, b_core]),
             owned=cap, metric=metric, block=block, precision=precision,
             kind=kind, pairs=pairs,
@@ -596,7 +601,9 @@ def _gm_cluster_step(
             .max(own_glab)
         )
         home_label = jax.lax.pmax(home_label, axis).at[n1 - 1].set(-1)
-        pair_stats = jnp.concatenate([st, (1 + passes)[None]])
+        pair_stats = jnp.concatenate(
+            [st, (1 + passes)[None], counts_band + prop_band]
+        )
         return home_label, core_g, b_glab[None], pair_stats[None]
 
     sp3 = P("p", None, None)

@@ -58,9 +58,9 @@ from ..obs import (
 )
 from ..ops.labels import (
     dbscan_fixed_size,
-    oc_counts,
+    oc_counts_banded,
     oc_extract,
-    oc_propagate,
+    oc_propagate_banded,
 )
 from ..partition import spatial_order
 from ..utils import clamp_block, round_up
@@ -1196,28 +1196,30 @@ def _oc_counts_device(
 ):
     """Pass 1 of the owner-computes step, for one device's L
     partitions: pair extraction + owned-row counts.  Returns ``(
-    own_core (L, cap), extracted)`` — ``extracted`` is the per-
+    own_core (L, cap), extracted, band)`` — ``extracted`` is the per-
     partition ``(kind, pairs, stats)`` list pass 2 reuses so the
-    Pallas extraction never runs twice in one program."""
-    cores, extracted = [], []
+    Pallas extraction never runs twice in one program; ``band`` the
+    worst-partition (2,) mixed-precision band stats of the counts
+    pass (zeros off ``precision="mixed"``)."""
+    cores, extracted, bands = [], [], []
     for i in range(pts.shape[0]):
         kind, pairs, st = oc_extract(
             pts[i], eps, msk[i], owned=cap, metric=metric, block=block,
             precision=precision, backend=backend, pair_budget=pair_budget,
         )
         extracted.append((kind, pairs, st))
-        cores.append(
-            oc_counts(
-                pts[i], eps, min_samples, msk[i], owned=cap, metric=metric,
-                block=block, precision=precision, kind=kind, pairs=pairs,
-            )
+        core_i, band_i = oc_counts_banded(
+            pts[i], eps, min_samples, msk[i], owned=cap, metric=metric,
+            block=block, precision=precision, kind=kind, pairs=pairs,
         )
-    return jnp.stack(cores), extracted
+        cores.append(core_i)
+        bands.append(band_i)
+    return jnp.stack(cores), extracted, jnp.stack(bands).max(axis=0)
 
 
 def _oc_tables_device(
     pts, msk, gid, core_all, extracted, *, cap, eps, metric, block,
-    precision, backend, pair_budget,
+    precision, backend, pair_budget, counts_band=None,
 ):
     """Pass 2 of the owner-computes step: relay propagation per
     partition, local roots mapped through gids.
@@ -1226,10 +1228,14 @@ def _oc_tables_device(
     followed by the halo slots' OWNER-computed flags.  ``extracted``:
     pass 1's per-partition extraction, or None to re-extract (the
     host-merge route, where the two passes are separate programs).
-    Returns ``(glabel, pair_stats)`` with pair_stats (3,)
-    ``[live_pairs, budget, passes]`` worst-case over partitions (the
-    static budget is shared, so max binds)."""
-    glabs, stats2, passes = [], [], []
+    ``counts_band``: pass 1's (2,) band stats to fold in (None when
+    pass 1 runs as a separate program — the host route sums them
+    host-side).  Returns ``(glabel, pair_stats)`` with pair_stats (5,)
+    ``[live_pairs, budget, passes, band_pairs, rescored_tiles]``
+    worst-case over partitions (the static budget is shared, so max
+    binds; band columns are the counts band plus the worst
+    partition's propagation band)."""
+    glabs, stats2, passes, bands = [], [], [], []
     for i in range(pts.shape[0]):
         if extracted is None:
             kind, pairs, st = oc_extract(
@@ -1239,7 +1245,7 @@ def _oc_tables_device(
             )
         else:
             kind, pairs, st = extracted[i]
-        labels_i, p_i = oc_propagate(
+        labels_i, p_i, band_i = oc_propagate_banded(
             pts[i], eps, msk[i], core_all[i], owned=cap, metric=metric,
             block=block, precision=precision, kind=kind, pairs=pairs,
         )
@@ -1252,10 +1258,15 @@ def _oc_tables_device(
         )
         stats2.append(st)
         passes.append(p_i)
+        bands.append(band_i)
+    band = jnp.stack(bands).max(axis=0)
+    if counts_band is not None:
+        band = band + counts_band
     pair_stats = jnp.concatenate(
         [
             jnp.stack(stats2).max(axis=0),
             (1 + jnp.stack(passes).max())[None],
+            band,
         ]
     )
     return jnp.stack(glabs), pair_stats
@@ -1284,7 +1295,7 @@ def _device_cluster_merge_oc(
     cap = o.shape[1]
     n1 = n_points + 1
 
-    own_core, extracted = _oc_counts_device(
+    own_core, extracted, counts_band = _oc_counts_device(
         pts, msk, cap=cap, eps=eps, min_samples=min_samples,
         metric=metric, block=block, precision=precision, backend=backend,
         pair_budget=pair_budget,
@@ -1297,6 +1308,7 @@ def _device_cluster_merge_oc(
         pts, msk, gid, jnp.concatenate([own_core, halo_core], axis=1),
         extracted, cap=cap, eps=eps, metric=metric, block=block,
         precision=precision, backend=backend, pair_budget=pair_budget,
+        counts_band=counts_band,
     )
     own_glab, halo_glab = glabel[:, :cap], glabel[:, cap:]
     final, core_out, rounds, converged = _merge_from_tables(
@@ -1477,12 +1489,12 @@ def _oc_counts_step(
     def per_device(o, om, h, hm):
         pts = jnp.concatenate([o, h], axis=1)
         msk = jnp.concatenate([om, hm], axis=1)
-        own_core, _extracted = _oc_counts_device(
+        own_core, _extracted, band = _oc_counts_device(
             pts, msk, cap=o.shape[1], eps=eps, min_samples=min_samples,
             metric=metric, block=block, precision=precision,
             backend=backend, pair_budget=pair_budget,
         )
-        return own_core
+        return own_core, band[None]
 
     spec = P("p", None, None)
     spec2 = P("p", None)
@@ -1490,7 +1502,7 @@ def _oc_counts_step(
         per_device,
         mesh=mesh,
         in_specs=(spec, spec2, spec, spec2),
-        out_specs=spec2,
+        out_specs=(spec2, P("p", None)),
         check_vma=False,
     )(owned, owned_mask, halo, halo_mask)
 
@@ -1549,10 +1561,12 @@ def _oc_host_tables(
     doubles as the sync point where a ring-exchange ``overflow`` is
     checked before the propagation program runs.  Returns ``(own_glab,
     own_core, halo_glab, pair_stats)`` — the same tables the legacy
-    :func:`sharded_step_local` produced, plus 3-wide pair stats.
+    :func:`sharded_step_local` produced, plus 5-wide pair stats (the
+    counts program's mixed-precision band columns fold in host-side,
+    since the two owner-computes passes are separate programs here).
     """
     owned, owned_mask, owned_gid, halo, halo_mask, halo_gid = arrays
-    own_core_dev = _oc_counts_step(
+    own_core_dev, counts_band = _oc_counts_step(
         *arrays, eps=float(eps), min_samples=int(min_samples),
         metric=metric, block=block, mesh=mesh, axis=axis,
         precision=precision, backend=backend, pair_budget=pair_budget,
@@ -1574,7 +1588,12 @@ def _oc_host_tables(
         eps=float(eps), metric=metric, block=block, mesh=mesh, axis=axis,
         precision=precision, backend=backend, pair_budget=pair_budget,
     )
-    return own_glab, own_core_dev, halo_glab, pstats
+    # Fold the counts program's band columns into the per-device rows
+    # (host-side: the two passes are separate programs on this route).
+    cb = np.asarray(counts_band).reshape(-1, 2)
+    pstats_np = np.array(pstats).reshape(cb.shape[0], -1)
+    pstats_np[:, 3:5] += cb
+    return own_glab, own_core_dev, halo_glab, pstats_np
 
 
 @functools.partial(
@@ -1750,6 +1769,11 @@ def _exec_stats(stats, *, oc_on, pstats, block, k, precision, n):
         stats["live_pairs"] = int(ps[:, 0].max())
         if ps.shape[1] > 2:
             stats["kernel_passes"] = int(ps[:, 2].max())
+        if ps.shape[1] > 4:
+            # Mixed-precision band telemetry: worst-case device (the
+            # same convention as live_pairs — the binding serial path).
+            stats["band_pairs"] = int(ps[:, 3].max())
+            stats["rescored_tiles"] = int(ps[:, 4].max())
         from ..ops.pallas_kernels import (
             _norm_precision_mode, effective_tile,
         )

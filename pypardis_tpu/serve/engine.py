@@ -87,12 +87,20 @@ class QueryEngine:
         interpret: bool = False,
         batch_capacity: int = 4096,
         max_pending: int = 1 << 16,
+        precision: str = "high",
     ):
         from ..obs import RunRecorder
+        from ..utils.validate import check_precision
 
         self.index = index
         self.backend = backend
         self.interpret = bool(interpret)
+        # Kernel precision for the query pass: "mixed" prunes candidate
+        # blocks at the bf16 peak and rescores survivors through the
+        # sealed exact path (results stay bitwise oracle-exact — only
+        # the work changes); inherited from the model's precision by
+        # from_model when that mode is mixed.
+        self.precision = check_precision(precision)
         self.batch_capacity = int(batch_capacity)
         self.max_pending = int(max_pending)
         self.recorder = RunRecorder()
@@ -114,6 +122,20 @@ class QueryEngine:
         )
         if backend is None:
             backend = getattr(model, "kernel_backend", "auto")
+        # A mixed-precision model serves mixed too (the same fast-bulk
+        # + exact-rescore economy); the exact modes keep the exact
+        # query pass unchanged.  Explicit precision kwarg wins.
+        if "precision" not in kw:
+            from ..utils.validate import check_precision
+
+            try:
+                mode = check_precision(
+                    getattr(model, "precision", "high")
+                )
+            except ValueError:
+                mode = "high"
+            if mode == "mixed":
+                kw["precision"] = "mixed"
         return cls(index, backend=backend, **kw)
 
     # -- request surface --------------------------------------------------
@@ -191,7 +213,7 @@ class QueryEngine:
         qbuf, qmask, tile_leaf, rowmap = self.index.assemble(qf32)
         packed = self.index.dispatch(
             qbuf, qmask, tile_leaf, backend=self.backend,
-            interpret=self.interpret,
+            interpret=self.interpret, precision=self.precision,
         )
         fill = sum(len(a) for a in rowmap) / max(qbuf.shape[0]
                                                  * qbuf.shape[2], 1)
@@ -265,6 +287,7 @@ class QueryEngine:
             "index_device_bytes": int(staging.route_nbytes("serve_index")),
             "staged_bytes_reused": int(st.get("staged_bytes_reused", 0)),
             "backend": str(self.backend),
+            "precision": str(self.precision),
         }
 
 

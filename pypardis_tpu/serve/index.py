@@ -319,9 +319,15 @@ class CorePointIndex:
         return qbuf, qmask, tile_leaf, rowmap
 
     def dispatch(self, qbuf, qmask, tile_leaf, backend: str = "auto",
-                 interpret: bool = False):
+                 interpret: bool = False, precision: str = "high"):
         """Launch the query kernel for one assembled batch (async);
-        returns the packed (2, nqt, qb) int32 device result."""
+        returns the packed (2, nqt, qb) int32 device result.
+
+        ``precision="mixed"`` turns on the bf16-peak candidate prune in
+        both kernels (survivors rescore through the sealed exact path,
+        so the bitwise oracle contract is preserved — see
+        :func:`pypardis_tpu.ops.query.query_min_core`).
+        """
         import jax.numpy as jnp
 
         from ..ops.query import query_min_core, resolve_query_backend
@@ -337,13 +343,15 @@ class CorePointIndex:
             return query_min_core_pallas(
                 jnp.asarray(qbuf), jnp.asarray(tile_leaf), coords, labels,
                 jnp.zeros(1, jnp.int32),
+                jnp.full(1, self.eps2, jnp.float32),
                 block=self.block, nb=self.nb, interpret=interpret,
+                precision=precision,
             )
         return query_min_core(
             jnp.asarray(qbuf), jnp.asarray(qmask), jnp.asarray(tile_leaf),
             coords, labels, blo, bhi, jnp.float32(self.eps2),
             jnp.int32(0),
-            block=self.block, nb=self.nb,
+            block=self.block, nb=self.nb, precision=precision,
         )
 
     # -- oracle -----------------------------------------------------------

@@ -22,9 +22,11 @@ from .shaping import round_up
 def _stat_rows(pstats) -> np.ndarray:
     """Normalize pstats to (n_runs, width) rows.
 
-    Rows are ``[live_pairs_total, budget]`` or ``[live_pairs_total,
-    budget, kernel_passes]`` — the ladder only reads the first two
-    columns; the third rides through for the drivers' FLOP model.
+    Rows are ``[live_pairs_total, budget]`` or the full 5-wide
+    ``[live_pairs_total, budget, kernel_passes, band_pairs,
+    rescored_tiles]`` (``ops.precision.PAIR_STATS_WIDTH``) — the
+    ladder only reads the first two columns; the rest ride through for
+    the drivers' FLOP model and the mixed-precision band telemetry.
     """
     ps = np.asarray(pstats)
     return ps.reshape(-1, ps.shape[-1] if ps.ndim else 1)

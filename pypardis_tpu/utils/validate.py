@@ -40,6 +40,31 @@ def check_query_points(points, k=None) -> np.ndarray:
     return points
 
 
+def check_precision(precision) -> str:
+    """Validate a kernel precision spec; returns the canonical mode.
+
+    Accepts the mode strings (``default``/``high``/``highest``/
+    ``mixed``, any case) and ``jax.lax.Precision`` values, raising the
+    shared normalizer's ValueError otherwise — so a typo'd
+    ``DBSCAN(precision="hgih")`` fails at construction with the
+    allowed list, not deep inside a jit trace at first fit.
+    """
+    from ..ops.precision import norm_precision_mode
+
+    return norm_precision_mode(precision)
+
+
+def check_kernel_backend(backend) -> str:
+    """Validate a kernel backend spec (``auto``/``xla``/``pallas``)."""
+    b = str(backend).lower()
+    if b not in ("auto", "xla", "pallas"):
+        raise ValueError(
+            f"kernel_backend must be one of ('auto', 'xla', 'pallas'), "
+            f"got {backend!r}"
+        )
+    return b
+
+
 def validate_params(eps, min_samples) -> None:
     """Raise ValueError on an invalid concrete (eps, min_samples).
 

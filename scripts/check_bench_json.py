@@ -104,6 +104,25 @@ def main() -> None:
     for key in ("live_pairs", "kernel_passes",
                 "achieved_flops_per_sec", "mfu"):
         number("compute", key)
+    # Mixed-precision contract (ISSUE 7 / ROADMAP item 3): every row
+    # states its kernel precision mode and carries the band-rescoring
+    # telemetry — zero off precision="mixed", finite always; mfu is
+    # reported against BOTH the bf16 peak (mfu) and the f32-synth
+    # (bf16_3x) effective peak.
+    mode = tel["compute"].get("precision_mode")
+    if mode not in ("default", "high", "highest", "mixed"):
+        fail(
+            f"telemetry.compute.precision_mode is {mode!r}, expected "
+            f"one of default|high|highest|mixed"
+        )
+    for key in ("band_fraction", "rescored_pairs", "band_pairs",
+                "mfu_f32_synth"):
+        number("compute", key)
+    if number("compute", "band_fraction") > 1.0:
+        fail(
+            f"telemetry.compute.band_fraction "
+            f"{tel['compute']['band_fraction']!r} exceeds 1.0"
+        )
     # Resource-watermark contract (ISSUE 6): every row carries the
     # sampler's peaks, finite on every route (0 is legal — e.g. device
     # bytes on backends that don't report memory_stats — NaN never is).
@@ -213,6 +232,8 @@ def main() -> None:
         f"(dup_work={tel['sharding']['duplicated_work_factor']}, "
         f"staged_reuse={tel['sharding']['staged_bytes_reused']}, "
         f"mfu={tel['compute']['mfu']}, "
+        f"precision={tel['compute']['precision_mode']}, "
+        f"band_fraction={tel['compute']['band_fraction']}, "
         f"rss_peak={tel['resources']['peak_host_rss_bytes']}, "
         f"events: {tel['events']}"
         f"{diff_note}{serve_note})"

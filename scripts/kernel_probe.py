@@ -1,4 +1,9 @@
-"""Timing breakdown: counts pass vs one minlab pass vs full pipeline."""
+"""Timing breakdown: counts pass vs one minlab pass vs full pipeline,
+plus a precision-mode sweep of the counts pass (default / mixed / high /
+highest) — the kernel-level view of what ``precision="mixed"`` buys:
+one bf16 pass + band-restricted rescores vs bf16_3x vs native f32.
+Mixed rows also print the measured band stats (in-band pairs, rescored
+tile visits)."""
 import sys
 import time
 
@@ -47,6 +52,27 @@ def main():
 
     dt_c = t(neighbor_counts_pallas, pts, eps, mask, block=block)
     print(f"counts pass: {dt_c:.2f}s")
+
+    # Precision-mode sweep: one counts pass per mode on the identical
+    # input.  "mixed" reports its band stats so the rescore economy
+    # (fast-peak bulk vs band-restricted bf16_3x tiles) is visible per
+    # geometry, not just per bench row.
+    for mode in ("default", "mixed", "high", "highest"):
+        def run_mode(mode=mode):
+            out = neighbor_counts_pallas(
+                pts, eps, mask, block=block, precision=mode
+            )
+            return out[0] if mode == "mixed" else out
+
+        dt_m_sweep = t(run_mode)
+        note = ""
+        if mode == "mixed":
+            _, bstats = neighbor_counts_pallas(
+                pts, eps, mask, block=block, precision="mixed"
+            )
+            bp, rt = [int(v) for v in np.asarray(bstats)]
+            note = f"  band_pairs={bp} rescored_tiles={rt}"
+        print(f"counts[precision={mode:7s}]: {dt_m_sweep:.2f}s{note}")
     counts = neighbor_counts_pallas(pts, eps, mask, block=block)
     core = (counts >= 10) & mask
     labels = jnp.where(core, jnp.arange(cap, dtype=jnp.int32), 2**31 - 1)

@@ -53,7 +53,7 @@ def main():
     from pypardis_tpu.ops.pipeline import unpack_pipeline_result
 
     t0 = time.perf_counter()
-    roots, _core, _total, _budget, _passes = unpack_pipeline_result(packed)
+    roots = unpack_pipeline_result(packed)[0]
     labels = densify_labels(roots[:n])
     t_dense = time.perf_counter() - t0
 

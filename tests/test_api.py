@@ -155,15 +155,18 @@ def test_packed_pipeline_result_roundtrip():
         [True, False, True, False, False, True, True, False] + [False] * 8
     )
     owner = jnp.arange(cap, dtype=jnp.int32)
-    stats = jnp.asarray([42, 100, 7], jnp.int32)
+    stats = jnp.asarray([42, 100, 7, 13, 3], jnp.int32)
     packed = np.asarray(
         _pipeline_pack(roots_s, core_s, stats, owner, cap=cap)
     )
-    roots, core, total, budget, passes = unpack_pipeline_result(packed)
+    roots, core, total, budget, passes, band_pairs, rescored = (
+        unpack_pipeline_result(packed)
+    )
     want = np.asarray([3, -1, 0, 5, -1, 2, 7, 1] + [-1] * 8)
     assert (roots == want).all()
     assert (core == np.asarray(core_s)).all()
     assert (total, budget, passes) == (42, 100, 7)
+    assert (band_pairs, rescored) == (13, 3)
 
 
 def test_cluster_mapping_vectorized_matches_loop():

@@ -152,7 +152,8 @@ def test_e2e_backend_pallas_interpret(blob_data, monkeypatch):
     l_p, core_p, pair_stats = dbscan_fixed_size(
         pts, 2.0, 8, mask, block=256, backend="pallas"
     )
-    total, budget, passes = np.asarray(pair_stats)
+    total, budget, passes, band_pairs, rescored = np.asarray(pair_stats)
+    assert (band_pairs, rescored) == (0, 0)  # non-mixed precision
     assert 0 < total <= budget
     assert passes >= 2  # the counts pass plus at least one minlab pass
     valid = np.asarray(mask)
