@@ -152,6 +152,49 @@ def make_manifold_data(
     return X, assign
 
 
+def make_separated_blob_data(
+    n: int,
+    dim: int,
+    *,
+    n_centers: int = 8,
+    std: float = 0.4,
+    min_sep: float = 6.0,
+    spread: float = 10.0,
+    seed: int = 0,
+):
+    """Gaussian blobs with a GUARANTEED minimum center separation;
+    returns ``(X, truth, centers)``.
+
+    The live-update correctness tests compare incremental labels
+    against a full refit with ARI == 1.0 — a guarantee that holds
+    exactly when no border point sits within eps of two different
+    clusters' cores (the one place DBSCAN's own output is
+    order-ambiguous).  Rejection-sampling centers to ``min_sep``
+    (choose ``min_sep > 2*eps + 6*std``) removes that ambiguity by
+    construction, making ARI == 1.0 a sound assertion rather than a
+    flaky one.
+    """
+    rng = np.random.default_rng(seed)
+    centers = [rng.uniform(-spread, spread, size=dim)]
+    tries = 0
+    while len(centers) < n_centers:
+        c = rng.uniform(-spread, spread, size=dim)
+        if min(np.linalg.norm(c - o) for o in centers) >= min_sep:
+            centers.append(c)
+        tries += 1
+        if tries > 10000:
+            raise ValueError(
+                f"cannot place {n_centers} centers with min_sep="
+                f"{min_sep} inside +-{spread}; loosen one of them"
+            )
+    centers = np.asarray(centers)
+    assign = rng.integers(0, n_centers, size=n)
+    X = (centers[assign] + rng.normal(scale=std, size=(n, dim))).astype(
+        np.float64
+    )
+    return X, assign, centers
+
+
 def ari_vs_truth(labels, truth) -> float:
     """Adjusted Rand index of predicted labels vs the generating
     assignment — the oracle field every benchmark row carries (noise

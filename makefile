@@ -4,8 +4,8 @@
 PY ?= python
 
 .PHONY: all wheel native test verify tpu-smoke bench bench-smoke \
-	partition-probe serve-probe global-morton-probe bench-diff \
-	flight-check demo clean
+	partition-probe serve-probe live-probe global-morton-probe \
+	bench-diff flight-check demo clean
 
 all: native test
 
@@ -45,8 +45,8 @@ bench:
 # check_bench_json --require-diff fails CI on a real regression),
 # then the CI-sized partitioner depth-scaling probe (fails when the
 # level builder's mp-doubling cost ratio exceeds 1.5x).
-bench-smoke: partition-probe serve-probe global-morton-probe bench-diff \
-		flight-check
+bench-smoke: partition-probe serve-probe live-probe global-morton-probe \
+		bench-diff flight-check
 	JAX_PLATFORMS=cpu BENCH_N=2000 BENCH_DIM=4 BENCH_REPS=1 \
 	BENCH_DEV_REPS=1 $(PY) bench.py \
 	| $(PY) scripts/bench_diff.py --annotate --baseline-dir . \
@@ -87,6 +87,21 @@ global-morton-probe:
 serve-probe:
 	JAX_PLATFORMS=cpu SERVE_N=$${SERVE_N:-4000} \
 	SERVE_Q=$${SERVE_Q:-1024} $(PY) scripts/serve_probe.py \
+	| $(PY) scripts/check_bench_json.py
+
+# Live-update probe (ISSUE 8): insert/delete latency p50/p99 + the
+# measured re-cluster blast radius (asserts recluster_tile_fraction <
+# 1.0 for a boundary-interior insert, incremental ARI == 1.0 vs full
+# refit, predict bitwise oracle-exact on the updated index), a
+# Poisson sustained-load row with >= 4 concurrent clients, and the
+# replicated-index throughput row (>= 2x gate enforced on hosts with
+# parallel device execution; the 1-core CI container reports the ratio
+# and asserts bitwise parity).  Schema'd like every bench row.
+live-probe:
+	JAX_PLATFORMS=cpu \
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	LIVE_N=$${LIVE_N:-4000} LIVE_SECONDS=$${LIVE_SECONDS:-1.5} \
+	$(PY) scripts/live_probe.py \
 	| $(PY) scripts/check_bench_json.py
 
 # KDPartitioner build-time-vs-max_partitions rows (both builders, with

@@ -87,8 +87,17 @@ def load_partitioner(path: str) -> PartitionTree:
         )
 
 
-def save_model(model, path: str) -> None:
-    """Persist a trained DBSCAN's results + hyperparameters."""
+def save_model(model, path: str, *, live=None, index=None) -> None:
+    """Persist a trained DBSCAN's results + hyperparameters.
+
+    ``live``/``index`` (both or neither — the ``LiveModel.save`` path)
+    additionally persist the MUTATED live state: the current point set
+    with labels/core flags/stable ids, the live routing tree and
+    counters, and the in-place-updated serving index slabs byte-exact
+    (epoch, leaf->slab map, slot gids included) — so a restarted server
+    resumes serving the updated model byte-identically and can keep
+    accepting writes.
+    """
     if model.labels_ is None:
         raise ValueError("model is untrained; nothing to checkpoint")
     boxes = model.bounding_boxes or {}
@@ -121,6 +130,52 @@ def save_model(model, path: str) -> None:
         cores = np.asarray(model.data)[
             np.asarray(model.core_sample_mask_, bool)
         ]
+    extra = {}
+    if live is not None:
+        extra.update(
+            live_points=np.asarray(live["points"], np.float64),
+            live_labels=np.asarray(live["labels"], np.int32),
+            live_core=np.asarray(live["core"], bool),
+            live_gids=np.asarray(live["gids"], np.int64),
+            live_tree=np.asarray(live["tree"], np.float64).reshape(-1, 5),
+            live_meta=json.dumps({
+                "next_label": int(live["next_label"]),
+                "counters": {
+                    k: int(v) for k, v in live["counters"].items()
+                },
+            }),
+        )
+    if index is not None:
+        # Leaf -> slab map flattened to (leaf, slab) pairs (ragged dict
+        # otherwise); slot gids ride so deletions keep working after a
+        # restore.
+        pairs = [
+            (int(l), int(s))
+            for l, slabs in sorted(index.leaf_slabs.items())
+            for s in slabs
+        ]
+        extra.update(
+            index_coords=index.coords,
+            index_labels=index.labels,
+            index_blo=index.blo,
+            index_bhi=index.bhi,
+            index_center=index.center,
+            index_tree=np.asarray(index.tree, np.float64).reshape(-1, 5),
+            index_gids=(
+                index.gids if index.gids is not None
+                else np.empty(0, np.int64)
+            ),
+            index_leaf_slabs=np.asarray(pairs, np.int64).reshape(-1, 2),
+            index_meta=json.dumps({
+                "eps": index.eps,
+                "block": index.block,
+                "qblock": index.qblock,
+                "n_core": index.n_core,
+                "leaf_cap": int(index.stats.get("leaf_cap", 0)),
+                "n_leaves": int(index.stats.get("n_leaves", 0)),
+                "epoch": int(index.epoch),
+            }),
+        )
     np.savez(
         _norm_npz(path),
         kind="dbscan_model",
@@ -140,6 +195,7 @@ def save_model(model, path: str) -> None:
         if labels
         else np.zeros((0, 0)),
         metrics=json.dumps(model.metrics_),
+        **extra,
     )
 
 
@@ -180,6 +236,56 @@ def load_model(path: str):
         # without retraining or the original dataset.
         if "core_points" in z.files and z["core_points"].size:
             model._serve_core_points = z["core_points"]
+        # Live-update payload (LiveModel.save checkpoints): the mutated
+        # point set + byte-exact index slabs, handed to LiveModel.load
+        # via _live_ckpt (plain load_model callers never see it).
+        if "live_points" in z.files:
+            from .serve import CorePointIndex
+
+            imeta = json.loads(str(z["index_meta"]))
+            lmeta = json.loads(str(z["live_meta"]))
+            leaf_slabs: dict = {}
+            for leaf, slab in z["index_leaf_slabs"]:
+                leaf_slabs.setdefault(int(leaf), []).append(int(slab))
+            idx = CorePointIndex(
+                eps=imeta["eps"],
+                center=z["index_center"],
+                tree=z["index_tree"],
+                coords=z["index_coords"],
+                labels=z["index_labels"],
+                blo=z["index_blo"],
+                bhi=z["index_bhi"],
+                block=imeta["block"],
+                qblock=imeta["qblock"],
+                n_core=imeta["n_core"],
+                leaf_slabs=leaf_slabs,
+                gids=(
+                    z["index_gids"] if z["index_gids"].size else None
+                ),
+                stats={
+                    "n_core": imeta["n_core"],
+                    "n_leaves": imeta["n_leaves"],
+                    "leaf_cap": imeta["leaf_cap"],
+                    "index_bytes": int(
+                        z["index_coords"].nbytes
+                        + z["index_labels"].nbytes
+                        + z["index_blo"].nbytes + z["index_bhi"].nbytes
+                    ),
+                    "staged_bytes_reused": 0,
+                    "staged_bytes": 0,
+                },
+            )
+            idx.epoch = int(imeta["epoch"])
+            model._live_ckpt = {
+                "points": z["live_points"],
+                "labels": z["live_labels"],
+                "core": z["live_core"],
+                "gids": z["live_gids"],
+                "tree": z["live_tree"],
+                "next_label": lmeta["next_label"],
+                "counters": lmeta["counters"],
+                "index": idx,
+            }
         # ``result`` builds lazily from the restored keys/labels (the
         # property key-sorts; an eager unsorted build here violated the
         # sortByKey contract for non-arange keys).

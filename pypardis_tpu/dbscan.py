@@ -556,6 +556,13 @@ class DBSCAN:
         # coordinates the index builds from.
         self._serve_engine = None
         self._serve_core_points = None
+        # Live-update state (pypardis_tpu.serve.live): the cached
+        # LiveModel behind insert()/delete(), its telemetry dict (the
+        # report()'s ``live`` block), and the fit generation counter a
+        # stale held engine is checked against.
+        self._live_model = None
+        self._live_stats = None
+        self._fit_generation = 0
 
     # -- training ---------------------------------------------------------
 
@@ -587,9 +594,14 @@ class DBSCAN:
         self.metrics_ = {}
         # A refit invalidates the serving surface: the cached engine
         # indexes the PREVIOUS clustering, and checkpoint-carried core
-        # points describe a model this fit replaces.
+        # points describe a model this fit replaces.  The generation
+        # bump is what lets a caller-held stale engine/LiveModel raise
+        # a clear error instead of silently serving the old model.
         self._serve_engine = None
         self._serve_core_points = None
+        self._live_model = None
+        self._live_stats = None
+        self._fit_generation += 1
 
         if len(points) == 0:
             self.labels_ = np.empty(0, np.int32)
@@ -792,6 +804,31 @@ class DBSCAN:
             self._serve_engine = QueryEngine.from_model(self, **kw)
         return self._serve_engine
 
+    # -- live updates -----------------------------------------------------
+
+    def live(self, **kw):
+        """The cached :class:`~pypardis_tpu.serve.live.LiveModel` over
+        this fitted model — the incremental write surface (built on
+        first use; kwargs force a rebuild).  Invalidated by a refit."""
+        self._require_fitted()
+        if self._live_model is None or kw:
+            from .serve import LiveModel
+
+            self._live_model = LiveModel(self, **kw)
+        return self._live_model
+
+    def insert(self, X) -> np.ndarray:
+        """Incrementally insert points into the fitted clustering
+        (DBSCAN-correct label maintenance, serving index refreshed in
+        place); returns the new points' stable ids.  See
+        :class:`~pypardis_tpu.serve.live.LiveModel`."""
+        return self.live().insert(X)
+
+    def delete(self, ids) -> int:
+        """Incrementally delete points by id (as returned by
+        :meth:`insert`; the initial fit's points are ``0..n-1``)."""
+        return self.live().delete(ids)
+
     # -- telemetry --------------------------------------------------------
 
     def report(self) -> Dict:
@@ -812,6 +849,7 @@ class DBSCAN:
             eng.serving_stats() if eng is not None and eng.queries > 0
             else None
         )
+        live = dict(self._live_stats) if self._live_stats else None
         return build_run_report(
             self._recorder,
             params={
@@ -835,6 +873,7 @@ class DBSCAN:
             backend=jax_backend_name(),
             metrics=self.metrics_,
             serving=serving,
+            live=live,
         )
 
     def summary(self) -> str:

@@ -204,6 +204,7 @@ def build_run_report(
     backend: str,
     metrics: Dict,
     serving: Optional[Dict] = None,
+    live: Optional[Dict] = None,
 ) -> Dict:
     """Assemble the stable report dict from a fit's recorder + metrics.
 
@@ -346,6 +347,14 @@ def build_run_report(
     # block on serve_probe rows.
     if serving:
         report["serving"] = serving
+    # Live-update gauges (pypardis_tpu.serve.live): present once the
+    # model has a LiveModel attached — insert/delete volumes, the
+    # measured re-cluster blast radius (recluster_tile_fraction), the
+    # in-place index-refresh economy (epoch + delta bytes), and update
+    # latency percentiles.  scripts/check_bench_json.py enforces the
+    # block on live_* rows.
+    if live:
+        report["live"] = live
     return _clean(report)
 
 
@@ -464,6 +473,19 @@ def format_summary(report: Dict) -> str:
             f"{srv.get('n_core', 0):,} cores / "
             f"{srv.get('n_leaves', 0)} leaves "
             f"({_fmt_bytes(srv.get('index_bytes', 0))})"
+        )
+    lv = report.get("live")
+    if lv:
+        lines.append(
+            f"  live: {lv.get('points', 0):,} pts "
+            f"({lv.get('cores', 0):,} cores), "
+            f"+{lv.get('inserts', 0)}/-{lv.get('deletes', 0)} in "
+            f"{lv.get('updates', 0)} update(s), "
+            f"recluster x{lv.get('recluster_events', 0)} "
+            f"(tile frac {lv.get('recluster_tile_fraction', 0):.2f}), "
+            f"epoch {lv.get('index_epoch', 0)} "
+            f"({_fmt_bytes(lv.get('index_delta_bytes', 0))} delta), "
+            f"insert p50 {lv.get('insert_p50_ms', 0):.1f}ms"
         )
     res = report.get("resources") or {}
     if res.get("samples", 0) > 0:

@@ -1,0 +1,164 @@
+"""Primitives behind incremental insert/delete on a fitted model.
+
+Incremental DBSCAN (Ester et al., VLDB 1998) rests on one locality
+fact: inserting or deleting a point only perturbs core-ness within
+``eps`` of the change, and labels within ``eps`` of those flips — so
+the write path never needs a global pass.  This module supplies the
+three primitives :class:`pypardis_tpu.serve.live.LiveModel` composes:
+
+* :func:`count_within_eps` — exact neighbor counts of a query set
+  against a candidate set.  Runs in **float64 on the raw coordinates**:
+  the fit kernels' float32 verdicts depend on the dataset mean (the
+  recentring frame moves with every insert), so a maintained f32 count
+  could flip across updates for a pair that never moved.  The f64
+  verdict is frame-independent — one ground truth for the whole update
+  sequence.  (A pair within one f32 ulp of eps can still disagree with
+  a fresh fit's verdict; continuous data never produces one.)
+
+* :func:`core_components` — eps-connectivity components of a set of
+  KNOWN core points, by running the existing fused device kernel
+  (:func:`pypardis_tpu.dbscan._pad_and_run`) with ``min_samples=1``.
+  Core flags are maintained incrementally and exactly by the caller,
+  so the local re-cluster needs *connectivity only* — with every point
+  core by construction, the kernel's components ARE the eps-graph
+  components, and no halo ring is needed to get slab-local counts
+  right (the PR 2 owner-computes lesson, inverted: ship verdicts, not
+  evidence).
+
+* :func:`attach_to_cores` — deterministic border assignment: nearest
+  core within eps, ties to the smallest label — the serving rule
+  (:mod:`pypardis_tpu.ops.query`), in the same f64 frame as the
+  counts.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+# Keep the (chunk x n_candidates) distance temp around 64MB of f64.
+_CHUNK_ELEMS = 1 << 23
+
+_INT_INF = np.int32(np.iinfo(np.int32).max)
+
+
+def _chunk_rows(n_cand: int) -> int:
+    return max(1, _CHUNK_ELEMS // max(n_cand, 1))
+
+
+def sq_dists_f64(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """(m, k) x (n, k) -> (m, n) float64 squared distances (one shot —
+    callers chunk; the expansion-free direct form keeps f64 exact at
+    any coordinate magnitude)."""
+    diff = a[:, None, :] - b[None, :, :]
+    return np.einsum("mnk,mnk->mn", diff, diff)
+
+
+def count_within_eps(
+    queries: np.ndarray, candidates: np.ndarray, eps: float
+) -> np.ndarray:
+    """(m,) int64 counts of candidate points within ``eps`` (inclusive,
+    matching the fit kernels' ``d2 <= eps^2``) of each query row.
+
+    A query that also appears among the candidates counts itself — the
+    DBSCAN core rule's self-count (min_samples includes the point).
+    """
+    q = np.asarray(queries, np.float64)
+    c = np.asarray(candidates, np.float64)
+    m = len(q)
+    out = np.zeros(m, np.int64)
+    if m == 0 or len(c) == 0:
+        return out
+    e2 = float(eps) ** 2
+    step = _chunk_rows(len(c))
+    for s in range(0, m, step):
+        out[s:s + step] = (sq_dists_f64(q[s:s + step], c) <= e2).sum(axis=1)
+    return out
+
+
+def within_eps_mask(
+    queries: np.ndarray, candidates: np.ndarray, eps: float
+) -> np.ndarray:
+    """(m,) bool: query row has SOME candidate within eps (inclusive)."""
+    return count_within_eps(queries, candidates, eps) > 0
+
+
+def attach_to_cores(
+    points: np.ndarray,
+    cores: np.ndarray,
+    core_labels: np.ndarray,
+    eps: float,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Deterministic border attachment: ``(labels, d2)`` per point —
+    the cluster of the nearest core within eps (ties: smallest label),
+    -1 / +inf where no core reaches.  Same rule as the serving oracle
+    (:func:`pypardis_tpu.ops.query.brute_force_query`), computed in the
+    f64 frame the incremental counts use."""
+    p = np.asarray(points, np.float64)
+    c = np.asarray(cores, np.float64)
+    lab = np.asarray(core_labels, np.int64)
+    m = len(p)
+    out_lab = np.full(m, -1, np.int32)
+    out_d2 = np.full(m, np.inf, np.float64)
+    if m == 0 or len(c) == 0:
+        return out_lab, out_d2
+    e2 = float(eps) ** 2
+    step = _chunk_rows(len(c))
+    for s in range(0, m, step):
+        d2 = sq_dists_f64(p[s:s + step], c)
+        dmin = d2.min(axis=1)
+        tied = np.where(d2 == dmin[:, None], lab[None, :], np.int64(_INT_INF))
+        labmin = tied.min(axis=1)
+        sel = dmin <= e2
+        out_lab[s:s + step] = np.where(sel, labmin, -1).astype(np.int32)
+        out_d2[s:s + step] = np.where(sel, dmin, np.inf)
+    return out_lab, out_d2
+
+
+def core_components(
+    cores: np.ndarray,
+    eps: float,
+    *,
+    block: int = 256,
+    precision: str = "high",
+    backend: str = "auto",
+) -> np.ndarray:
+    """(n,) int32 eps-connectivity component ids (dense, from 0) of a
+    set of KNOWN core points — the local re-cluster's compute step.
+
+    Runs the existing fused single-chip kernel with ``min_samples=1``:
+    every input is core by construction (the caller maintains core
+    flags exactly), so the kernel's cluster labels are precisely the
+    connected components of the eps-graph over these points.  The slab
+    is the extracted blast radius — a few KD leaves — so this is the
+    one device pass of an incremental update.
+    """
+    cores = np.asarray(cores, np.float64)
+    n = len(cores)
+    if n == 0:
+        return np.empty(0, np.int32)
+    if n == 1:
+        return np.zeros(1, np.int32)
+    from ..dbscan import _pad_and_run
+    from . import densify_labels
+
+    roots, _core, _info = _pad_and_run(
+        cores, eps, 1, "euclidean", block, precision=precision,
+        backend=backend,
+    )
+    return densify_labels(roots)
+
+
+def label_lut(mapping: dict, max_id: int) -> np.ndarray:
+    """Dense int32 LUT for a union-find label mapping
+    (:func:`pypardis_tpu.parallel.merge.resolve_label_edges` output):
+    identity outside the mapping, so it can be applied to any label
+    array with one fancy-index — including the device-resident index
+    labels row (:meth:`pypardis_tpu.serve.CorePointIndex
+    .apply_label_map`)."""
+    lut = np.arange(max(int(max_id) + 1, 1), dtype=np.int32)
+    for k, v in mapping.items():
+        if 0 <= int(k) < len(lut):
+            lut[int(k)] = int(v)
+    return lut

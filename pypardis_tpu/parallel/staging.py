@@ -51,6 +51,11 @@ _host_pool: dict = {}
 # route -> (key, tuple_of_device_arrays, nbytes).  One entry per route.
 _device_cache: dict = {}
 
+# route -> cumulative bytes shipped as IN-PLACE deltas (device_replace):
+# the live-update economy's gauge — a pad-slot insert or one-leaf
+# rebuild ships kilobytes against a megabyte-scale resident.
+_route_delta: dict = {}
+
 # Telemetry for the current fit, reset by begin_fit().
 _fit_stats = {"reused": 0, "staged": 0}
 
@@ -71,6 +76,7 @@ def clear() -> None:
     and callers that need the HBM back between fits)."""
     _host_pool.clear()
     _device_cache.clear()
+    _route_delta.clear()
 
 
 def pool_nbytes() -> int:
@@ -172,6 +178,36 @@ def device_evict(route: str) -> None:
     the retry must rebuild, not re-serve dead handles)."""
     if _device_cache.pop(route, None) is not None:
         flight_note("staging.evict", route=route, reason="explicit")
+
+
+def route_delta_nbytes(route: str) -> int:
+    """Cumulative bytes shipped through :func:`device_replace` for a
+    delta route — telemetry for in-place index refreshes."""
+    return int(_route_delta.get(route, 0))
+
+
+def device_replace(
+    route: str, key, arrays: tuple, *, staged_nbytes: int,
+    delta_route: Optional[str] = None,
+) -> tuple:
+    """Swap a route's cached device arrays for an IN-PLACE-updated
+    generation: the entry's resident size is the full new arrays (for
+    ``route_nbytes`` / pool watermarks) but the staging counters move
+    only by ``staged_nbytes`` — the bytes that actually crossed the
+    host->device link (the scattered columns, appended slabs, and
+    relabel LUT of a live index delta, never the whole resident)."""
+    nbytes = sum(int(np.prod(a.shape)) * a.dtype.itemsize for a in arrays)
+    _fit_stats["staged"] += int(staged_nbytes)
+    _device_cache[route] = (key, arrays, {}, nbytes)
+    if delta_route is not None:
+        _route_delta[delta_route] = (
+            _route_delta.get(delta_route, 0) + int(staged_nbytes)
+        )
+    flight_note(
+        "staging.device_replace", route=route,
+        delta_nbytes=int(staged_nbytes), nbytes=int(nbytes),
+    )
+    return arrays
 
 
 def device_put_cached(route: str, key, arrays: tuple, aux=None) -> tuple:

@@ -26,7 +26,16 @@ persistence via :func:`pypardis_tpu.checkpoint.save_index` /
 a restarted process serves without re-clustering).
 """
 
-from .engine import QueryEngine
+from .engine import QueryEngine, ReplicatedQueryEngine
 from .index import CorePointIndex, build_index
+from .live import LiveModel
+from .load import sustained_load
 
-__all__ = ["CorePointIndex", "QueryEngine", "build_index"]
+__all__ = [
+    "CorePointIndex",
+    "QueryEngine",
+    "ReplicatedQueryEngine",
+    "LiveModel",
+    "build_index",
+    "sustained_load",
+]

@@ -81,6 +81,7 @@ class CorePointIndex:
     def __init__(
         self, *, eps, center, tree, coords, labels, blo, bhi,
         block: int, qblock: int, n_core: int, stats: Optional[Dict] = None,
+        leaf_slabs: Optional[Dict] = None, gids=None,
     ):
         self.eps = float(eps)
         self.eps2 = eps2_f32(eps)
@@ -99,6 +100,31 @@ class CorePointIndex:
         self.stats: Dict = dict(stats or {})
         self._margin = self.eps * _MARGIN_SLACK
         self._dev = None
+        # Live-update state (the serve_index_delta path): monotone
+        # generation counter (bumped on every in-place mutation — the
+        # epoch the engine publishes), tree-leaf -> slab ids (a leaf
+        # that overflowed its pad slots owns extra slabs appended past
+        # the build layout; routing fans a query out to all of them),
+        # and per-slot point gids so deletions can find their columns.
+        self.epoch = 0
+        self.delta_bytes = 0
+        if leaf_slabs is not None:
+            self.leaf_slabs = {
+                int(l): [int(s) for s in slabs]
+                for l, slabs in leaf_slabs.items()
+            }
+        else:
+            n_slabs = (
+                0 if self.coords.shape[1] == 0
+                else self.coords.shape[1] // max(self.leaf_cap, 1)
+            )
+            self.leaf_slabs = {s: [s] for s in range(n_slabs)}
+            if not self.leaf_slabs:
+                self.leaf_slabs = {0: []}
+        self.gids = (
+            None if gids is None else np.asarray(gids, np.int64)
+        )
+        self._gid_col: Optional[Dict[int, int]] = None
 
     # -- construction -----------------------------------------------------
 
@@ -141,6 +167,7 @@ class CorePointIndex:
             idx.stats = {"n_core": 0, "n_leaves": 0, "build_s": 0.0,
                          "index_bytes": 0, "staged_bytes_reused": 0,
                          "staged_bytes": 0}
+            idx.src_index = np.empty(0, np.int64)
             return idx
         # Center in float64 (the fit drivers' discipline: the f32 cast
         # after a f64 subtract keeps GPS-scale magnitudes accurate) —
@@ -163,12 +190,16 @@ class CorePointIndex:
         nb = C // block
         coords = np.full((d, L * C), PAD_COORD, np.float32)
         slab_labels = np.full(L * C, _INT_INF, np.int32)
+        # slab column -> input core row (-1 pads): the permutation the
+        # live path needs to attach stable point ids to slots.
+        src_index = np.full(L * C, -1, np.int64)
         for leaf in range(L):
             idx_l = np.asarray(parts[leaf])
             idx_l = idx_l[spatial_order(cores_c[idx_l])]
             s = leaf * C
             coords[:, s:s + len(idx_l)] = cores_c[idx_l].T
             slab_labels[s:s + len(idx_l)] = labels[idx_l]
+            src_index[s:s + len(idx_l)] = idx_l
         # Per-column-block core bounds for the XLA kernel's gap pruning
         # (empty blocks invert, so they always prune).
         valid = (slab_labels != _INT_INF).reshape(L * nb, block)
@@ -180,6 +211,11 @@ class CorePointIndex:
             labels=slab_labels, blo=blo, bhi=bhi, block=block,
             qblock=int(qblock), n_core=n,
         )
+        idx.src_index = src_index
+        # The constructor's slab map derives from stats["leaf_cap"],
+        # which is only assigned below — set the build layout's
+        # tree-leaf <-> slab identity explicitly.
+        idx.leaf_slabs = {leaf: [leaf] for leaf in range(L)}
         idx.stats = {
             "n_core": n,
             "n_leaves": L,
@@ -217,9 +253,12 @@ class CorePointIndex:
 
     @property
     def leaf_cap(self) -> int:
-        if self.n_core == 0:
+        cap = int(self.stats.get("leaf_cap", 0) or 0)
+        if cap > 0:
+            return cap
+        if self.coords.shape[1] == 0:
             return self.block
-        return int(self.stats.get("leaf_cap", self.coords.shape[1]))
+        return int(self.coords.shape[1])
 
     @property
     def nb(self) -> int:
@@ -263,6 +302,301 @@ class CorePointIndex:
         self._dev = arrays
         return arrays
 
+    # -- live updates (the serve_index_delta path) ------------------------
+
+    def attach_gids(self, core_gids) -> None:
+        """Attach stable point ids to the slab slots: ``core_gids`` is
+        in the order the cores were passed to :meth:`build` (the
+        ``src_index`` permutation maps them onto columns).  Required
+        before :meth:`remove_gids` / :meth:`set_label_gids`."""
+        src = getattr(self, "src_index", None)
+        gids = np.full(len(self.labels), -1, np.int64)
+        if src is not None and len(src):
+            sel = src >= 0
+            gids[sel] = np.asarray(core_gids, np.int64)[src[sel]]
+        self.gids = gids
+        self._gid_col = None
+
+    def _gid_map(self) -> Dict[int, int]:
+        if self.gids is None:
+            raise RuntimeError(
+                "index has no point ids; call attach_gids() first"
+            )
+        if self._gid_col is None:
+            self._gid_col = {
+                int(g): int(c)
+                for c, g in enumerate(self.gids) if g >= 0
+            }
+        return self._gid_col
+
+    def begin_update(self) -> None:
+        """Open a mutation batch: every insert/remove/relabel until
+        :meth:`commit_update` edits the host mirrors only; the commit
+        recomputes touched block bounds and ships ONE device delta."""
+        if getattr(self, "_pending", None) is not None:
+            raise RuntimeError("an index update is already open")
+        self._pending = {
+            "cols": set(), "old_w": self.coords.shape[1], "lut": None,
+        }
+
+    def insert_cores(self, cores, labels, gids) -> None:
+        """Add core points (raw-frame coordinates) with their cluster
+        labels and stable ids.  Each point routes through the SAME
+        split tree queries replay, into its leaf's pad slots; a leaf
+        out of pad slots has its slab set rebuilt — members plus
+        newcomers re-Morton-sorted across the old slab(s) and one
+        appended slab — and only that leaf's columns re-ship."""
+        cores = np.asarray(cores)
+        labels = np.asarray(labels, np.int32)
+        gids = np.asarray(gids, np.int64)
+        n = len(cores)
+        if n == 0:
+            return
+        cc = np.ascontiguousarray(
+            (cores.astype(np.float64) - self.center).astype(np.float32)
+        )
+        if self.tree:
+            from ..partition import route_tree
+
+            leaves = route_tree(self.tree, cc)
+        else:
+            leaves = np.zeros(n, np.int32)
+        for leaf in np.unique(leaves):
+            sel = np.flatnonzero(leaves == leaf)
+            self._insert_into_leaf(
+                int(leaf), cc[sel], labels[sel], gids[sel]
+            )
+        self.n_core += n
+
+    def _insert_into_leaf(self, leaf, pts, labels, gids) -> None:
+        C = self.leaf_cap
+        slabs = self.leaf_slabs.setdefault(leaf, [])
+        free: list = []
+        for s in slabs:
+            free.extend(
+                (np.flatnonzero(
+                    self.labels[s * C:(s + 1) * C] == _INT_INF
+                ) + s * C).tolist()
+            )
+        if len(free) >= len(pts):
+            cols = np.asarray(free[:len(pts)], np.int64)
+            self._set_cols(cols, pts, labels, gids)
+        else:
+            self._rebuild_leaf(leaf, pts, labels, gids)
+
+    def _set_cols(self, cols, pts, labels, gids) -> None:
+        self.coords[:, cols] = pts.T
+        self.labels[cols] = labels
+        if self.gids is not None:
+            self.gids[cols] = gids
+            self._gid_col = None
+        self._pending["cols"].update(int(c) for c in cols)
+
+    def _rebuild_leaf(self, leaf, new_pts, new_labels, new_gids) -> None:
+        """Re-lay-out ONE overflowing leaf: old members + newcomers,
+        Morton re-sorted, across its slabs plus however many appended
+        slabs the overflow needs.  Every other leaf's columns are
+        untouched — the commit ships only this leaf's slabs."""
+        from ..partition import spatial_order
+
+        C = self.leaf_cap
+        slabs = self.leaf_slabs.setdefault(leaf, [])
+        old_cols = np.concatenate(
+            [np.arange(s * C, (s + 1) * C) for s in slabs]
+        ) if slabs else np.empty(0, np.int64)
+        live = old_cols[self.labels[old_cols] != _INT_INF] \
+            if len(old_cols) else old_cols
+        pts = np.concatenate(
+            [self.coords[:, live].T, np.asarray(new_pts, np.float32)]
+        ) if len(live) else np.asarray(new_pts, np.float32)
+        labs = np.concatenate([self.labels[live], new_labels])
+        gds = np.concatenate([
+            self.gids[live] if self.gids is not None
+            else np.full(len(live), -1, np.int64),
+            new_gids,
+        ])
+        m = len(pts)
+        while len(slabs) * C < m:
+            slabs.append(self._append_slab())
+        cols_all = np.concatenate(
+            [np.arange(s * C, (s + 1) * C) for s in slabs]
+        )
+        self.coords[:, cols_all] = PAD_COORD
+        self.labels[cols_all] = _INT_INF
+        if self.gids is not None:
+            self.gids[cols_all] = -1
+        order = spatial_order(pts)
+        dest = cols_all[:m]
+        self.coords[:, dest] = pts[order].T
+        self.labels[dest] = labs[order]
+        if self.gids is not None:
+            self.gids[dest] = gds[order]
+            self._gid_col = None
+        self.leaf_slabs[leaf] = slabs
+        self._pending["cols"].update(int(c) for c in cols_all)
+
+    def _append_slab(self) -> int:
+        C = self.leaf_cap
+        self.stats.setdefault("leaf_cap", C)
+        d = self.coords.shape[0]
+        nb = C // self.block
+        s = self.coords.shape[1] // C
+        self.coords = np.concatenate(
+            [self.coords, np.full((d, C), PAD_COORD, np.float32)], axis=1
+        )
+        self.labels = np.concatenate(
+            [self.labels, np.full(C, _INT_INF, np.int32)]
+        )
+        if self.gids is not None:
+            self.gids = np.concatenate(
+                [self.gids, np.full(C, -1, np.int64)]
+            )
+        self.blo = np.concatenate(
+            [self.blo, np.full((nb, d), BIG, np.float32)]
+        )
+        self.bhi = np.concatenate(
+            [self.bhi, np.full((nb, d), -BIG, np.float32)]
+        )
+        return s
+
+    def remove_gids(self, gids) -> None:
+        """Turn the given points' slots back into pad slots (far-away
+        coordinates, INT32_MAX labels) — deletion never re-lays-out a
+        leaf; freed slots are absorbed by later inserts."""
+        gmap = self._gid_map()
+        cols = np.asarray(
+            [gmap[int(g)] for g in np.asarray(gids).reshape(-1)], np.int64
+        )
+        if len(cols) == 0:
+            return
+        self.coords[:, cols] = PAD_COORD
+        self.labels[cols] = _INT_INF
+        self.gids[cols] = -1
+        for g in np.asarray(gids).reshape(-1):
+            gmap.pop(int(g), None)
+        self.n_core -= len(cols)
+        self._pending["cols"].update(int(c) for c in cols)
+
+    def set_label_gids(self, gids, labels) -> None:
+        """Rewrite the cluster labels of existing slots (the delete
+        path's re-clustered fresh ids)."""
+        gmap = self._gid_map()
+        gids = np.asarray(gids).reshape(-1)
+        if len(gids) == 0:
+            return
+        cols = np.asarray([gmap[int(g)] for g in gids], np.int64)
+        self.labels[cols] = np.asarray(labels, np.int32)
+        self._pending["cols"].update(int(c) for c in cols)
+
+    def apply_label_map(self, lut) -> None:
+        """Apply a union-find relabel LUT (identity outside the merged
+        ids — :func:`pypardis_tpu.ops.incremental.label_lut`) to every
+        live slot.  Device-side this ships only the LUT and gathers in
+        place, so a merge that renames a million-slot cluster costs a
+        kilobyte of transfer."""
+        lut = np.asarray(lut, np.int32)
+        sel = self.labels != _INT_INF
+        if sel.any():
+            self.labels[sel] = lut[
+                np.clip(self.labels[sel], 0, len(lut) - 1)
+            ]
+        p = self._pending
+        p["lut"] = lut if p["lut"] is None else lut[
+            np.clip(p["lut"], 0, len(lut) - 1)
+        ]
+
+    def _recompute_bounds(self, blocks) -> None:
+        b = self.block
+        blocks = np.asarray(sorted(blocks), np.int64)
+        if len(blocks) == 0:
+            return
+        idx = (blocks[:, None] * b + np.arange(b)[None, :]).reshape(-1)
+        cc = self.coords[:, idx].reshape(self.d, len(blocks), b)
+        valid = (self.labels[idx] != _INT_INF).reshape(len(blocks), b)
+        self.blo[blocks] = np.where(valid[None], cc, BIG).min(axis=2).T
+        self.bhi[blocks] = np.where(valid[None], cc, -BIG).max(axis=2).T
+
+    def commit_update(self) -> int:
+        """Close the mutation batch: recompute touched block bounds,
+        ship one device delta (scattered columns + appended slabs + the
+        relabel LUT — never the whole index), bump the epoch, and
+        refresh the staging-cache entry so ``staged_bytes_reused``
+        accounting and ``route_nbytes`` stay truthful.  Returns the
+        delta bytes shipped."""
+        p = getattr(self, "_pending", None)
+        if p is None:
+            raise RuntimeError("no index update open; call begin_update()")
+        self._pending = None
+        cols = np.asarray(sorted(p["cols"]), np.int64)
+        old_w = int(p["old_w"])
+        lut = p["lut"]
+        touched_blocks = set((cols // self.block).tolist())
+        self._recompute_bounds(touched_blocks)
+        delta = 0
+        if self._dev is not None:
+            import jax.numpy as jnp
+
+            from ..parallel import staging
+
+            coords_d, labels_d, blo_d, bhi_d = self._dev
+            new_w = self.coords.shape[1]
+            old_rows = old_w // self.block
+            if new_w > old_w:
+                app_c = self.coords[:, old_w:]
+                app_l = self.labels[old_w:]
+                app_lo = self.blo[old_rows:]
+                app_hi = self.bhi[old_rows:]
+                coords_d = jnp.concatenate(
+                    [coords_d, jnp.asarray(app_c)], axis=1
+                )
+                labels_d = jnp.concatenate([labels_d, jnp.asarray(app_l)])
+                blo_d = jnp.concatenate([blo_d, jnp.asarray(app_lo)])
+                bhi_d = jnp.concatenate([bhi_d, jnp.asarray(app_hi)])
+                delta += (
+                    app_c.nbytes + app_l.nbytes + app_lo.nbytes
+                    + app_hi.nbytes
+                )
+            scat = cols[cols < old_w]
+            if len(scat):
+                ji = jnp.asarray(scat)
+                coords_d = coords_d.at[:, ji].set(
+                    jnp.asarray(self.coords[:, scat])
+                )
+                labels_d = labels_d.at[ji].set(
+                    jnp.asarray(self.labels[scat])
+                )
+                delta += self.coords[:, scat].nbytes \
+                    + self.labels[scat].nbytes
+            if lut is not None:
+                jl = jnp.asarray(lut)
+                labels_d = jnp.where(
+                    labels_d == _INT_INF,
+                    labels_d,
+                    jl[jnp.clip(labels_d, 0, len(lut) - 1)],
+                )
+                delta += lut.nbytes
+            brows = np.asarray(
+                sorted(b for b in touched_blocks if b < old_rows), np.int64
+            )
+            if len(brows):
+                jb = jnp.asarray(brows)
+                blo_d = blo_d.at[jb].set(jnp.asarray(self.blo[brows]))
+                bhi_d = bhi_d.at[jb].set(jnp.asarray(self.bhi[brows]))
+                delta += 2 * self.blo[brows].nbytes
+            self._dev = (coords_d, labels_d, blo_d, bhi_d)
+            staging.device_replace(
+                "serve_index", self._content_key(), self._dev,
+                staged_nbytes=delta, delta_route="serve_index_delta",
+            )
+        self.epoch += 1
+        self.delta_bytes += int(delta)
+        self.stats["n_leaves"] = self.n_leaves
+        self.stats["index_bytes"] = int(
+            self.coords.nbytes + self.labels.nbytes + self.blo.nbytes
+            + self.bhi.nbytes
+        )
+        return int(delta)
+
     # -- query-side layout ------------------------------------------------
 
     def prepare_queries(self, X) -> np.ndarray:
@@ -272,20 +606,29 @@ class CorePointIndex:
         return (X.astype(np.float64) - self.center).astype(np.float32)
 
     def route(self, qf32: np.ndarray):
-        """[(leaf, query indices)] in ascending leaf order — each query
-        appears in EVERY leaf whose eps-expanded region contains it
-        (the neighbor-leaf path for boundary-straddling queries)."""
+        """[(slab, query indices)] in ascending slab order — each query
+        appears in EVERY slab of every tree leaf whose eps-expanded
+        region contains it (the neighbor-leaf path for
+        boundary-straddling queries; a leaf grown past its pad capacity
+        by live inserts owns several slabs, and its queries scan each)."""
         n = len(qf32)
+        if n == 0:
+            return []
         if not self.tree:
-            return [(0, np.arange(n, dtype=np.int64))] if n else []
+            slabs = sorted(self.leaf_slabs.get(0, []))
+            idx = np.arange(n, dtype=np.int64)
+            return [(s, idx) for s in slabs]
         from ..partition import expanded_members
 
         members = expanded_members(self.tree, qf32, self._margin)
-        return [
-            (leaf, members[leaf][0])
-            for leaf in sorted(members)
-            if len(members[leaf][0])
-        ]
+        out = []
+        for leaf in sorted(members):
+            arr = members[leaf][0]
+            if len(arr):
+                for slab in self.leaf_slabs.get(leaf, [leaf]):
+                    out.append((slab, arr))
+        out.sort(key=lambda t: t[0])
+        return out
 
     def assemble(self, qf32: np.ndarray):
         """Pack routed queries into padded device tiles.

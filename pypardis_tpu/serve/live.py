@@ -1,0 +1,644 @@
+"""Live updates: incremental insert/delete on a fitted model.
+
+Incremental DBSCAN (Ester et al., VLDB 1998) observes that an update
+only perturbs the clustering inside the eps-neighborhood of the change:
+neighbor counts move only within ``eps`` of an inserted/deleted point,
+and labels only within ``eps`` of a core-ness flip.  This maps exactly
+onto the locality primitives the repo already has — the KD split tree
+bounds the blast radius to a few leaves, and the device-resident
+:class:`~pypardis_tpu.serve.CorePointIndex` is refreshed *in place*
+(pad slots absorb inserts; one overflowing leaf rebuilds alone) through
+the ``serve_index_delta`` staging route, never a full rebuild.
+
+The update algebra, per batch:
+
+* **insert** — counts can only rise, so core-ness only flips *on*, and
+  clusters only grow or MERGE (never split).  The fast path (no flip,
+  no new core) attaches each newcomer to the nearest core within eps —
+  or noise — and touches nothing else.  Otherwise the blast radius is
+  the set of KD leaves whose eps-expanded box contains a new or
+  flipped point; every NEW eps-edge provably has both endpoints inside
+  those leaves, so a **local re-cluster** of the extracted slab (the
+  existing fused device kernel with ``min_samples=1`` over KNOWN
+  cores, :func:`pypardis_tpu.ops.incremental.core_components`) plus a
+  union-find stitch of (old label, local component) edges
+  (:func:`pypardis_tpu.parallel.merge.resolve_label_edges` — the same
+  machinery that merges shards; one insert bridging three clusters is
+  exactly the PR 2 multi-edge lesson) reproduces the full refit's
+  partition.  A merge renames labels globally, but as a LUT — no
+  geometry outside the slab is ever touched.
+
+* **delete** — counts can only fall, so core-ness only flips *off*, and
+  clusters only shrink or SPLIT.  A split is not leaf-local (removing
+  one bridge can sever a cluster spanning the dataset), but it is
+  *cluster*-local: only the clusters owning a deleted point or a
+  demoted core can change.  Those clusters' surviving members are
+  re-clustered (same two primitives) under fresh labels; everything
+  else keeps its label untouched.
+
+Determinism note: count verdicts run in float64 on raw coordinates
+(:mod:`pypardis_tpu.ops.incremental`) — one frame for the whole update
+sequence, where a maintained f32 verdict would depend on the drifting
+dataset mean.  Border points attach to the nearest core within eps
+(ties: smallest label) — the serving rule.  A full refit breaks border
+ties by Morton-order root instead, so equality with a refit is a
+*partition* (ARI == 1.0) guarantee on geometries where no border point
+straddles two clusters — ambiguous straddlers are the one documented
+divergence, same as any incremental-DBSCAN formulation.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..ops.incremental import (
+    attach_to_cores,
+    core_components,
+    count_within_eps,
+    label_lut,
+)
+
+# Routing slack over eps (the serve index's discipline): leaf-membership
+# replays in f64, verdicts in f64 here too — the slack only ever adds
+# candidate leaves, never changes an answer.
+_SLACK = 1.001
+
+
+class LiveModel:
+    """Incremental insert/delete on a fitted :class:`~pypardis_tpu.
+    dbscan.DBSCAN`, with the serving index refreshed in place.
+
+    Points carry stable integer ids (returned by :meth:`insert`,
+    consumed by :meth:`delete`); the initial fit's points get ids
+    ``0..n-1``.  ``model.labels_`` / ``core_sample_mask_`` / ``data``
+    are kept in sync after every update (canonical — not re-densified —
+    cluster ids, so ``predict`` labels and training labels agree), and
+    ``model.report()["live"]`` carries the update telemetry.
+    """
+
+    def __init__(self, model, *, leaves: Optional[int] = None,
+                 block: int = 256, qblock: int = 128,
+                 _resume: Optional[Dict] = None, **engine_kw):
+        model._require_fitted()
+        self.model = model
+        self.eps = float(model.eps)
+        self.min_samples = int(model.min_samples)
+        self._fit_generation = getattr(model, "_fit_generation", 0)
+        if _resume is not None:
+            pts = np.asarray(_resume["points"], np.float64)
+            labels = np.asarray(_resume["labels"], np.int32)
+            core = np.asarray(_resume["core"], bool)
+            self._next_label = int(_resume["next_label"])
+        else:
+            if model.data is None:
+                raise RuntimeError(
+                    "live updates need the training coordinates; "
+                    "model.data was cleared (or the model came from a "
+                    "checkpoint without live state)"
+                )
+            pts = np.asarray(model.data, np.float64)
+            labels = np.asarray(model.labels_, np.int32)
+            core = np.asarray(model.core_sample_mask_, bool)
+            self._next_label = (
+                int(labels.max()) + 1 if (labels >= 0).any() else 0
+            )
+        n, k = pts.shape
+        self.k = int(k)
+        self._data_dtype = (
+            np.asarray(model.data).dtype if model.data is not None
+            else np.float64
+        )
+        cap = max(2 * n, n + 64)
+        self._coords = np.empty((cap, k), np.float64)
+        self._coords[:n] = pts
+        self._alive = np.zeros(cap, bool)
+        self._alive[:n] = True
+        self._labels = np.full(cap, -1, np.int32)
+        self._labels[:n] = labels
+        self._core = np.zeros(cap, bool)
+        self._core[:n] = core
+        self._n = n
+
+        # Spatial tree over the initial points: the locality structure
+        # every update routes through.  Fresh and deterministic (the
+        # fit's partitioner may be absent or describe a mesh layout) —
+        # split planes cover all space, so points drifting outside the
+        # initial extent still route.
+        if leaves is None:
+            leaves = int(np.clip(n // 512, 4, 64))
+        if _resume is not None:
+            self._tree = [
+                (int(p), int(a), float(b), int(l), int(r))
+                for p, a, b, l, r in _resume["tree"]
+            ]
+        elif leaves > 1 and n >= 2:
+            from ..partition import KDPartitioner
+
+            part = KDPartitioner(
+                pts, max_partitions=int(leaves), split_method="min_var",
+                seed=0,
+            )
+            self._tree = part.tree
+        else:
+            self._tree = []
+        from ..partition import route_tree
+
+        self._leaf_of = np.zeros(cap, np.int32)
+        self._leaf_of[:n] = (
+            route_tree(self._tree, pts) if self._tree
+            else np.zeros(n, np.int32)
+        )
+        self._leaf_members: Dict[int, List[int]] = {}
+        for i in range(n):
+            self._leaf_members.setdefault(
+                int(self._leaf_of[i]), []
+            ).append(i)
+        self.n_leaves = max(len(self._leaf_members), 1)
+
+        # Serving surface: the model's cached engine over a gid-tagged
+        # index (resume restores the mutated slabs byte-identically).
+        if _resume is not None:
+            from .engine import QueryEngine
+
+            self.index = _resume["index"]
+            self.engine = QueryEngine(
+                self.index, backend=model.kernel_backend, model=model,
+                **engine_kw,
+            )
+            model._serve_engine = self.engine
+        else:
+            self.engine = model.query_engine(
+                block=block, qblock=qblock, **engine_kw
+            )
+            self.index = self.engine.index
+            self.index.attach_gids(np.flatnonzero(core))
+
+        # Telemetry (the ``live`` block of ``model.report()``): ONE
+        # dict object updated in place, so a report taken at any time
+        # reads current gauges.
+        self._ins_ms: deque = deque(maxlen=4096)
+        self._del_ms: deque = deque(maxlen=4096)
+        self.stats: Dict = {}
+        self._counters = {
+            "inserts": 0, "deletes": 0, "updates": 0,
+            "recluster_events": 0, "recluster_points": 0,
+            "label_remaps": 0,
+        }
+        self._last_fraction = 0.0
+        model._live_stats = self.stats
+        model._live_model = self
+        self._publish()
+
+    # -- public write surface ---------------------------------------------
+
+    def insert(self, X) -> np.ndarray:
+        """Insert points; returns their stable ids.
+
+        DBSCAN-correct label maintenance: a newcomer within eps of a
+        core point joins (nearest core's cluster); a newcomer or
+        neighbor crossing the core threshold triggers the local
+        re-cluster + union-find merge described in the module docs.
+        """
+        t0 = time.perf_counter()
+        X = self._check_points(X)
+        m = len(X)
+        if m == 0:
+            return np.empty(0, np.int64)
+        eps, ms = self.eps, self.min_samples
+
+        cand = self._pool(X)
+        cand_pts = self._coords[cand]
+        # Existing points whose counts rise, and their new full counts.
+        delta = count_within_eps(cand_pts, X, eps)
+        changed = cand[delta > 0]
+        if len(changed):
+            pool2 = self._pool(self._coords[changed])
+            new_counts = (
+                count_within_eps(
+                    self._coords[changed], self._coords[pool2], eps
+                )
+                + count_within_eps(self._coords[changed], X, eps)
+            )
+            flips = changed[~self._core[changed] & (new_counts >= ms)]
+        else:
+            flips = np.empty(0, np.int64)
+        # Newcomers' counts: alive candidates + the batch itself (the
+        # self-count rides in the new-new term).
+        new_counts_p = (
+            count_within_eps(X, cand_pts, eps)
+            + count_within_eps(X, X, eps)
+        )
+        new_core = new_counts_p >= ms
+
+        ids = self._append(X)
+        self._core[ids] = new_core
+        self._core[flips] = True
+
+        if len(flips) == 0 and not new_core.any():
+            # Fast path: every newcomer is border or noise; no
+            # structure moved.  Candidate cores all live in the routed
+            # leaves (a core within eps of p puts p in its leaf's
+            # eps-expanded box).
+            core_cand = cand[self._core[cand]]
+            labs, _d2 = attach_to_cores(
+                X, self._coords[core_cand], self._labels[core_cand], eps
+            )
+            self._labels[ids] = labs
+            self._last_fraction = 0.0
+            self._finish_update("inserts", m, t0, self._ins_ms)
+            return ids
+
+        # Local re-cluster of the blast radius.
+        changed_pts = np.concatenate([X, self._coords[flips]])
+        lut, s_core, s_core_labels = self._recluster_insert(changed_pts)
+
+        # Index refresh, one delta: the merge LUT renames in place; the
+        # new cores (inserted + flipped) fill pad slots.
+        self.index.begin_update()
+        if lut is not None:
+            self.index.apply_label_map(lut)
+            self._counters["label_remaps"] += 1
+        add = np.concatenate([ids[new_core], flips]).astype(np.int64)
+        if len(add):
+            self.index.insert_cores(
+                self._coords[add], self._labels[add], add
+            )
+        self.index.commit_update()
+        self._finish_update("inserts", m, t0, self._ins_ms)
+        return ids
+
+    def delete(self, ids) -> int:
+        """Delete points by id; returns the number removed.
+
+        Labels of untouched clusters never move; clusters that owned a
+        deleted point or a demoted core re-cluster locally (a split's
+        true blast radius) under fresh labels.
+        """
+        t0 = time.perf_counter()
+        # Dedupe: a repeated id in one call must count (and free its
+        # index slot) exactly once.
+        ids = np.unique(np.asarray(ids, np.int64).reshape(-1))
+        if len(ids) == 0:
+            return 0
+        bad = ids[(ids < 0) | (ids >= self._n) | ~self._alive[
+            np.clip(ids, 0, max(self._n - 1, 0))
+        ]]
+        if len(bad):
+            raise KeyError(
+                f"unknown or already-deleted point id(s): "
+                f"{bad[:8].tolist()}"
+            )
+        eps, ms = self.eps, self.min_samples
+        D = self._coords[ids].copy()
+        was_core = self._core[ids].copy()
+        dead_labels = self._labels[ids].copy()
+        self._alive[ids] = False
+        self._core[ids] = False
+
+        cand = self._pool(D)
+        delta = count_within_eps(self._coords[cand], D, eps)
+        changed = cand[delta > 0]
+        if len(changed):
+            pool2 = self._pool(self._coords[changed])
+            new_counts = count_within_eps(
+                self._coords[changed], self._coords[pool2], eps
+            )
+            flips = changed[self._core[changed] & (new_counts < ms)]
+        else:
+            flips = np.empty(0, np.int64)
+
+        if not was_core.any() and len(flips) == 0:
+            # Border/noise deletions detach nothing else.
+            self._labels[ids] = -1
+            self._last_fraction = 0.0
+            self._finish_update("deletes", len(ids), t0, self._del_ms)
+            return len(ids)
+
+        flip_labels = self._labels[flips]
+        self._core[flips] = False
+        self._labels[ids] = -1
+        affected = np.unique(np.concatenate([
+            dead_labels[dead_labels >= 0],
+            flip_labels[flip_labels >= 0],
+        ]))
+        s_core, s_labels, touched_leaves = self._recluster_delete(
+            affected, ids
+        )
+
+        self.index.begin_update()
+        gone = np.concatenate([ids[was_core], flips]).astype(np.int64)
+        if len(gone):
+            self.index.remove_gids(gone)
+        if len(s_core):
+            self.index.set_label_gids(s_core, s_labels)
+        self.index.commit_update()
+        self._finish_update("deletes", len(ids), t0, self._del_ms)
+        return len(ids)
+
+    # -- re-cluster machinery ---------------------------------------------
+
+    def _recluster_insert(self, changed_pts):
+        """Re-cluster the leaves reached by new/flipped points; stitch
+        the local components back into the global labels through the
+        union-find.  Returns ``(lut_or_None, s_core_ids, labels)``."""
+        leaves = self._leaves_reaching(changed_pts)
+        S = self._members(leaves)
+        s_core = S[self._core[S]]
+        comp = core_components(
+            self._coords[s_core], self.eps,
+            block=min(int(self.model.block), 256),
+            precision=self.model.precision,
+            backend=self.model.kernel_backend,
+        )
+        n_comp = int(comp.max()) + 1 if len(comp) else 0
+        fresh = self._next_label + comp.astype(np.int64)
+        self._next_label += n_comp
+        old = self._labels[s_core].astype(np.int64)
+        sel = old >= 0
+        edges = np.stack([old[sel], fresh[sel]], axis=1)
+        from ..parallel.merge import resolve_label_edges
+
+        alive_labels = self._labels[:self._n][self._alive[:self._n]]
+        ids_univ = np.unique(np.concatenate([
+            alive_labels[alive_labels >= 0].astype(np.int64),
+            fresh,
+        ])) if len(fresh) else np.unique(
+            alive_labels[alive_labels >= 0].astype(np.int64)
+        )
+        lut = None
+        if len(ids_univ):
+            mapping = resolve_label_edges(edges, ids_univ)
+            lut = label_lut(mapping, int(ids_univ.max()))
+            live = self._alive[:self._n] & (self._labels[:self._n] >= 0)
+            self._labels[:self._n][live] = lut[
+                self._labels[:self._n][live]
+            ]
+            final = lut[np.clip(fresh, 0, len(lut) - 1)]
+        else:
+            final = fresh.astype(np.int32)
+        self._labels[s_core] = final
+        self._attach_noncore(S[~self._core[S]])
+        self._note_recluster(leaves, len(S))
+        return lut, s_core, self._labels[s_core]
+
+    def _recluster_delete(self, affected, deleted_ids):
+        """Re-cluster the surviving members of the affected clusters
+        under fresh labels (no stitching: a cross-cluster core edge
+        would have merged them before the delete)."""
+        alive = self._alive[:self._n]
+        in_affected = np.isin(self._labels[:self._n], affected) & alive
+        S = np.flatnonzero(in_affected).astype(np.int64)
+        s_core = S[self._core[S]]
+        comp = core_components(
+            self._coords[s_core], self.eps,
+            block=min(int(self.model.block), 256),
+            precision=self.model.precision,
+            backend=self.model.kernel_backend,
+        )
+        n_comp = int(comp.max()) + 1 if len(comp) else 0
+        fresh = (self._next_label + comp.astype(np.int64)).astype(np.int32)
+        self._next_label += n_comp
+        self._labels[s_core] = fresh
+        self._attach_noncore(S[~self._core[S]])
+        leaves = set(
+            int(l) for l in np.unique(np.concatenate([
+                self._leaf_of[S], self._leaf_of[deleted_ids]
+            ]))
+        ) if len(S) or len(deleted_ids) else set()
+        self._note_recluster(leaves, len(S))
+        return s_core, self._labels[s_core], leaves
+
+    def _attach_noncore(self, pts_ids) -> None:
+        """Re-attach non-core points: nearest core within eps (ties:
+        smallest label), else noise — candidate cores gathered from the
+        leaves each point's eps-ball reaches."""
+        if len(pts_ids) == 0:
+            return
+        pool = self._pool(self._coords[pts_ids])
+        core_cand = pool[self._core[pool]]
+        labs, _d2 = attach_to_cores(
+            self._coords[pts_ids], self._coords[core_cand],
+            self._labels[core_cand], self.eps,
+        )
+        self._labels[pts_ids] = labs
+
+    # -- locality helpers -------------------------------------------------
+
+    def _pool(self, pts) -> np.ndarray:
+        """Alive ids in every leaf whose eps-expanded box contains one
+        of ``pts`` — the candidate set that provably contains all
+        eps-neighbors of ``pts``."""
+        return self._members(self._leaves_reaching(pts))
+
+    def _leaves_reaching(self, pts):
+        if not self._tree:
+            return {0}
+        from ..partition import expanded_members
+
+        members = expanded_members(
+            self._tree, np.asarray(pts, np.float64),
+            self.eps * _SLACK,
+        )
+        return {l for l, (idx, _own) in members.items() if len(idx)}
+
+    def _members(self, leaves) -> np.ndarray:
+        out = []
+        for leaf in leaves:
+            lst = self._leaf_members.get(int(leaf))
+            if not lst:
+                continue
+            arr = np.asarray(lst, np.int64)
+            arr = arr[self._alive[arr]]
+            if len(arr) * 2 < len(lst):
+                self._leaf_members[int(leaf)] = arr.tolist()
+            out.append(arr)
+        if not out:
+            return np.empty(0, np.int64)
+        return np.unique(np.concatenate(out))
+
+    def _append(self, X) -> np.ndarray:
+        m = len(X)
+        need = self._n + m
+        if need > len(self._coords):
+            cap = max(2 * len(self._coords), need)
+            for name in ("_coords", "_alive", "_labels", "_core",
+                         "_leaf_of"):
+                old = getattr(self, name)
+                fresh = np.zeros(
+                    (cap,) + old.shape[1:], old.dtype
+                ) if old.dtype != np.int32 else np.full(
+                    (cap,) + old.shape[1:], -1, np.int32
+                )
+                fresh[:self._n] = old[:self._n]
+                setattr(self, name, fresh)
+            self._leaf_of[self._n:] = 0
+        ids = np.arange(self._n, need, dtype=np.int64)
+        self._coords[ids] = X
+        self._alive[ids] = True
+        self._labels[ids] = -1
+        self._core[ids] = False
+        from ..partition import route_tree
+
+        leaf = (
+            route_tree(self._tree, X) if self._tree
+            else np.zeros(m, np.int32)
+        )
+        self._leaf_of[ids] = leaf
+        for i, l in zip(ids, leaf):
+            self._leaf_members.setdefault(int(l), []).append(int(i))
+        self._n = need
+        return ids
+
+    def _check_points(self, X) -> np.ndarray:
+        from ..utils.validate import check_query_points
+
+        if getattr(self.model, "_fit_generation", 0) \
+                != self._fit_generation:
+            raise RuntimeError(
+                "model was refit after this LiveModel was built; "
+                "rebuild it (model.live())"
+            )
+        return np.asarray(
+            check_query_points(X, self.k), np.float64
+        )
+
+    # -- read surface -----------------------------------------------------
+
+    def ids(self) -> np.ndarray:
+        """Stable ids of the alive points, ascending."""
+        return np.flatnonzero(self._alive[:self._n]).astype(np.int64)
+
+    def points(self) -> np.ndarray:
+        return self._coords[:self._n][self._alive[:self._n]].copy()
+
+    def labels(self) -> np.ndarray:
+        """Current cluster labels of the alive points (canonical ids —
+        stable across updates, not re-densified)."""
+        return self._labels[:self._n][self._alive[:self._n]].copy()
+
+    def core_mask(self) -> np.ndarray:
+        return self._core[:self._n][self._alive[:self._n]].copy()
+
+    def predict(self, X, return_distance: bool = False):
+        """Out-of-sample assignment against the CURRENT index (bitwise
+        oracle-exact — the in-place refresh preserves the seal_f32
+        contract)."""
+        return self.engine.predict(X, return_distance)
+
+    # -- bookkeeping ------------------------------------------------------
+
+    def _note_recluster(self, leaves, n_points) -> None:
+        self._counters["recluster_events"] += 1
+        self._counters["recluster_points"] += int(n_points)
+        self._last_fraction = round(
+            len(set(leaves)) / max(self.n_leaves, 1), 6
+        )
+
+    def _finish_update(self, kind, m, t0, lat) -> None:
+        lat.append((time.perf_counter() - t0) * 1e3)
+        self._counters[kind] += int(m)
+        self._counters["updates"] += 1
+        self._sync_model()
+        self._publish()
+
+    def _sync_model(self) -> None:
+        m = self.model
+        alive = self._alive[:self._n]
+        m.labels_ = self._labels[:self._n][alive].copy()
+        m.core_sample_mask_ = self._core[:self._n][alive].copy()
+        m.data = self._coords[:self._n][alive].astype(self._data_dtype)
+        m._keys = np.flatnonzero(alive).astype(np.int64)
+        m._result_cache = None
+        m._serve_core_points = None
+
+    def _publish(self) -> None:
+        def _pct(d, q):
+            return round(float(np.percentile(np.asarray(d), q)), 3) \
+                if len(d) else 0.0
+
+        from ..parallel import staging
+
+        c = self._counters
+        self.stats.update({
+            "points": int(self._alive[:self._n].sum()),
+            "cores": int(self._core[:self._n][
+                self._alive[:self._n]].sum()),
+            "inserts": c["inserts"],
+            "deletes": c["deletes"],
+            "updates": c["updates"],
+            "recluster_events": c["recluster_events"],
+            "recluster_points": c["recluster_points"],
+            "recluster_tile_fraction": float(self._last_fraction),
+            "label_remaps": c["label_remaps"],
+            "n_leaves": int(self.n_leaves),
+            "index_epoch": int(self.index.epoch),
+            "index_delta_bytes": int(self.index.delta_bytes),
+            "index_delta_route_bytes": int(
+                staging.route_delta_nbytes("serve_index_delta")
+            ),
+            "insert_p50_ms": _pct(self._ins_ms, 50),
+            "insert_p99_ms": _pct(self._ins_ms, 99),
+            "delete_p50_ms": _pct(self._del_ms, 50),
+            "delete_p99_ms": _pct(self._del_ms, 99),
+        })
+
+    # -- persistence ------------------------------------------------------
+
+    def save(self, path: str) -> None:
+        """Checkpoint the LIVE state: current points/labels/cores, the
+        routing tree, counters, and the mutated index slabs — a
+        restarted server resumes serving the updated model
+        byte-identically (:func:`pypardis_tpu.checkpoint.save_model`
+        grows the live payload)."""
+        from ..checkpoint import save_model
+
+        self._sync_model()
+        save_model(
+            self.model, path,
+            live={
+                "points": self.points(),
+                "labels": self.labels(),
+                "core": self.core_mask(),
+                "gids": self.ids(),
+                "next_label": int(self._next_label),
+                "tree": np.asarray(self._tree, np.float64).reshape(-1, 5),
+                "counters": dict(self._counters),
+            },
+            index=self.index,
+        )
+
+    @classmethod
+    def load(cls, path: str, **engine_kw) -> "LiveModel":
+        """Restore a live checkpoint; point ids re-densify to
+        ``0..n_alive-1`` (in the saved id order)."""
+        from ..checkpoint import load_model
+
+        model = load_model(path)
+        ck = getattr(model, "_live_ckpt", None)
+        if ck is None:
+            raise ValueError(
+                f"{path} is a plain model checkpoint without live "
+                f"state; build a fresh LiveModel(model) instead"
+            )
+        index = ck.pop("index")
+        old_gids = np.asarray(ck.pop("gids"), np.int64)
+        # Saved gids were sparse (deletions); positions restart dense.
+        remap = {int(g): i for i, g in enumerate(old_gids)}
+        if index.gids is not None:
+            g = index.gids
+            index.gids = np.asarray(
+                [remap.get(int(x), -1) if x >= 0 else -1 for x in g],
+                np.int64,
+            )
+            index._gid_col = None
+        live = cls(model, _resume={**ck, "index": index}, **engine_kw)
+        counters = ck.get("counters") or {}
+        for k, v in counters.items():
+            if k in live._counters:
+                live._counters[k] = int(v)
+        live._publish()
+        return live

@@ -1,0 +1,170 @@
+"""Sustained multi-client load harness for the serving subsystem.
+
+Drives N concurrent client threads against a :class:`~pypardis_tpu.
+serve.QueryEngine` under **Poisson arrivals** (exponential inter-arrival
+sleeps per client — the standard open-loop traffic model), with an
+optional write mix routed through a :class:`~pypardis_tpu.serve.live.
+LiveModel`.  A dedicated drainer thread pumps ``drain()`` continuously,
+so request latency includes real queue wait and coalescing — the
+serving numbers a production deployment would see, not a closed-loop
+best case.
+
+The engine's submit/drain surface is single-threaded by design (the
+double-buffered drain rotates pooled staging buffers); the harness
+serializes access through one lock, which is also the honest model on
+the CPU CI host — contention shows up in p99, not in corruption.
+
+Measured per run (the ``live_load`` bench row's payload): sustained
+qps over the harness wall, p50/p99 request latency, batch fill,
+and — for writes — **update-visible latency**: the wall time from a
+write entering :meth:`LiveModel.insert` until a ``predict`` of the
+written point returns its post-update label through the refreshed
+index.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+
+def sustained_load(
+    engine,
+    *,
+    clients: int = 4,
+    duration_s: float = 2.0,
+    rate_hz: float = 200.0,
+    batch_rows: int = 16,
+    write_fraction: float = 0.0,
+    live=None,
+    query_sampler: Optional[Callable] = None,
+    seed: int = 0,
+) -> Dict:
+    """Run the harness; returns the schema'd stats dict.
+
+    ``rate_hz`` is the per-client request rate (Poisson); ``clients``
+    threads run open-loop for ``duration_s``.  ``write_fraction`` of
+    requests become single-point inserts against ``live`` (required
+    when > 0); the rest submit ``batch_rows``-row query batches.
+    ``query_sampler(rng, n) -> (n, k)`` supplies query coordinates
+    (default: uniform over the index's core bounding box ± eps).
+    """
+    if write_fraction > 0 and live is None:
+        raise ValueError(
+            "write_fraction > 0 needs a LiveModel (live=...)"
+        )
+    index = engine.index
+    if query_sampler is None:
+        sel = np.asarray(index.labels) != np.iinfo(np.int32).max
+        if sel.any():
+            lo = index.coords[:, sel].min(axis=1) - index.eps
+            hi = index.coords[:, sel].max(axis=1) + index.eps
+            center = index.center
+        else:
+            lo = np.full(index.d, -1.0)
+            hi = np.full(index.d, 1.0)
+            center = np.zeros(index.d)
+
+        def query_sampler(rng, n):
+            # Raw-frame queries (prepare_queries re-centers).
+            return rng.uniform(lo, hi, size=(n, index.d)) + center
+
+    lock = threading.Lock()
+    tickets: list = []
+    visible_ms: list = []
+    errors: list = []
+    stop = threading.Event()
+    t_start = time.perf_counter()
+    deadline = t_start + float(duration_s)
+    n_writes = [0]
+
+    def client(cid: int) -> None:
+        rng = np.random.default_rng(seed * 1000 + cid)
+        while time.perf_counter() < deadline and not stop.is_set():
+            # Poisson arrivals: exponential inter-arrival gap.
+            time.sleep(float(rng.exponential(1.0 / rate_hz)))
+            if time.perf_counter() >= deadline:
+                break
+            try:
+                if live is not None and rng.random() < write_fraction:
+                    q = np.asarray(query_sampler(rng, 1))
+                    t0 = time.perf_counter()
+                    with lock:
+                        ids = live.insert(q)
+                        labs = engine.predict(q)
+                    visible_ms.append(
+                        (time.perf_counter() - t0) * 1e3
+                    )
+                    del ids, labs
+                    n_writes[0] += 1
+                else:
+                    q = np.asarray(query_sampler(rng, batch_rows))
+                    with lock:
+                        tickets.append(engine.submit(q))
+            except Exception as e:  # noqa: BLE001 — harness must drain
+                errors.append(e)
+                stop.set()
+                return
+
+    def drainer() -> None:
+        while not stop.is_set():
+            try:
+                with lock:
+                    engine.drain()
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+                stop.set()
+                return
+            time.sleep(0.0005)
+            if time.perf_counter() >= deadline:
+                return  # stragglers resolve in the final drain below
+
+    threads = [
+        threading.Thread(target=client, args=(c,), daemon=True)
+        for c in range(int(clients))
+    ]
+    pump = threading.Thread(target=drainer, daemon=True)
+    for t in threads:
+        t.start()
+    pump.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    pump.join()
+    with lock:
+        engine.drain()  # resolve any straggler tickets
+    wall = time.perf_counter() - t_start
+    if errors:
+        raise errors[0]
+
+    lat = np.asarray(
+        [t.latency_ms for t in tickets if t.latency_ms is not None],
+        np.float64,
+    )
+    queries = int(sum(t.n for t in tickets if t.done))
+    vis = np.asarray(visible_ms, np.float64)
+
+    def _pct(a, q):
+        return round(float(np.percentile(a, q)), 3) if len(a) else 0.0
+
+    stats = engine.serving_stats()
+    return {
+        "arrival": "poisson",
+        "clients": int(clients),
+        "duration_s": round(wall, 3),
+        "rate_hz": float(rate_hz),
+        "requests": len(tickets) + int(n_writes[0]),
+        "queries": queries,
+        "writes": int(n_writes[0]),
+        "write_fraction": float(write_fraction),
+        "qps": round(queries / wall, 1) if wall > 0 else 0.0,
+        "p50_ms": _pct(lat, 50),
+        "p99_ms": _pct(lat, 99),
+        "batch_fill": stats.get("batch_fill", 0.0),
+        "update_visible_p50_ms": _pct(vis, 50),
+        "update_visible_p99_ms": _pct(vis, 99),
+        "index_epoch": stats.get("index_epoch", 0),
+    }

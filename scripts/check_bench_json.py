@@ -204,6 +204,71 @@ def main() -> None:
         if serving["queries"] > 0 and serving["qps"] <= 0:
             fail("telemetry.serving.qps is 0 with queries > 0")
 
+    # Live-update contract (ISSUE 8): live_* rows must carry the
+    # ``live`` telemetry block — update volumes, the measured
+    # re-cluster blast radius, the in-place index-refresh economy, and
+    # update-latency percentiles — all finite; the tile fraction is a
+    # fraction.  Any row that has a live block is held to the schema.
+    if str(row["metric"]).startswith("live") and "live" not in tel:
+        fail("live row without telemetry.live block")
+    live = tel.get("live")
+    if live is not None:
+        if not isinstance(live, dict):
+            fail(f"telemetry.live is {type(live).__name__}")
+        for key in ("recluster_tile_fraction", "insert_p50_ms",
+                    "insert_p99_ms", "delete_p50_ms", "delete_p99_ms"):
+            v = live.get(key)
+            if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                    or v != v or v in (float("inf"), float("-inf")):
+                fail(f"telemetry.live.{key} is {v!r}, expected a "
+                     f"finite number")
+        if not 0.0 <= live["recluster_tile_fraction"] <= 1.0:
+            fail(
+                f"telemetry.live.recluster_tile_fraction "
+                f"{live['recluster_tile_fraction']!r} outside [0, 1]"
+            )
+        for key in ("points", "cores", "inserts", "deletes", "updates",
+                    "recluster_events", "index_epoch",
+                    "index_delta_bytes"):
+            v = live.get(key)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                fail(
+                    f"telemetry.live.{key} is {v!r}, expected a "
+                    f"non-negative int"
+                )
+    if str(row["metric"]) == "live_load_qps":
+        load = row.get("load")
+        if not isinstance(load, dict):
+            fail("live_load_qps row without the load payload")
+        if load.get("arrival") != "poisson":
+            fail(f"load.arrival is {load.get('arrival')!r}")
+        if int(load.get("clients", 0)) < 4:
+            fail(f"sustained load ran {load.get('clients')!r} clients, "
+                 f"need >= 4")
+        for key in ("qps", "p50_ms", "p99_ms", "batch_fill",
+                    "update_visible_p50_ms", "update_visible_p99_ms"):
+            v = load.get(key)
+            if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                    or v != v or v in (float("inf"), float("-inf")):
+                fail(f"load.{key} is {v!r}, expected a finite number")
+    if str(row["metric"]) == "live_replicated_speedup":
+        v = row.get("value")
+        if not isinstance(v, (int, float)) or v != v or v <= 0:
+            fail(f"replicated speedup is {v!r}")
+        rep = row.get("replicated")
+        if not isinstance(rep, dict):
+            fail("live_replicated_speedup row without replicated stats")
+        if int(rep.get("replicated_devices", 0)) < 2:
+            fail(
+                f"replicated mode ran on "
+                f"{rep.get('replicated_devices')!r} device(s)"
+            )
+        if int(rep.get("per_device_index_bytes", 0)) <= 0:
+            fail(
+                f"per_device_index_bytes is "
+                f"{rep.get('per_device_index_bytes')!r}"
+            )
+
     # Regression-gate contract (ISSUE 6): rows produced under `make
     # bench-smoke` ride through bench_diff --annotate first; the
     # verdict must be present and must not be a real regression.

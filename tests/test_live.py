@@ -1,0 +1,382 @@
+"""Live-update subsystem (ISSUE 8): incremental insert/delete on a
+fitted model + in-place index refresh + sustained serving.
+
+Correctness contracts:
+
+* after any tested insert/delete sequence, labels are ARI == 1.0
+  (label-permutation-equivalent) vs a FULL REFIT on the final point
+  set — across fused, KD-sharded, and global-Morton fitted models, on
+  geometries with guaranteed blob separation (the one DBSCAN ambiguity
+  — a border point within eps of two clusters' cores — is excluded by
+  construction, as documented in serve/live.py);
+* ``predict`` stays bitwise exact (labels AND d2) against the
+  brute-force oracle on the UPDATED index — the ``seal_f32`` contract
+  holds through the in-place ``serve_index_delta`` refresh;
+* one insert can bridge several clusters (the union-find stitch, not a
+  single-min edge), one delete can split one.
+"""
+
+import numpy as np
+import pytest
+from sklearn.metrics import adjusted_rand_score
+
+from benchdata import make_separated_blob_data
+from pypardis_tpu import DBSCAN
+from pypardis_tpu.parallel.mesh import default_mesh
+from pypardis_tpu.serve import (
+    LiveModel,
+    QueryEngine,
+    ReplicatedQueryEngine,
+    sustained_load,
+)
+
+EPS, MS = 1.1, 6
+
+
+def _fit(mode="fused", n=600, dim=3, seed=0):
+    X, _truth, centers = make_separated_blob_data(
+        n, dim, n_centers=5, std=0.35,
+        min_sep=2 * EPS + 6 * 0.35 + 1.0, spread=10.0, seed=seed,
+    )
+    if mode == "fused":
+        m = DBSCAN(eps=EPS, min_samples=MS, mesh=default_mesh(1),
+                   block=128)
+    elif mode == "kd":
+        m = DBSCAN(eps=EPS, min_samples=MS, block=128)
+    elif mode == "global_morton":
+        m = DBSCAN(eps=EPS, min_samples=MS, block=128,
+                   mode="global_morton")
+    else:
+        raise AssertionError(mode)
+    return m.fit(X), X, centers
+
+
+def _assert_refit_equivalent(live):
+    refit = DBSCAN(
+        eps=live.eps, min_samples=live.min_samples,
+        mesh=default_mesh(1), block=128,
+    ).fit(live.points()).labels_
+    ari = adjusted_rand_score(refit, live.labels())
+    assert ari == 1.0, f"ARI {ari} vs full refit"
+
+
+def _assert_oracle_exact(live, Q):
+    t = live.engine.submit(Q)
+    live.engine.drain()
+    olabs, od2 = live.index.oracle_predict(Q)
+    np.testing.assert_array_equal(t.labels, olabs)
+    np.testing.assert_array_equal(t.d2, od2)
+
+
+def test_insert_fast_path_border_and_noise():
+    m, X, centers = _fit()
+    live = m.live(leaves=8)
+    epoch0 = live.index.epoch
+    # Far point: noise; near-blob non-core point: joins the blob's
+    # cluster — neither flips anyone, so no re-cluster, no index delta.
+    ids = m.insert(np.array([[40.0, 40.0, 40.0]]))
+    assert live.labels()[-1] == -1
+    near = centers[0] + np.array([0.0, 0.0, EPS * 0.9])
+    ids2 = m.insert(near[None])
+    lab = live._labels[ids2[0]]
+    assert lab >= 0
+    if live.stats["recluster_events"] == 0:
+        assert live.index.epoch == epoch0
+    assert live.stats["inserts"] == 2
+    _assert_refit_equivalent(live)
+
+
+def test_one_insert_bridges_three_clusters():
+    """The bridging geometry: three arms whose tips surround a gap; a
+    single core insert at the center merges all three — the PR 2
+    lesson (one bridge links EVERY adjacent cluster, not a single-min
+    edge) applied to the live path."""
+    eps, ms = 0.9, 5
+    arms = []
+    for a in (0.0, 2 * np.pi / 3, 4 * np.pi / 3):
+        r = np.arange(0.8, 3.01, 0.1)
+        arms.append(np.stack([r * np.cos(a), r * np.sin(a)], axis=1))
+    X = np.concatenate(arms)
+    m = DBSCAN(eps=eps, min_samples=ms, mesh=default_mesh(1),
+               block=64).fit(X)
+    labs0 = np.asarray(m.labels_)
+    assert len(np.unique(labs0[labs0 >= 0])) == 3
+    live = m.live(leaves=4)
+    ids = live.insert(np.zeros((1, 2)))
+    assert live._core[ids[0]], "bridge point must itself become core"
+    labs = live.labels()
+    assert len(np.unique(labs[labs >= 0])) == 1
+    refit = DBSCAN(eps=eps, min_samples=ms, mesh=default_mesh(1),
+                   block=64).fit(live.points()).labels_
+    assert adjusted_rand_score(refit, labs) == 1.0
+    _assert_oracle_exact(live, np.concatenate([X, np.zeros((1, 2))]))
+
+
+def test_delete_splits_cluster():
+    """Deleting the bridge point of a bar-shaped cluster splits it —
+    the affected-cluster re-cluster path (splits are cluster-local,
+    never leaf-local)."""
+    eps, ms = 0.6, 3
+    line = np.stack(
+        [np.arange(0.0, 8.01, 0.4), np.zeros(21)], axis=1
+    )
+    m = DBSCAN(eps=eps, min_samples=ms, mesh=default_mesh(1),
+               block=64).fit(line)
+    labs0 = np.asarray(m.labels_)
+    assert len(np.unique(labs0[labs0 >= 0])) == 1
+    live = m.live(leaves=4)
+    mid = np.argmin(np.abs(line[:, 0] - 4.0))
+    live.delete([int(mid)])
+    labs = live.labels()
+    assert len(np.unique(labs[labs >= 0])) == 2, "cluster must split"
+    _assert_refit_equivalent(live)
+    _assert_oracle_exact(live, live.points())
+
+
+@pytest.mark.parametrize("mode", ["fused", "kd", "global_morton"])
+def test_randomized_sequences_match_refit(mode):
+    """Property sweep: seeded insert/delete sequences against models
+    fitted by every route end ARI == 1.0 vs a full refit on the final
+    point set, and predict stays bitwise oracle-exact throughout."""
+    m, X, centers = _fit(mode=mode)
+    live = m.live(leaves=8)
+    rng = np.random.default_rng(17)
+    dim = X.shape[1]
+    for step in range(8):
+        kind = step % 4
+        if kind == 0:  # interior inserts (may flip borders to core)
+            c = centers[step % len(centers)]
+            live.insert(c + rng.normal(scale=0.3, size=(4, dim)))
+        elif kind == 1:  # a brand-new clump: fresh cluster from thin air
+            spot = np.full(dim, 20.0 + 3 * step)
+            live.insert(spot + rng.normal(scale=0.2, size=(MS + 2, dim)))
+        elif kind == 2:  # scattered noise
+            live.insert(rng.uniform(-30, 30, size=(2, dim)))
+        else:  # delete a handful, cores included
+            alive = live.ids()
+            take = rng.choice(alive, size=6, replace=False)
+            live.delete(take)
+    _assert_refit_equivalent(live)
+    Q = np.concatenate([
+        live.points()[:200],
+        rng.uniform(-25, 25, size=(100, dim)),
+    ])
+    _assert_oracle_exact(live, Q)
+    # Locality was measured along the way, and an update sequence that
+    # re-clustered must have touched fewer tiles than exist.
+    assert 0.0 <= live.stats["recluster_tile_fraction"] < 1.0
+
+
+def test_index_delta_pad_absorption_then_overflow():
+    """Pad slots absorb inserts (delta bytes << resident bytes, no new
+    slab); an overflowing leaf rebuilds ALONE (other leaves' columns
+    never re-ship); predict stays oracle-exact across both."""
+    m, X, centers = _fit(n=800)
+    live = m.live(leaves=8, block=32, qblock=32)
+    idx = live.index
+    assert idx.n_leaves > 1, "need a multi-leaf index for locality"
+    slabs0 = idx.n_leaves
+    resident = idx.stats["index_bytes"]
+    epoch0, delta0 = idx.epoch, idx.delta_bytes
+
+    # One interior insert: a pad-slot fill (or a single-leaf rebuild at
+    # worst) — the delta must undercut the resident slab bytes.
+    live.insert(centers[0] + np.full((1, X.shape[1]), 0.05))
+    if idx.epoch > epoch0:
+        assert 0 < idx.delta_bytes - delta0 < resident
+
+    # Pour points into ONE region until its leaf overflows.
+    rng = np.random.default_rng(3)
+    before_cols = idx.coords.shape[1]
+    live.insert(centers[1] + rng.normal(scale=0.3, size=(300, X.shape[1])))
+    assert idx.coords.shape[1] > before_cols, "expected slab growth"
+    grown = [l for l, s in idx.leaf_slabs.items() if len(s) > 1]
+    assert grown, "an overflowing leaf must own appended slabs"
+    assert idx.n_leaves > slabs0
+    _assert_oracle_exact(live, np.concatenate([
+        live.points()[:200], rng.uniform(-20, 20, size=(50, X.shape[1]))
+    ]))
+    _assert_refit_equivalent(live)
+
+
+def test_delete_frees_slots_for_later_inserts():
+    m, X, centers = _fit()
+    live = m.live(leaves=8)
+    idx = live.index
+    core_ids = live.ids()[live.core_mask()]
+    live.delete(core_ids[:10])
+    free_after = int((idx.labels == np.iinfo(np.int32).max).sum())
+    cols = idx.coords.shape[1]
+    live.insert(centers[2] + np.random.default_rng(5).normal(
+        scale=0.2, size=(5, X.shape[1])
+    ))
+    assert idx.coords.shape[1] == cols, "freed pad slots must absorb"
+    assert int((idx.labels == np.iinfo(np.int32).max).sum()) < free_after
+    _assert_refit_equivalent(live)
+
+
+def test_stale_engine_raises_after_refit():
+    """Satellite: a caller-held engine (or LiveModel) from before a
+    refit raises a clear error instead of silently serving the old
+    clustering; model.query_engine() hands out the rebuilt engine."""
+    m, X, _centers = _fit()
+    engine = m.query_engine()
+    live = m.live()
+    assert engine.predict(X[:4]) is not None  # fresh: works
+    m.fit(X[: len(X) // 2])
+    with pytest.raises(RuntimeError, match="refit"):
+        engine.predict(X[:4])
+    with pytest.raises(RuntimeError, match="refit"):
+        engine.submit(X[:4])
+    with pytest.raises(RuntimeError, match="refit"):
+        live.insert(X[:1])
+    # The model's own surface re-builds transparently.
+    assert m.query_engine().predict(X[:4]) is not None
+    assert len(m.live().insert(X[:1])) == 1
+
+
+def test_live_checkpoint_roundtrip(tmp_path):
+    """Satellite: save/load round-trips the MUTATED state — a
+    restarted server answers byte-identically to the pre-restart one
+    and keeps accepting writes."""
+    m, X, centers = _fit(n=500)
+    live = m.live(leaves=8, block=32, qblock=32)
+    rng = np.random.default_rng(9)
+    live.insert(centers[0] + rng.normal(scale=0.3, size=(40, X.shape[1])))
+    live.delete(live.ids()[5:15])
+    live.insert(rng.uniform(-20, 20, size=(3, X.shape[1])))
+    Q = np.concatenate([
+        live.points()[:150], rng.uniform(-15, 15, size=(80, X.shape[1]))
+    ])
+    t = live.engine.submit(Q)
+    live.engine.drain()
+
+    path = str(tmp_path / "live.npz")
+    live.save(path)
+    restored = LiveModel.load(path)
+    assert restored.index.epoch == live.index.epoch
+    np.testing.assert_array_equal(restored.index.coords, live.index.coords)
+    np.testing.assert_array_equal(restored.index.labels, live.index.labels)
+    t2 = restored.engine.submit(Q)
+    restored.engine.drain()
+    np.testing.assert_array_equal(t.labels, t2.labels)
+    np.testing.assert_array_equal(t.d2, t2.d2)
+    # The restored server keeps taking writes, still refit-equivalent.
+    restored.insert(centers[1] + rng.normal(scale=0.2,
+                                            size=(4, X.shape[1])))
+    restored.delete(restored.ids()[:2])
+    _assert_refit_equivalent(restored)
+
+    # A PLAIN model checkpoint (no live state) still loads the old way.
+    plain = str(tmp_path / "plain.npz")
+    m2, _X2, _c = _fit(n=300, seed=4)
+    m2.save(plain)
+    with pytest.raises(ValueError, match="without live state"):
+        LiveModel.load(plain)
+
+
+def test_replicated_engine_parity_and_stats():
+    m, X, _centers = _fit(n=500)
+    live = m.live(leaves=8)
+    rng = np.random.default_rng(2)
+    Q = np.concatenate([
+        X[:200], rng.uniform(-15, 15, size=(100, X.shape[1]))
+    ])
+    single = QueryEngine(live.index, backend="xla")
+    rep = ReplicatedQueryEngine(live.index, backend="xla")
+    t1 = single.submit(Q)
+    single.drain()
+    t2 = rep.submit(Q)
+    rep.drain()
+    np.testing.assert_array_equal(t1.labels, t2.labels)
+    np.testing.assert_array_equal(t1.d2, t2.d2)
+    olabs, od2 = live.index.oracle_predict(Q)
+    np.testing.assert_array_equal(t2.labels, olabs)
+    np.testing.assert_array_equal(t2.d2, od2)
+    stats = rep.serving_stats()
+    assert stats["replicated"] is True
+    assert stats["replicated_devices"] == 8
+    assert stats["per_device_index_bytes"] > 0
+    # A live update re-broadcasts: parity must survive an epoch bump.
+    live.insert(X[:1] + 0.01)
+    t3 = rep.submit(Q)
+    rep.drain()
+    ol3, od3 = live.index.oracle_predict(Q)
+    np.testing.assert_array_equal(t3.labels, ol3)
+    np.testing.assert_array_equal(t3.d2, od3)
+
+
+def test_sustained_load_harness():
+    m, X, _centers = _fit(n=500)
+    live = m.live(leaves=8)
+    res = sustained_load(
+        live.engine, clients=4, duration_s=0.7, rate_hz=120.0,
+        batch_rows=16, write_fraction=0.4, live=live, seed=1,
+    )
+    assert res["arrival"] == "poisson"
+    assert res["clients"] == 4
+    assert res["queries"] > 0
+    for key in ("qps", "p50_ms", "p99_ms", "batch_fill"):
+        assert np.isfinite(res[key]), (key, res)
+    if res["writes"]:
+        assert res["update_visible_p50_ms"] > 0
+        assert live.index.epoch >= 0
+    _assert_refit_equivalent(live)
+
+
+def test_report_live_block_and_summary():
+    m, X, centers = _fit()
+    live = m.live(leaves=8)
+    live.insert(centers[0] + np.full((1, X.shape[1]), 0.1))
+    live.delete(live.ids()[:1])
+    rep = m.report()
+    lv = rep["live"]
+    for key in ("points", "cores", "inserts", "deletes", "updates",
+                "recluster_events", "index_epoch", "index_delta_bytes",
+                "recluster_tile_fraction", "insert_p50_ms",
+                "insert_p99_ms", "delete_p50_ms", "delete_p99_ms"):
+        assert key in lv, key
+        assert np.isfinite(lv[key]), (key, lv[key])
+    assert 0.0 <= lv["recluster_tile_fraction"] <= 1.0
+    assert lv["inserts"] == 1 and lv["deletes"] == 1
+    assert "live:" in m.summary()
+
+
+def test_inflight_tickets_survive_epoch_bump():
+    """A ticket submitted before a live update resolves on the next
+    drain against the refreshed index — the engine picks up the new
+    epoch through its normal path without dropping anything."""
+    m, X, centers = _fit(n=400, seed=3)
+    live = m.live(leaves=8)
+    Q = X[:64]
+    t = live.engine.submit(Q)
+    epoch0 = live.index.epoch
+    live.insert(centers[0] + np.random.default_rng(8).normal(
+        scale=0.25, size=(10, X.shape[1])
+    ))
+    live.engine.drain()
+    assert t.done
+    olabs, od2 = live.index.oracle_predict(Q)  # post-update oracle
+    np.testing.assert_array_equal(t.labels, olabs)
+    np.testing.assert_array_equal(t.d2, od2)
+    assert live.engine.serving_stats()["index_epoch"] \
+        == live.index.epoch >= epoch0
+
+
+def test_insert_validation_and_delete_unknown_id():
+    m, X, _centers = _fit(n=300, seed=2)
+    live = m.live()
+    with pytest.raises(ValueError, match="2-D"):
+        live.insert(np.zeros(3))
+    with pytest.raises(ValueError):
+        live.insert(np.zeros((2, X.shape[1] + 1)))
+    bad = np.zeros((1, X.shape[1]))
+    bad[0, 0] = np.nan
+    with pytest.raises(ValueError, match="NaN"):
+        live.insert(bad)
+    with pytest.raises(KeyError, match="unknown"):
+        live.delete([10 ** 9])
+    ids = live.insert(np.full((1, X.shape[1]), 30.0))
+    live.delete(ids)
+    with pytest.raises(KeyError, match="deleted"):
+        live.delete(ids)
