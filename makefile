@@ -5,7 +5,7 @@ PY ?= python
 
 .PHONY: all wheel native test verify tpu-smoke bench bench-smoke \
 	partition-probe serve-probe live-probe global-morton-probe \
-	bench-diff flight-check demo clean
+	fault-probe bench-diff flight-check demo clean
 
 all: native test
 
@@ -46,7 +46,7 @@ bench:
 # then the CI-sized partitioner depth-scaling probe (fails when the
 # level builder's mp-doubling cost ratio exceeds 1.5x).
 bench-smoke: partition-probe serve-probe live-probe global-morton-probe \
-		bench-diff flight-check
+		fault-probe bench-diff flight-check
 	JAX_PLATFORMS=cpu BENCH_N=2000 BENCH_DIM=4 BENCH_REPS=1 \
 	BENCH_DEV_REPS=1 $(PY) bench.py \
 	| $(PY) scripts/bench_diff.py --annotate --baseline-dir . \
@@ -60,6 +60,17 @@ bench-smoke: partition-probe serve-probe live-probe global-morton-probe \
 bench-diff:
 	$(PY) scripts/bench_diff.py --prior BENCH_r04.json \
 	--current BENCH_r05.json --expect noise
+
+# Fault-tolerance probe (ISSUE 9): injects a mid-fixpoint shard
+# failure, a staging OOM, and a serving hang (PYPARDIS_FAULTS sites),
+# asserts labels byte-identical to the clean run through the unified
+# retry/degradation ladder, SIGKILLs a checkpointing child fit and
+# proves train(resume=) kill/resume byte-parity, then schema-checks the
+# emitted row (check_bench_json enforces the faults block: clean rows
+# must be all-zero, fault rows carry the real injected/retried counts).
+fault-probe:
+	FAULT_N=$${FAULT_N:-3000} $(PY) scripts/fault_probe.py \
+	| $(PY) scripts/check_bench_json.py
 
 # Crash-safety smoke: fit with the flight recorder enabled, SIGKILL it
 # mid-run, then reconstruct a Chrome trace + partial report from the

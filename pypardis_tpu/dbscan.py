@@ -20,6 +20,7 @@ Execution strategy replaces Spark end-to-end:
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Dict, Optional
 
@@ -185,7 +186,7 @@ def _layout_cacheable(cap: int, k: int) -> bool:
 
 def _pad_and_run(
     points, eps, min_samples, metric, block, precision="high", sort=True,
-    backend="auto",
+    backend="auto", jobstate=None,
 ):
     """Center, spatially sort, pad to a block multiple, run the kernel,
     un-sort and slice back.
@@ -302,6 +303,7 @@ def _pad_and_run(
                 sort=bool(sort and n > 2 * block),
                 pair_budget=pair_budget,
                 layout_key=layout_key,
+                jobstate=jobstate,
             )
         )
 
@@ -369,6 +371,9 @@ def _pad_and_run(
             "Pallas kernel failed to lower on %s; falling back to the "
             "XLA kernel path (%s)", jax_backend_name(), e,
         )
+        from .utils.retry import note_degraded
+
+        note_degraded("kernel_xla", error=str(e)[:160])
         packed = ladder("xla")
     if staged is not None:
         # The pipeline's host fetch has completed, so the input
@@ -563,10 +568,13 @@ class DBSCAN:
         self._live_model = None
         self._live_stats = None
         self._fit_generation = 0
+        # Checkpoint-resumable fit state (utils.jobstate), created per
+        # train() when resume=/PYPARDIS_CKPT asks for it.
+        self._jobstate = None
 
     # -- training ---------------------------------------------------------
 
-    def train(self, data) -> "DBSCAN":
+    def train(self, data, resume: Optional[str] = None) -> "DBSCAN":
         """Cluster a (key, vector) dataset (reference dbscan.py:104-126).
 
         With ``profile_dir`` set, the whole run executes under a
@@ -575,6 +583,17 @@ class DBSCAN:
         :class:`~pypardis_tpu.utils.profiling.PhaseTimer` into
         ``metrics_`` — phases end on materialized outputs, so the
         numbers include async device execution.
+
+        ``resume=path`` makes the fit checkpoint-resumable
+        (:mod:`pypardis_tpu.utils.jobstate`): phase-boundary snapshots
+        (completed chained partitions, stepped propagation state, the
+        global-Morton fixpoint ``lab_map``) stream to ``path`` at the
+        ``PYPARDIS_CKPT_EVERY_S`` cadence, and a fit SIGKILLed mid-run
+        replays only the unfinished work when retrained with the same
+        ``resume`` path — labels byte-identical to an uninterrupted
+        fit (the file's fit fingerprint rejects mismatched data or
+        params).  ``PYPARDIS_CKPT=<path>`` enables snapshot WRITING for
+        fits that never pass ``resume``.
         """
         import contextlib
 
@@ -583,6 +602,22 @@ class DBSCAN:
 
         validate_params(self.eps, self.min_samples)
         keys, points = _as_keys_points(data)
+        ckpt_path = resume or os.environ.get("PYPARDIS_CKPT")
+        if ckpt_path:
+            from .utils.jobstate import JobState, fit_meta
+
+            self._jobstate = JobState.open(
+                ckpt_path,
+                fit_meta(
+                    points, eps=self.eps, min_samples=self.min_samples,
+                    metric=self.metric if isinstance(self.metric, str)
+                    else getattr(self.metric, "__name__", "callable"),
+                    block=self.block, mode=self.mode,
+                ),
+                resume=resume is not None,
+            )
+        else:
+            self._jobstate = None
         self._keys = keys
         self.data = points
         t0 = time.perf_counter()
@@ -693,6 +728,14 @@ class DBSCAN:
             raise
         finally:
             sampler.stop()
+            if self._jobstate is not None:
+                # Persist any boundary state the cadence was still
+                # holding (a SIGKILL needs no help — every boundary
+                # write is atomic; this covers ordinary exceptions).
+                try:
+                    self._jobstate.flush(force=True)
+                except OSError:
+                    pass
             if flight is not None:
                 flight.finish(status="ok")  # no-op after an error seal
                 flight.close()
@@ -715,6 +758,45 @@ class DBSCAN:
 
     def fit_predict(self, X) -> np.ndarray:
         return self.fit(X).labels_
+
+    # ``labels_`` / ``core_sample_mask_`` / ``data`` are properties so
+    # the live-update path can sync them LAZILY: LiveModel used to copy
+    # all three O(N) arrays on EVERY update (the CHANGES PR 8 note) —
+    # now an update just marks them dirty, and the copy happens once,
+    # here, when something actually reads the model surface.  A
+    # sustained write load that never reads labels_ pays zero sync cost.
+    @property
+    def labels_(self) -> Optional[np.ndarray]:
+        lm = self._live_model
+        if lm is not None:
+            lm._sync_if_dirty()
+        return self._labels_v
+
+    @labels_.setter
+    def labels_(self, value) -> None:
+        self._labels_v = value
+
+    @property
+    def core_sample_mask_(self) -> Optional[np.ndarray]:
+        lm = self._live_model
+        if lm is not None:
+            lm._sync_if_dirty()
+        return self._core_mask_v
+
+    @core_sample_mask_.setter
+    def core_sample_mask_(self, value) -> None:
+        self._core_mask_v = value
+
+    @property
+    def data(self):
+        lm = self._live_model
+        if lm is not None:
+            lm._sync_if_dirty()
+        return self._data_v
+
+    @data.setter
+    def data(self, value) -> None:
+        self._data_v = value
 
     @property
     def neighbors(self):
@@ -923,6 +1005,7 @@ class DBSCAN:
             roots, core, kinfo = _pad_and_run(
                 points, self.eps, self.min_samples, self.metric, self.block,
                 precision=self.precision, backend=self.kernel_backend,
+                jobstate=self._jobstate,
             )
         self.core_sample_mask_ = core
         with timer.phase("densify"):
@@ -969,8 +1052,29 @@ class DBSCAN:
                     "the dataset; use the default KD ring route for "
                     "disk-backed inputs"
                 )
-            self._train_sharded_global_morton(points, timer)
-            return
+            try:
+                self._train_sharded_global_morton(points, timer)
+                return
+            except Exception as e:  # noqa: BLE001 — rethrown below
+                from .utils.retry import is_degradable_error, \
+                    note_degraded
+
+                if not is_degradable_error(e):
+                    raise
+                # Terminal mode fallback: the KD owner-computes engine
+                # clusters the same data with smaller peak allocations
+                # (no global Morton keying copy, host-spillable merge)
+                # and is pinned byte-identical across modes — degrade
+                # rather than die.
+                note_degraded(
+                    "kd_owner_computes", mode="global_morton",
+                    error=str(e)[:160],
+                )
+                get_logger().warning(
+                    "global-Morton engine failed terminally (%s); "
+                    "falling back to the KD owner-computes mode "
+                    "(labels are pinned byte-identical)", e,
+                )
         if _is_device_array(points):
             # Device-resident input never round-trips the coordinates
             # through the host (the analogue of train(rdd) on
@@ -1033,6 +1137,7 @@ class DBSCAN:
                 halo=halo,
                 owner_computes=self.owner_computes,
                 overlap=self.overlap,
+                jobstate=self._jobstate,
             )
         with timer.phase("densify"):
             self.labels_ = densify_labels(labels)
@@ -1153,6 +1258,7 @@ class DBSCAN:
                 precision=self.precision,
                 backend=self.kernel_backend,
                 merge=self.merge,
+                jobstate=self._jobstate,
             )
         parity = stats.pop("parity", None)
         with timer.phase("densify"):

@@ -295,6 +295,35 @@ def build_run_report(
         "halo_overflow": ev.get("halo_overflow", 0),
         "merge_unconverged": ev.get("merge_unconverged", 0),
         "compile": ev.get("compile", 0),
+        "fault_injected": ev.get("fault_injected", 0),
+        "degraded": ev.get("degraded", 0),
+    }
+
+    # Fault-tolerance block (always present, schema-enforced): what the
+    # fault-injection switchboard fired (utils.faults — 0 on every
+    # clean run, by the zero-cost-when-unset contract), how many
+    # retries the unified layer spent and abandoned (utils.retry
+    # per-site counters summed), and which graceful-degradation rung a
+    # terminal failure landed on ("" when none).
+    ctr = (
+        recorder.metrics.counters_with_prefix("")
+        if recorder is not None else {}
+    )
+    faults_block = {
+        "injected": int(ctr.get("faults.injected", 0)),
+        "retried": int(sum(
+            v for k, v in ctr.items()
+            if k.startswith("retry.") and k.endswith(".attempts")
+        )),
+        "giveups": int(sum(
+            v for k, v in ctr.items()
+            if k.startswith("retry.") and k.endswith(".giveups")
+        )),
+        "degraded": int(ctr.get("faults.degraded", 0)),
+        "degraded_to": str(
+            recorder.metrics.gauge("faults.degraded_to", "")
+            if recorder is not None else ""
+        ),
     }
 
     # Host-stepped propagation breakdown (pipeline._cluster_stepped's
@@ -333,6 +362,7 @@ def build_run_report(
         "resources": resources,
         "devices": devices,
         "events": events,
+        "faults": faults_block,
         "metrics": (
             recorder.metrics.as_dict()
             if recorder is not None
@@ -505,6 +535,17 @@ def format_summary(report: Dict) -> str:
             f"  devices: {len(dev_pts)} x [{lo:,}..{hi:,}] pts "
             f"(skew {skew:.2f}x)"
         )
+    fl = report.get("faults") or {}
+    if any(fl.get(k) for k in ("injected", "retried", "giveups",
+                               "degraded")):
+        bits = (
+            f"  faults: {fl.get('injected', 0)} injected, "
+            f"{fl.get('retried', 0)} retried, "
+            f"{fl.get('giveups', 0)} giveups"
+        )
+        if fl.get("degraded"):
+            bits += f", degraded -> {fl.get('degraded_to', '?')}"
+        lines.append(bits)
     lines.append(
         "  events: "
         f"{ev['restage']} restage, {ev['pair_overflow']} pair-overflow, "

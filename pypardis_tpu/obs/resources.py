@@ -77,6 +77,29 @@ def staging_pool_bytes() -> int:
         return 0
 
 
+def rss_soft_limit() -> int:
+    """The host-RSS soft watermark in bytes (``PYPARDIS_RSS_SOFT_LIMIT``;
+    0 = disabled)."""
+    try:
+        return int(float(os.environ.get("PYPARDIS_RSS_SOFT_LIMIT", 0)))
+    except (TypeError, ValueError):
+        return 0
+
+
+def memory_pressure() -> bool:
+    """Whether host RSS currently exceeds the soft limit.
+
+    Evaluated live (one /proc read) so callers outside a sampled fit —
+    probes driving ``sharded_dbscan`` directly — see the same verdict.
+    The retry/degradation layer consults this to take the host-spill
+    merge rung PREEMPTIVELY (``merge='auto'`` resolves to ``'host'``
+    under pressure) instead of waiting for the in-graph merge's
+    replicated arrays to OOM a watermarked host.
+    """
+    limit = rss_soft_limit()
+    return bool(limit) and host_rss_bytes() > limit
+
+
 class ResourceSampler:
     """Background watermark sampler for one fit.
 
@@ -103,12 +126,26 @@ class ResourceSampler:
         self._peak_dev = 0
         self._peak_pool = 0
         self._samples = 0
+        self._soft_limit = rss_soft_limit()
+        self._pressure_noted = False
 
     def _sample(self) -> None:
         host = host_rss_bytes()
         dev = device_live_bytes()
         pool = staging_pool_bytes()
         self._samples += 1
+        # Watermark -> action hookup: crossing the soft limit emits ONE
+        # resource.pressure event per fit (the gauge stays current) and
+        # flips the verdict memory_pressure() serves to the retry layer
+        # — which then prefers the host-spill merge rung preemptively.
+        if self._soft_limit and host > self._soft_limit:
+            self._rec.metrics.set("resources.pressure", True)
+            if not self._pressure_noted:
+                self._pressure_noted = True
+                self._rec.event(
+                    "resource.pressure", rss_bytes=int(host),
+                    soft_limit_bytes=int(self._soft_limit),
+                )
         grew = (
             host > self._peak_host or dev > self._peak_dev
             or pool > self._peak_pool

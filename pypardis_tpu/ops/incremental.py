@@ -116,6 +116,14 @@ def attach_to_cores(
     return out_lab, out_d2
 
 
+def bucket_size(n: int, floor: int = 64) -> int:
+    """Power-of-two compile bucket for :func:`core_components` slabs."""
+    b = max(int(floor), 1)
+    while b < int(n):
+        b *= 2
+    return b
+
+
 def core_components(
     cores: np.ndarray,
     eps: float,
@@ -123,6 +131,8 @@ def core_components(
     block: int = 256,
     precision: str = "high",
     backend: str = "auto",
+    bucket: bool = True,
+    min_bucket: int = 64,
 ) -> np.ndarray:
     """(n,) int32 eps-connectivity component ids (dense, from 0) of a
     set of KNOWN core points — the local re-cluster's compute step.
@@ -133,6 +143,18 @@ def core_components(
     connected components of the eps-graph over these points.  The slab
     is the extracted blast radius — a few KD leaves — so this is the
     one device pass of an incremental update.
+
+    ``bucket`` pads the slab to a power-of-two size with far-apart
+    sentinel rows before the kernel runs.  Compiled programs are keyed
+    by padded shape, so without buckets every distinct blast-radius
+    size paid its own jit trace — the ~1.6s first-insert compile the
+    live path used to eat per new size.  Buckets collapse those to a
+    handful of shapes, and :meth:`LiveModel`'s build-time warmup
+    compiles the bucket the first insert will actually hit.  The
+    sentinels sit ``10*eps`` apart along one axis past the data's
+    extent, so they form singleton components AFTER every real point
+    in densify order — real components are untouched (sliced back to
+    ``n``).
     """
     cores = np.asarray(cores, np.float64)
     n = len(cores)
@@ -143,11 +165,38 @@ def core_components(
     from ..dbscan import _pad_and_run
     from . import densify_labels
 
+    run = cores
+    if bucket:
+        target = bucket_size(n, min_bucket)
+        pad = target - n
+        if pad > 0:
+            # Sentinels sit on a compact grid just past the data's
+            # upper corner, spaced 3*eps apart (mutually > eps, and
+            # every sentinel is > 2*steps beyond the real extent on
+            # axis 0).  A grid — not a line — keeps the slab's spread
+            # within ~10 steps per axis: the kernel recentres in f32,
+            # whose distance error grows with coordinate magnitude, so
+            # a pad-long line of sentinels would degrade the REAL
+            # pairs' verdicts at large buckets.
+            k = cores.shape[1]
+            step = 3.0 * max(float(eps), 1e-6)
+            g, side = 1, pad
+            while side > 8 and g < k:
+                g += 1
+                side = int(np.ceil(pad ** (1.0 / g)))
+            side = max(side, 2)
+            hi = cores.max(axis=0)
+            far = np.tile(cores.mean(axis=0), (pad, 1))
+            idx = np.arange(pad)
+            for a in range(g):
+                far[:, a] = hi[a] + step * (2 + (idx % side))
+                idx = idx // side
+            run = np.concatenate([cores, far])
     roots, _core, _info = _pad_and_run(
-        cores, eps, 1, "euclidean", block, precision=precision,
+        run, eps, 1, "euclidean", block, precision=precision,
         backend=backend,
     )
-    return densify_labels(roots)
+    return densify_labels(roots)[:n]
 
 
 def label_lut(mapping: dict, max_id: int) -> np.ndarray:

@@ -413,12 +413,9 @@ ROUND_BATCH = int(
 
 
 def _default_transient(e: BaseException) -> bool:
-    msg = f"{type(e).__name__}: {e}"
-    return any(
-        s in msg
-        for s in ("UNAVAILABLE", "INTERNAL", "INVALID_ARGUMENT",
-                  "InvalidArgument")
-    )
+    from ..utils.retry import is_transient_error
+
+    return is_transient_error(e)
 
 
 def _transient_retry(stage, fn, retryable=_default_transient):
@@ -430,36 +427,17 @@ def _transient_retry(stage, fn, retryable=_default_transient):
     surfaces as UNAVAILABLE until it restarts.  Pure environment
     nondeterminism — the retried call computes the same pure function.
     ``retryable`` classifies which exceptions are worth the 0/10/75s
-    ladder; everything else re-raises immediately.
+    ladder; everything else re-raises immediately.  Since the
+    fault-tolerance PR this is a thin veneer over the unified
+    :class:`pypardis_tpu.utils.retry.Retrier` (same ladder, plus the
+    per-site ``retry.<stage>.attempts/giveups`` counters and the
+    shared deadline/jitter machinery).
     """
-    import time as _time
+    from ..utils.retry import DEFAULT_WAITS, Retrier
 
-    last = None
-    for wait in (0, 10, 75):
-        if wait:
-            from ..obs import event as obs_event
-            from ..obs.registry import sanitize_segment
-            from ..utils.log import get_logger
-
-            # Every retry is a telemetry event (events.retry.<stage>):
-            # the restage/ladder machinery is inspectable from
-            # DBSCAN.report() without scraping warning logs.
-            obs_event(
-                f"retry.{sanitize_segment(stage)}",
-                wait_s=wait, error=str(last)[:160],
-            )
-            get_logger().warning(
-                "transient TPU runtime error in %s; retrying in %ds: %s",
-                stage, wait, str(last)[:160],
-            )
-            _time.sleep(wait)
-        try:
-            return fn()
-        except Exception as e:  # noqa: BLE001 — re-raised unless transient
-            if not retryable(e):
-                raise
-            last = e
-    raise last
+    return Retrier(stage, waits=DEFAULT_WAITS).run(
+        fn, retryable=retryable
+    )
 
 
 def _step_overlap_enabled() -> bool:
@@ -486,7 +464,7 @@ def _step_overlap_enabled() -> bool:
 
 def _cluster_stepped(
     xs, mask_k, owner, eps, *, cap, min_samples, block, precision,
-    pair_budget,
+    pair_budget, jobstate=None,
 ):
     """Stage 2 (host-stepped, Pallas): one device call per round batch.
 
@@ -526,6 +504,20 @@ def _cluster_stepped(
     (rows, cols), pair_stats, core, f, band0 = _transient_retry(
         "prepare", run_prepare
     )
+    # Resume: the pair list / core flags recompute deterministically
+    # above; only the propagation state f needs restoring.  Min-label
+    # propagation is monotone toward a unique fixpoint, so continuing
+    # from ANY intermediate state of the same tables reaches labels
+    # byte-identical to the uninterrupted run.  Snapshots are keyed by
+    # the effective pair budget — state written under a budget that
+    # later overflowed is never resumed.
+    budget_tag = int(pair_budget or 0)
+    resumed_batches = 0
+    if jobstate is not None:
+        saved = jobstate.stepped_restore(budget_tag, int(f.shape[0]))
+        if saved is not None:
+            f = jnp.asarray(saved[0])
+            resumed_batches = int(saved[1])
     # Mixed-precision band telemetry accumulates host-side across the
     # stepped dispatches (each device call reports its own batch; the
     # convergence-flag fetch is already a sync point, so the extra
@@ -546,7 +538,7 @@ def _cluster_stepped(
     # size crashed the worker outright (round-4 measurement) — scale
     # the batch down with capacity so one call stays safely short.
     batch_k = max(1, min(ROUND_BATCH, (1 << 27) // max(xs.shape[1], 1)))
-    max_batches = -(-MAX_ROUNDS // batch_k)
+    max_batches = max(-(-MAX_ROUNDS // batch_k) - resumed_batches, 1)
     speculate = _step_overlap_enabled()
     batches = 0  # batches whose results were CONSUMED
     dispatched = 0  # includes the wasted post-fixpoint speculation
@@ -562,6 +554,9 @@ def _cluster_stepped(
     if not speculate:
         for _ in range(max_batches):
             def some_rounds(f=f):
+                from ..utils import faults
+
+                faults.maybe_fail("stepped.batch")
                 out = dispatch(f)
                 return out + (bool(out[2]),)  # sync inside retry scope
 
@@ -571,6 +566,12 @@ def _cluster_stepped(
             band_acc += np.asarray(band_b, np.int64)
             batches += 1
             obs_heartbeat("stepped.rounds", batches, max_batches, t_rounds)
+            if jobstate is not None and jobstate.due():
+                # The (capk,) fetch is the snapshot's cost — cadence-
+                # gated (PYPARDIS_CKPT_EVERY_S), never paid otherwise.
+                jobstate.stepped_note(
+                    np.asarray(f), resumed_batches + batches, budget_tag
+                )
             if not changed:  # the last executed round was a fixpoint
                 converged = True
                 break
@@ -590,6 +591,9 @@ def _cluster_stepped(
             def one_window():
                 nonlocal pending
                 try:
+                    from ..utils import faults
+
+                    faults.maybe_fail("stepped.batch")
                     cur = pending if pending is not None else dispatch(f)
                     spec = None if last else dispatch(cur[0])
                     changed = bool(np.asarray(cur[2]))
@@ -604,6 +608,10 @@ def _cluster_stepped(
             batches += 1
             obs_heartbeat("stepped.rounds", batches, max_batches, t_rounds)
             f, g = cur[0], cur[1]
+            if jobstate is not None and jobstate.due():
+                jobstate.stepped_note(
+                    np.asarray(f), resumed_batches + batches, budget_tag
+                )
             band_acc += np.asarray(cur[3], np.int64)
             if not changed:
                 converged = True
@@ -672,6 +680,7 @@ def dbscan_device_pipeline(
     sort: bool = True,
     pair_budget: int | None = None,
     layout_key=None,
+    jobstate=None,
 ):
     """points_t: (d, cap) float32, centered, zero-padded past ``n``
     (traced) — or a ZERO-ARG CALLABLE producing it, evaluated only
@@ -763,11 +772,15 @@ def dbscan_device_pipeline(
                 xs, mask_k, owner, eps,
                 cap=cap, min_samples=min_samples, block=block,
                 precision=precision, pair_budget=pair_budget,
+                jobstate=jobstate,
             )
             sp.set(capacity=int(xs.shape[1]))
             return out
 
     def run_cluster():
+        from ..utils import faults
+
+        faults.maybe_fail("pipeline.cluster")
         out = _pipeline_cluster(
             xs, mask_k, owner, eps,
             cap=cap, min_samples=min_samples, metric=metric, block=block,

@@ -88,8 +88,15 @@ from ..ops.labels import (
     oc_propagate_banded,
 )
 from ..partition import morton_range_split
-from ..utils import clamp_block, round_up, validate_params
+from ..utils import clamp_block, faults, round_up, validate_params
 from ..utils.budget import run_ladders
+from ..utils.retry import (
+    Retrier,
+    is_degradable_error,
+    note_degraded,
+    note_giveup,
+    note_retry,
+)
 from . import staging
 from .halo import boundary_send_select, ring_tile_round
 from .mesh import shard_map
@@ -233,7 +240,9 @@ def build_morton_shards(points, n_shards, block, sharding, eps=None):
             "box_hi": hi.tolist(),
         },
     }
-    arrays = tuple(jax.device_put(a, sharding) for a in (owned, msk, gid))
+    arrays = staging.transfer(lambda: tuple(
+        jax.device_put(a, sharding) for a in (owned, msk, gid)
+    ))
     staging.device_put_cached("gm_owned", base, arrays, aux=aux)
     return arrays, aux, bufs, base
 
@@ -420,10 +429,24 @@ def _gm_exchange(arrays, eps, *, mesh, axis, gtile, bt, bc):
         t_ring = _time.perf_counter()
         for r in range(n_dev - 1):
             with obs_span("gm.ring_round", round=r) as rs:
-                state = _gm_ring_step(
-                    *state, my_lo, my_hi, np.float32(eps),
-                    mesh=mesh, axis=axis,
-                )
+
+                def one_round(state=state):
+                    # Injection site + unified retry scope: the ring
+                    # step is pure in its inputs (the Python-held state
+                    # tuple is rebound only on success), so a
+                    # re-dispatch after a transient fault recomputes
+                    # the identical round.  The overflow probe inside
+                    # the scope is the sync that surfaces execution
+                    # faults here rather than rounds later.
+                    faults.maybe_fail("gm.ring_round")
+                    out = _gm_ring_step(
+                        *state, my_lo, my_hi, np.float32(eps),
+                        mesh=mesh, axis=axis,
+                    )
+                    np.asarray(out[-1])
+                    return out
+
+                state = Retrier("gm.ring_round").run(one_round)
                 # The per-round overflow probe doubles as the span sync
                 # — a scalar fetch, so the span measures the round's
                 # execution, not its dispatch.
@@ -483,6 +506,7 @@ def _gm_boundary_tiles(arrays, eps, *, mesh, axis, block, btcap, base):
     """The boundary exchange behind its capacity ladder and the staging
     cache (route ``gm_boundary``, keyed base + eps): warm refits of the
     same data/eps skip the select + ring entirely."""
+    faults.maybe_fail("gm.exchange")
     bkey = base + ("boundary", float(eps))
     cached = staging.device_get("gm_boundary", bkey)
     if cached is not None:
@@ -515,17 +539,36 @@ def _gm_boundary_tiles(arrays, eps, *, mesh, axis, block, btcap, base):
         )
         if send_ovf and explicit:
             # An explicit send cap is a user contract: dropped boundary
-            # tiles would mean silently wrong labels, so fail loudly.
-            raise RuntimeError(
-                f"global-Morton boundary-tile send buffer overflow "
-                f"(btcap={bt}, need {send_need}); pass a larger btcap"
+            # tiles would mean silently wrong labels, so fail loudly —
+            # and actionably: the message names the exact need and
+            # every knob that raises the cap.
+            err = RuntimeError(
+                f"global-Morton boundary-tile send buffer overflow: "
+                f"btcap={bt} but this mesh/eps needs {send_need} tiles "
+                f"per device; pass btcap>={send_need} "
+                f"(global_morton_dbscan(btcap=...)) or set "
+                f"PYPARDIS_GM_BTCAP={send_need}, or leave btcap unset "
+                f"for the auto-doubling ladder"
             )
+            note_giveup("gm.btcap", err)
+            raise err
         attempts -= 1
         if attempts <= 0:
-            raise RuntimeError(
+            err = RuntimeError(
                 f"global-Morton boundary-tile buffer overflow persisted "
-                f"(btcap={bt}, bcap={bc})"
+                f"through {6} capacity retries (btcap={bt}, bcap={bc}); "
+                f"pass a larger btcap (global_morton_dbscan(btcap=...) "
+                f"or PYPARDIS_GM_BTCAP)"
             )
+            note_giveup("gm.btcap", err)
+            raise err
+        note_retry(
+            "gm.btcap", 0.0,
+            RuntimeError(
+                f"boundary-tile overflow (send={send_ovf}, "
+                f"recv={recv_ovf}) at btcap={bt}, bcap={bc}"
+            ),
+        )
         if send_ovf:
             # n_send is exact, so one retry covers the send side.
             bt = min(nt, max(send_need, 2 * bt))
@@ -640,7 +683,7 @@ def _gm_fixpoint_step(lab_map, home_label, core_g, bgid, b_glab,
 
 
 def _gm_fixpoint(home_label, core_g, bgid, b_glab, *, mesh, axis,
-                 n_points, merge_rounds):
+                 n_points, merge_rounds, jobstate=None, budget_tag=0):
     """Host-stepped cross-device pmin fixpoint.
 
     Each round is its own program with a per-round convergence probe
@@ -650,23 +693,49 @@ def _gm_fixpoint(home_label, core_g, bgid, b_glab, *, mesh, axis,
     shared :func:`sharded._merge_round` body); ``converged`` False at
     ``merge_rounds`` means possibly under-merged — the caller's ladder
     retries at 4x, never returns it silently.
+
+    Rounds run under the unified retry layer (site
+    ``gm.fixpoint_round``): a transient fault re-dispatches the round
+    from the same Python-held ``lab_map`` — pure, so byte-identical.
+    With a ``jobstate``, each round's (N+1,) ``lab_map`` snapshots at
+    the checkpoint cadence; a SIGKILLed fit resumes mid-fixpoint and
+    converges to the identical labels (pmin propagation is monotone
+    toward its unique fixpoint from any intermediate state of the same
+    tables — which is why snapshots are keyed by the pair budget that
+    produced those tables).
     """
     import time as _time
 
     rep = NamedSharding(mesh, P())
     lab_map = jax.device_put(np.arange(n_points + 1, dtype=np.int32), rep)
     rounds = 0
+    if jobstate is not None:
+        saved = jobstate.gm_restore(int(budget_tag), n_points + 1)
+        if saved is not None:
+            lab_map = jax.device_put(saved[0], rep)
+            rounds = min(int(saved[1]), max(merge_rounds - 1, 0))
+            obs_event("jobstate_restore", route="gm_fixpoint",
+                      round=rounds)
     converged = False
     t0 = _time.perf_counter()
     while rounds < merge_rounds:
         with obs_span("gm.fixpoint_round", round=rounds):
-            lab_map, changed = _gm_fixpoint_step(
-                lab_map, home_label, core_g, bgid, b_glab,
-                mesh=mesh, axis=axis, n_points=n_points,
-            )
-            ch = bool(np.asarray(changed))
+
+            def one_round(lab_map=lab_map):
+                faults.maybe_fail("gm.fixpoint_round")
+                new_map, changed = _gm_fixpoint_step(
+                    lab_map, home_label, core_g, bgid, b_glab,
+                    mesh=mesh, axis=axis, n_points=n_points,
+                )
+                return new_map, bool(np.asarray(changed))
+
+            lab_map, ch = Retrier("gm.fixpoint_round").run(one_round)
         rounds += 1
         obs_heartbeat("gm.fixpoint", rounds, merge_rounds, t0)
+        if jobstate is not None and jobstate.due():
+            jobstate.gm_note(
+                np.asarray(lab_map), rounds, int(budget_tag)
+            )
         if not ch:
             converged = True
             break
@@ -691,6 +760,7 @@ def global_morton_dbscan(
     pair_budget: Optional[int] = None,
     merge_rounds: int = 32,
     btcap: Optional[int] = None,
+    jobstate=None,
 ):
     """Cluster ``points`` over the mesh with zero row duplication.
 
@@ -726,8 +796,21 @@ def global_morton_dbscan(
     axis = mesh.axis_names[0]
     points = np.asarray(points)
     n, k = points.shape
+    if btcap is None:
+        env_btcap = os.environ.get("PYPARDIS_GM_BTCAP")
+        if env_btcap:
+            btcap = int(env_btcap)
     if merge == "auto":
-        merge = "host" if n >= MERGE_HOST_AUTO else "device"
+        # Host-RSS pressure (PYPARDIS_RSS_SOFT_LIMIT crossed) takes the
+        # host-spill merge preemptively — same rung the degradation
+        # ladder would reach after a device-merge OOM, chosen before
+        # the replicated (N+1,) arrays are ever allocated.
+        from ..obs.resources import memory_pressure
+
+        merge = (
+            "host" if n >= MERGE_HOST_AUTO or memory_pressure()
+            else "device"
+        )
     block = clamp_block(block, -(-n // max(n_shards, 1)))
     sharding = NamedSharding(mesh, P(axis))
     staging.begin_fit()
@@ -772,6 +855,7 @@ def global_morton_dbscan(
     if merge == "host":
 
         def run_step(pb, _mr):
+            faults.maybe_fail("gm.execute")
             out = _with_kernel_fallback(
                 lambda b2: _oc_host_tables(
                     (owned, omsk, ogid, bnd, bmsk, bgid),
@@ -797,6 +881,7 @@ def global_morton_dbscan(
         rounds_cell = [0]
 
         def run_step(pb, mr):
+            faults.maybe_fail("gm.execute")
             home_label, core_g, b_glab, pstats = _with_kernel_fallback(
                 lambda b2: _gm_cluster_step(
                     owned, omsk, ogid, bnd, bmsk, bgid,
@@ -811,15 +896,36 @@ def global_morton_dbscan(
                 lab_map, rounds, converged = _gm_fixpoint(
                     home_label, core_g, bgid, b_glab, mesh=mesh,
                     axis=axis, n_points=n, merge_rounds=mr,
+                    jobstate=jobstate, budget_tag=int(pb or 0),
                 )
                 sp.set(rounds=rounds, converged=converged)
             rounds_cell[0] = rounds
             return (home_label, core_g, lab_map), pstats, converged
 
         with obs_span("gm.execute", merge="device"):
-            (home_label, core_g, lab_map), pstats = run_ladders(
-                run_step, hint_key, pair_budget, merge_rounds
-            )
+            try:
+                (home_label, core_g, lab_map), pstats = run_ladders(
+                    run_step, hint_key, pair_budget, merge_rounds
+                )
+            except Exception as e:  # noqa: BLE001 — rethrown below
+                if not is_degradable_error(e):
+                    raise
+                # Degradation rung: the device merge's replicated
+                # (N+1,) arrays are this mode's hungriest allocation —
+                # rerun with the collective-free host union-find spill
+                # (pinned byte-identical).
+                note_degraded(
+                    "merge_host", mode="global_morton",
+                    error=str(e)[:160],
+                )
+                staging.give_back(host_bufs)
+                return global_morton_dbscan(
+                    points, eps=eps, min_samples=min_samples,
+                    metric=metric, block=block, mesh=mesh,
+                    precision=precision, backend=backend, merge="host",
+                    pair_budget=pair_budget, merge_rounds=merge_rounds,
+                    btcap=btcap, jobstate=jobstate,
+                )
         lab_np = np.asarray(lab_map)
         home_np = np.asarray(home_label)
         final = np.where(

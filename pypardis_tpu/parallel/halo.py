@@ -199,7 +199,13 @@ def boundary_send_select(owned, mask, gid, eps, *, gtile, btcap, axis):
     send_hi, n_send, overflow, my_lo, my_hi)``.  Invalid send slots
     carry inverted boxes (never accepted downstream), masked rows, and
     INT32_MAX gids.  ``overflow`` counts boundary tiles dropped for
-    ``btcap`` — the driver's doubling ladder treats nonzero as a retry.
+    ``btcap`` — the driver's doubling ladder
+    (:func:`pypardis_tpu.parallel.global_morton._gm_boundary_tiles`)
+    treats nonzero as a retry, reports each rung through the unified
+    retry counters (``retry.gm.btcap.*``), and an EXPLICIT too-small
+    cap raises an actionable error naming the exact need and the knobs
+    (``btcap=`` / ``PYPARDIS_GM_BTCAP``) — dropped boundary tiles would
+    mean silently wrong labels, so exhaustion is always loud.
     """
     from ..ops.distances import cross_tile_live, tile_bounds
 

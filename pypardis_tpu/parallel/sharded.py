@@ -63,8 +63,9 @@ from ..ops.labels import (
     oc_propagate_banded,
 )
 from ..partition import spatial_order
-from ..utils import clamp_block, round_up
+from ..utils import clamp_block, faults, round_up
 from ..utils.budget import run_ladders
+from ..utils.retry import Retrier, is_degradable_error, note_degraded
 from . import staging
 from .halo import ring_halo_exchange_multi
 from .mesh import shard_map
@@ -422,7 +423,9 @@ def _host_build_cached(points, partitioner, eps, n_shards, block, sharding):
             "pad_waste": float(p_total * cap) / max(len(points), 1) - 1.0,
             "partition_sizes": _partition_sizes(owned_idx, p_total),
         }
-        arrays_o = tuple(jax.device_put(a, sharding) for a in arrays_o)
+        arrays_o = staging.transfer(lambda: tuple(
+            jax.device_put(a, sharding) for a in arrays_o
+        ))
         staging.device_put_cached("host_owned", base, arrays_o, aux=o_stats)
     else:
         arrays_o, o_stats = cached_o
@@ -432,7 +435,9 @@ def _host_build_cached(points, partitioner, eps, n_shards, block, sharding):
             int(o_stats["n_shard_partitions"]), block,
             alloc=_staged_alloc(bufs),
         )
-        arrays_h = tuple(jax.device_put(a, sharding) for a in arrays_h)
+        arrays_h = staging.transfer(lambda: tuple(
+            jax.device_put(a, sharding) for a in arrays_h
+        ))
         staging.device_put_cached(
             "host_halo", base + (float(eps),), arrays_h, aux=h_stats
         )
@@ -463,7 +468,9 @@ def _ring_build_cached(points, partitioner, eps, n_shards, block, sharding):
             "pad_waste": float(p_total * cap) / max(len(points), 1) - 1.0,
             "partition_sizes": _partition_sizes(owned_idx, p_total),
         }
-        arrays_o = tuple(jax.device_put(a, sharding) for a in arrays_o)
+        arrays_o = staging.transfer(lambda: tuple(
+            jax.device_put(a, sharding) for a in arrays_o
+        ))
         staging.device_put_cached("ring_owned", base, arrays_o, aux=o_stats)
     else:
         arrays_o, o_stats = cached
@@ -830,14 +837,14 @@ def _put_slab(a, dev):
     an explicit copy keeps cached slabs immutable everywhere else.
     """
     if jax.default_backend() == "tpu":
-        return jax.device_put(a, dev)
-    return jax.device_put(np.array(a), dev)
+        return staging.transfer(lambda: jax.device_put(a, dev))
+    return staging.transfer(lambda: jax.device_put(np.array(a), dev))
 
 
 def _chained_tables_overlap(
     points, partitioner, eps, *, center, part_idx, halo_idx,
     cap, hcap, p_total, block, min_samples, metric, precision, backend,
-    pair_budget, base_key, mesh,
+    pair_budget, base_key, mesh, jobstate=None,
 ):
     """Double-buffered per-partition build + chained execution.
 
@@ -934,11 +941,47 @@ def _chained_tables_overlap(
             )
         )
 
+    # Resume (utils.jobstate): partitions whose label tables a previous
+    # (killed) run already snapshotted replay from the file instead of
+    # re-dispatching — the tables were fetched post-probe, so they are
+    # the kernel's exact outputs and the merge consumes byte-identical
+    # inputs.  Snapshots are keyed by the effective pair budget: tables
+    # computed under a budget that later overflowed are never reused.
+    budget_tag = int(pair_budget or 0)
+    restored = (
+        jobstate.chained_restore(budget_tag) if jobstate is not None
+        else {}
+    )
+    if restored:
+        # Restored partitions skip the slab build, but the merge still
+        # needs their (deterministic) gid tables — replay them.
+        _replay_gids(part_idx, gid_o_host)
+        _replay_gids(halo_idx, gid_h_host)
+        obs_event("jobstate_restore", route="chained",
+                  partitions=len(restored))
+        dev = mesh.devices.reshape(-1)[0]
+
     def ensure(p):
-        if own_slabs is None and len(built_own) <= p:
-            _build(p, part_idx, cap, built_own, rot_own, gid_o_host)
-        if halo_slabs is None and len(built_halo) <= p:
-            _build(p, halo_idx, hcap, built_halo, rot_halo, gid_h_host)
+        # while-driven so the built lists stay index-aligned past
+        # restored partitions (a None placeholder keeps the slot; the
+        # gid column still ships for the merge programs).
+        while own_slabs is None and len(built_own) <= p:
+            q = len(built_own)
+            if q in restored:
+                built_own.append(
+                    (None, None, _put_slab(gid_o_host[q], dev))
+                )
+            else:
+                _build(q, part_idx, cap, built_own, rot_own, gid_o_host)
+        while halo_slabs is None and len(built_halo) <= p:
+            q = len(built_halo)
+            if q in restored:
+                built_halo.append(
+                    (None, None, _put_slab(gid_h_host[q], dev))
+                )
+            else:
+                _build(q, halo_idx, hcap, built_halo, rot_halo,
+                       gid_h_host)
 
     key = (
         "cluster", (p_total, cap, k), (p_total, hcap, k), float(eps),
@@ -946,47 +989,73 @@ def _chained_tables_overlap(
         pair_budget,
     )
     first = key not in _chained_compiled
-    ensure(0)
-    if first:
+    first_live = next(
+        (p for p in range(p_total) if p not in restored), None
+    )
+    if first_live is not None:
+        ensure(first_live)
+    if first and first_live is not None:
         obs_event("compile", stage="chained_cluster")
         # Idle-device barrier before the cluster program's first
         # compile (same discipline as _cluster_tables_1dev_chained).
-        np.asarray(built_own[0][2][:1])
+        np.asarray(built_own[first_live][2][:1])
 
     glabs, cores, pstats = [], [], []
     busy = 0.0
     idle_overlaps = 0
     t_loop = _time.perf_counter()
     for p in range(p_total):
+        ensure(p)
+        if p in restored:
+            glab_np, cor_np, ps_np = restored[p]
+            glabs.append(jnp.asarray(glab_np))
+            cores.append(jnp.asarray(cor_np))
+            pstats.append(jnp.asarray(ps_np))
+            obs_heartbeat("chained.partitions", p + 1, p_total, t_loop)
+            continue
         po, mo, go = built_own[p]
         ph, mh, hg = built_halo[p]
         t_disp = _time.perf_counter()
-        pts = jnp.concatenate([po, ph], axis=0)
-        msk = jnp.concatenate([mo, mh])
-        gid = jnp.concatenate([go, hg])
-        lab, cor, ps = dbscan_fixed_size(
-            pts, eps, min_samples, msk, metric=metric, block=block,
-            precision=precision, backend=backend, pair_budget=pair_budget,
-        )
-        glab = jnp.where(
-            lab >= 0,
-            jnp.take(gid, jnp.clip(lab, 0, None)),
-            -1,
-        ).astype(jnp.int32)
+
+        def one_partition():
+            # Injection site + unified retry: the dispatch consumes
+            # nothing (no donation), so a re-dispatch from the same
+            # slabs recomputes the identical tables.
+            faults.maybe_fail("chained.partition")
+            pts = jnp.concatenate([po, ph], axis=0)
+            msk = jnp.concatenate([mo, mh])
+            gid = jnp.concatenate([go, hg])
+            lab, cor, ps = dbscan_fixed_size(
+                pts, eps, min_samples, msk, metric=metric, block=block,
+                precision=precision, backend=backend,
+                pair_budget=pair_budget,
+            )
+            glab = jnp.where(
+                lab >= 0,
+                jnp.take(gid, jnp.clip(lab, 0, None)),
+                -1,
+            ).astype(jnp.int32)
+            # THE overlap: partition p+1's host build + transfer runs
+            # while the device executes partition p.
+            if p + 1 < p_total:
+                ensure(p + 1)
+            t_built = _time.perf_counter()
+            ready_early = bool(
+                getattr(glab, "is_ready", lambda: False)()
+            )
+            # Completion probe: the chained path's anti-queued-
+            # re-execution sync, now also the rotation barrier freeing
+            # slab p's buffers — and the sync that surfaces execution
+            # faults inside this retry scope.
+            np.asarray(glab[:1])
+            return glab, cor, ps, t_built, ready_early
+
+        glab, cor, ps, t_built, ready_early = Retrier(
+            "chained.partition"
+        ).run(one_partition)
         glabs.append(glab)
         cores.append(cor)
         pstats.append(ps)
-        # THE overlap: partition p+1's host build + transfer runs while
-        # the device executes partition p.
-        if p + 1 < p_total:
-            ensure(p + 1)
-        t_built = _time.perf_counter()
-        ready_early = bool(
-            getattr(glab, "is_ready", lambda: False)()
-        )
-        # Completion probe: the chained path's anti-queued-re-execution
-        # sync, now also the rotation barrier freeing slab p's buffers.
-        np.asarray(glab[:1])
         t_done = _time.perf_counter()
         # Device-busy upper bound: when the device finished inside the
         # host build window the busy interval is clipped to it.
@@ -997,15 +1066,22 @@ def _chained_tables_overlap(
         # file always, log lines via PYPARDIS_HEARTBEAT): a chained
         # 100M-point run is hours of this loop — it must not be silent.
         obs_heartbeat("chained.partitions", p + 1, p_total, t_loop)
+        if jobstate is not None and jobstate.due():
+            # Phase-boundary snapshot: the post-probe tables, fetched
+            # once — the cost of checkpointing, cadence-gated.
+            jobstate.chained_note(
+                p, np.asarray(glab), np.asarray(cor), np.asarray(ps),
+                budget_tag,
+            )
     wall = _time.perf_counter() - t_loop
     if first:
         _chained_compiled.add(key)
-    if own_slabs is None:
+    if own_slabs is None and not restored:
         staging.device_put_cached(
             "chained_owned", base_key,
             tuple(a for triple in built_own for a in triple),
         )
-    if halo_slabs is None:
+    if halo_slabs is None and not restored:
         staging.device_put_cached(
             "chained_halo", base_key + (float(eps),),
             tuple(a for triple in built_halo for a in triple),
@@ -1031,7 +1107,7 @@ def _chained_tables_overlap(
 def _sharded_dbscan_1dev_overlap(
     points, partitioner, *, eps, min_samples, metric, block, mesh, axis,
     n_points, precision, backend, merge, pair_budget, merge_rounds,
-    n_shards, base_key,
+    n_shards, base_key, jobstate=None,
 ):
     """Driver for the overlapped 1-device chained route: geometry +
     halo sets on host, then the double-buffered loop, then the same
@@ -1080,7 +1156,7 @@ def _sharded_dbscan_1dev_overlap(
                     cap=cap, hcap=hcap, p_total=p_total, block=block,
                     min_samples=min_samples, metric=metric,
                     precision=precision, backend=be, pair_budget=pb,
-                    base_key=base_key, mesh=mesh,
+                    base_key=base_key, mesh=mesh, jobstate=jobstate,
                 ),
                 backend,
             )
@@ -1689,6 +1765,9 @@ def _with_kernel_fallback(fn, backend):
             "Pallas kernel failed to lower on %s; falling back to the "
             "XLA kernel path (%s)", jax.default_backend(), e,
         )
+        # The Pallas→XLA fallback is the first graceful-degradation
+        # rung (label-safe: the XLA kernels are pinned byte-identical).
+        note_degraded("kernel_xla", error=str(e)[:160])
         return fn("xla")
 
 
@@ -1826,6 +1905,7 @@ def sharded_dbscan(
     owner_computes: bool = True,
     overlap: Optional[bool] = None,
     mode: str = "kd",
+    jobstate=None,
 ):
     """Cluster ``points`` over the device mesh.
 
@@ -1918,8 +1998,18 @@ def sharded_dbscan(
         # Both halo paths can spill the merge to the host (round-4
         # review, Next #6: the ring route used to pin merge='device',
         # so a 100M device-resident fit would replicate ~5 (N+1)-arrays
-        # per device in-graph).
-        merge = "host" if len(points) >= MERGE_HOST_AUTO else "device"
+        # per device in-graph).  Under host-RSS pressure
+        # (PYPARDIS_RSS_SOFT_LIMIT crossed — obs.resources) the
+        # host-spill rung is taken PREEMPTIVELY: the in-graph merge's
+        # replicated (N+1,) arrays are exactly the allocation a
+        # watermarked host should not gamble on.
+        from ..obs.resources import memory_pressure
+
+        merge = (
+            "host"
+            if len(points) >= MERGE_HOST_AUTO or memory_pressure()
+            else "device"
+        )
     if mesh is None:
         mesh = default_mesh()
     n_shards = mesh.devices.size
@@ -1937,6 +2027,24 @@ def sharded_dbscan(
             "stream=True requires halo='ring': the streaming build "
             "never materializes host halo slabs"
         )
+
+    def _spill_to_host_merge(e: BaseException):
+        # Graceful-degradation rung: a terminal OOM-class failure under
+        # merge='device' (its replicated (N+1,) arrays are the hungriest
+        # allocation of the fit) reruns with the compact host union-find
+        # spill.  Label-safe: both merges are pinned byte-identical.
+        note_degraded(
+            "merge_host", mode="kd", error=str(e)[:160]
+        )
+        return sharded_dbscan(
+            points, partitioner, eps, min_samples, metric=metric,
+            block=block, mesh=mesh, precision=precision, backend=backend,
+            halo=halo, hcap=hcap, merge="host", pair_budget=pair_budget,
+            merge_rounds=merge_rounds, stream=stream,
+            owner_computes=owner_computes, overlap=overlap,
+            jobstate=jobstate,
+        )
+
     sharding = NamedSharding(mesh, P(axis))
     staging.begin_fit()
     n, k = points.shape
@@ -1968,14 +2076,20 @@ def sharded_dbscan(
              oc_on),
         )
         with obs_span("sharded.execute", halo="ring", merge=merge):
-            out, pstats = _ring_ladder(
-                args, eps=eps, min_samples=min_samples, metric=metric,
-                block=block, mesh=mesh, axis=axis, n_points=n,
-                precision=precision, backend=backend, hcap=hcap,
-                pair_budget=pair_budget, merge_rounds=merge_rounds,
-                cap=int(stats["owned_cap"]), merge=merge,
-                owner_computes=oc_on,
-            )
+            try:
+                out, pstats = _ring_ladder(
+                    args, eps=eps, min_samples=min_samples, metric=metric,
+                    block=block, mesh=mesh, axis=axis, n_points=n,
+                    precision=precision, backend=backend, hcap=hcap,
+                    pair_budget=pair_budget, merge_rounds=merge_rounds,
+                    cap=int(stats["owned_cap"]), merge=merge,
+                    owner_computes=oc_on,
+                )
+            except Exception as e:  # noqa: BLE001 — rethrown below
+                if merge != "device" or not is_degradable_error(e):
+                    raise
+                staging.give_back(host_bufs)
+                return _spill_to_host_merge(e)
         if merge == "host":
             tables, _zero, used_hcap = out
             own_glab, own_core, halo_glab, halo_gid = tables
@@ -2016,14 +2130,19 @@ def sharded_dbscan(
             # live stacked-array cache (a previous non-overlapped fit)
             # falls through instead — its warm path has no host work
             # left to hide.
-            return _sharded_dbscan_1dev_overlap(
-                points, partitioner, eps=eps, min_samples=min_samples,
-                metric=metric, block=block, mesh=mesh, axis=axis,
-                n_points=n, precision=precision, backend=backend,
-                merge=merge, pair_budget=pair_budget,
-                merge_rounds=merge_rounds, n_shards=n_shards,
-                base_key=base_key,
-            )
+            try:
+                return _sharded_dbscan_1dev_overlap(
+                    points, partitioner, eps=eps, min_samples=min_samples,
+                    metric=metric, block=block, mesh=mesh, axis=axis,
+                    n_points=n, precision=precision, backend=backend,
+                    merge=merge, pair_budget=pair_budget,
+                    merge_rounds=merge_rounds, n_shards=n_shards,
+                    base_key=base_key, jobstate=jobstate,
+                )
+            except Exception as e:  # noqa: BLE001 — rethrown below
+                if merge != "device" or not is_degradable_error(e):
+                    raise
+                return _spill_to_host_merge(e)
     with obs_span("sharded.build_shards", halo="host"):
         arrays, stats, host_bufs = _host_build_cached(
             points, partitioner, eps, n_shards, block, sharding
@@ -2093,6 +2212,10 @@ def sharded_dbscan(
         return _canonicalize_roots(labels, core), core, stats
 
     def run_step(pb, mr):
+        # Injection site for the degradation-rung tests: an injected
+        # OOM here escapes run_ladders (which only handles capacity
+        # overflows) and lands in the merge-spill handler below.
+        faults.maybe_fail("sharded.execute")
         labels, core, pstats, m_rounds, converged = _with_kernel_fallback(
             lambda be: sharded_step(
                 *arrays,
@@ -2114,9 +2237,15 @@ def sharded_dbscan(
         return (labels, core, m_rounds), pstats, converged
 
     with obs_span("sharded.execute", halo="host", merge="device"):
-        (labels, core, m_rounds), pstats = run_ladders(
-            run_step, hint_key, pair_budget, merge_rounds
-        )
+        try:
+            (labels, core, m_rounds), pstats = run_ladders(
+                run_step, hint_key, pair_budget, merge_rounds
+            )
+        except Exception as e:  # noqa: BLE001 — rethrown below
+            if not is_degradable_error(e):
+                raise
+            staging.give_back(host_bufs)
+            return _spill_to_host_merge(e)
     stats = dict(
         stats, merge="device", merge_rounds=int(m_rounds),
         merge_converged=True,
@@ -2263,13 +2392,23 @@ def _ring_ladder(
             )
             hcap_attempts -= 1
             if hcap_attempts <= 0:
-                raise RuntimeError(
+                from ..utils.retry import note_giveup
+
+                err = RuntimeError(
                     f"ring halo buffer overflow at hcap={this_hcap}; "
                     f"pass a larger hcap"
                     if explicit
                     else f"ring halo buffer overflow persisted up to "
                     f"hcap={this_hcap}"
-                ) from None
+                )
+                note_giveup("ring.hcap", err)
+                raise err from None
+            from ..utils.retry import note_retry
+
+            note_retry(
+                "ring.hcap", 0.0,
+                RuntimeError(f"halo overflow at hcap={this_hcap}"),
+            )
             this_hcap *= 2
             continue
         return (*out, this_hcap), pstats

@@ -218,3 +218,45 @@ def device_put_cached(route: str, key, arrays: tuple, aux=None) -> tuple:
     _device_cache[route] = (key, arrays, dict(aux or {}), nbytes)
     flight_note("staging.device_put", route=route, nbytes=int(nbytes))
     return arrays
+
+
+def _evict_all_device(error) -> None:
+    """OOM recovery between transfer attempts: drop every cached device
+    slab so the retry has HBM headroom.  Arrays a driver already holds
+    stay alive through its own references; only the cache's retention
+    (the cross-fit reuse economy) is sacrificed."""
+    if _device_cache:
+        flight_note(
+            "staging.evict", route="*", reason="oom_recovery",
+            nbytes=sum(int(e[3]) for e in _device_cache.values()),
+        )
+        _device_cache.clear()
+
+
+def transfer(put_fn, *, site: str = "staging.device_put"):
+    """Run a host→device transfer under the unified retry layer.
+
+    ``put_fn`` is a zero-arg callable performing the actual
+    ``jax.device_put`` (or equivalent).  Transient tunnel faults retry
+    through the standard ladder; an OOM-classified failure first evicts
+    the device slab cache (:func:`_evict_all_device`) so the retry has
+    the HBM the cache was hoarding — the recovery action that makes a
+    transfer-time OOM survivable rather than terminal.  The
+    ``staging.device_put`` fault-injection site lives here, inside the
+    retry scope, so injected faults recover through exactly this
+    machinery.
+    """
+    from ..utils import faults
+    from ..utils.retry import Retrier, is_oom_error, is_transient_error
+
+    def attempt():
+        faults.maybe_fail(site)
+        return put_fn()
+
+    return Retrier(site, waits=(0.0, 10.0)).run(
+        attempt,
+        retryable=lambda e: is_transient_error(e) or is_oom_error(e),
+        on_retry=lambda e: (
+            _evict_all_device(e) if is_oom_error(e) else None
+        ),
+    )

@@ -29,24 +29,53 @@ from ..ops.query import _INT_INF, unpack_query_result
 from .index import CorePointIndex, build_index
 
 
+class QueueFull(RuntimeError):
+    """``submit`` backpressure: the bounded queue is at ``max_pending``.
+    Counted as a shed (``serving.shed_total``) — the Clipper-style
+    load-shedding signal a saturated serving tier must surface rather
+    than buffer unboundedly."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """A ticket's ``timeout_s`` elapsed before its result was usable.
+    The ticket is FAILED — a result delivered after its SLA is a miss,
+    and a stuck drain must fail tickets instead of hanging callers."""
+
+
 class QueryTicket:
-    """One submitted request; resolved by the next ``drain()``."""
+    """One submitted request; resolved (or failed) by the next
+    ``drain()``."""
 
-    __slots__ = ("n", "labels", "d2", "_t_submit", "latency_ms", "_q")
+    __slots__ = (
+        "n", "labels", "d2", "_t_submit", "latency_ms", "_q",
+        "deadline", "error",
+    )
 
-    def __init__(self, n: int, q: np.ndarray):
+    def __init__(self, n: int, q: np.ndarray,
+                 timeout_s: Optional[float] = None):
         self.n = int(n)
         self.labels: Optional[np.ndarray] = None
         self.d2: Optional[np.ndarray] = None
         self.latency_ms: Optional[float] = None
         self._t_submit = time.perf_counter()
         self._q = q
+        self.deadline = (
+            self._t_submit + float(timeout_s)
+            if timeout_s is not None else None
+        )
+        self.error: Optional[BaseException] = None
 
     @property
     def done(self) -> bool:
-        return self.labels is not None
+        return self.labels is not None or self.error is not None
+
+    @property
+    def failed(self) -> bool:
+        return self.error is not None
 
     def result(self, return_distance: bool = False):
+        if self.error is not None:
+            raise self.error
         if self.labels is None:
             raise RuntimeError(
                 "ticket not resolved yet; call QueryEngine.drain() first"
@@ -122,6 +151,11 @@ class QueryEngine:
         self._busy_s = 0.0
         self._fill_num = 0
         self._fill_den = 0
+        # Load-shedding / deadline telemetry (the Clipper-style
+        # production-serving counters): requests refused at a full
+        # queue, and tickets failed for a blown timeout_s.
+        self._shed = 0
+        self._deadline_failures = 0
 
     @classmethod
     def from_model(cls, model, *, leaves=None, block: int = 256,
@@ -163,17 +197,27 @@ class QueryEngine:
                 "model.query_engine() to get the rebuilt engine"
             )
 
-    def submit(self, X) -> QueryTicket:
+    def submit(self, X, timeout_s: Optional[float] = None) -> QueryTicket:
         """Enqueue a request (validated immediately; results after the
-        next :meth:`drain`)."""
+        next :meth:`drain`).
+
+        ``timeout_s`` sets the ticket's deadline: if the result is not
+        usable within it — queue wait included — the ticket FAILS with
+        :class:`DeadlineExceeded` instead of the caller waiting forever
+        on a stuck drain.  A full queue raises :class:`QueueFull`
+        (counted in ``serving_stats()["shed_total"]``) — backpressure,
+        never silent truncation.
+        """
         self._check_stale()
         q = self.index.prepare_queries(X)
         if self._pending_rows + len(q) > self.max_pending:
-            raise RuntimeError(
+            self._shed += 1
+            raise QueueFull(
                 f"query queue full ({self._pending_rows} rows pending, "
-                f"max_pending={self.max_pending}); drain() first"
+                f"max_pending={self.max_pending}); drain() first or "
+                f"shed load upstream"
             )
-        t = QueryTicket(len(q), q)
+        t = QueryTicket(len(q), q, timeout_s=timeout_s)
         self._pending.append(t)
         self._pending_rows += len(q)
         return t
@@ -196,11 +240,22 @@ class QueryEngine:
         """
         if not self._pending:
             return 0
+        from ..utils import faults
+
+        # Injection site: a serve.drain hang(Ns) fault stalls here —
+        # exactly the stuck-ticket scenario the deadline machinery must
+        # convert into failed tickets rather than a hung caller.
+        faults.maybe_fail("serve.drain")
         t0 = time.perf_counter()
         batches = []
         cur, rows = [], 0
         while self._pending:
             t = self._pending.popleft()
+            if t.deadline is not None and time.perf_counter() > t.deadline:
+                # Already past its SLA (queue wait, a stalled previous
+                # drain): fail now, never dispatch dead work.
+                self._fail_deadline(t)
+                continue
             if cur and rows + t.n > self.batch_capacity:
                 batches.append(cur)
                 cur, rows = [], 0
@@ -266,6 +321,12 @@ class QueryEngine:
         now = time.perf_counter()
         s = 0
         for t in fl.tickets:
+            if t.deadline is not None and now > t.deadline:
+                # The result exists but arrived past the ticket's SLA
+                # — a deadline miss is a failure, not a slow success.
+                self._fail_deadline(t)
+                s += t.n
+                continue
             t.labels = labels[s:s + t.n]
             t.d2 = d2[s:s + t.n]
             t.latency_ms = (now - t._t_submit) * 1e3
@@ -275,6 +336,18 @@ class QueryEngine:
         self._fill_num += int(round(fl.fill * fl.n_rows))
         self._fill_den += fl.n_rows
         return fl.n_rows
+
+    def _fail_deadline(self, t: QueryTicket) -> None:
+        waited_ms = (time.perf_counter() - t._t_submit) * 1e3
+        t.error = DeadlineExceeded(
+            f"query ticket missed its deadline: waited "
+            f"{waited_ms:.1f}ms against a "
+            f"{(t.deadline - t._t_submit) * 1e3:.1f}ms timeout "
+            f"(queue wait + drain stall included); the ticket is "
+            f"failed, resubmit if still wanted"
+        )
+        t._q = None
+        self._deadline_failures += 1
 
     def _publish(self) -> None:
         m = self.recorder.metrics
@@ -312,6 +385,10 @@ class QueryEngine:
             "staged_bytes_reused": int(st.get("staged_bytes_reused", 0)),
             "backend": str(self.backend),
             "precision": str(self.precision),
+            # Load-shedding / deadline counters (Clipper NSDI'17: the
+            # bounded-queue + SLA surface of a production serving tier).
+            "shed_total": int(self._shed),
+            "deadline_failures": int(self._deadline_failures),
             # Live-update generation of the underlying index (bumped by
             # every in-place serve_index_delta refresh).
             "index_epoch": int(getattr(self.index, "epoch", 0)),

@@ -42,6 +42,7 @@ def sustained_load(
     live=None,
     query_sampler: Optional[Callable] = None,
     seed: int = 0,
+    submit_timeout_s: Optional[float] = None,
 ) -> Dict:
     """Run the harness; returns the schema'd stats dict.
 
@@ -51,11 +52,20 @@ def sustained_load(
     when > 0); the rest submit ``batch_rows``-row query batches.
     ``query_sampler(rng, n) -> (n, k)`` supplies query coordinates
     (default: uniform over the index's core bounding box ± eps).
+
+    Fault mode: ``submit_timeout_s`` attaches a per-ticket deadline, a
+    full queue is counted as a shed (the client backs off — never
+    aborts the harness), and deadline-failed tickets are counted
+    rather than crashed on — so the harness runs clean under an
+    injected ``serve.drain`` hang (``PYPARDIS_FAULTS``) and reports
+    how the serving tier degraded (``shed`` / ``deadline_failures``
+    in the stats row).
     """
     if write_fraction > 0 and live is None:
         raise ValueError(
             "write_fraction > 0 needs a LiveModel (live=...)"
         )
+    from .engine import QueueFull
     index = engine.index
     if query_sampler is None:
         sel = np.asarray(index.labels) != np.iinfo(np.int32).max
@@ -80,6 +90,7 @@ def sustained_load(
     t_start = time.perf_counter()
     deadline = t_start + float(duration_s)
     n_writes = [0]
+    n_shed = [0]
 
     def client(cid: int) -> None:
         rng = np.random.default_rng(seed * 1000 + cid)
@@ -103,7 +114,17 @@ def sustained_load(
                 else:
                     q = np.asarray(query_sampler(rng, batch_rows))
                     with lock:
-                        tickets.append(engine.submit(q))
+                        tickets.append(
+                            engine.submit(
+                                q, timeout_s=submit_timeout_s
+                            )
+                        )
+            except QueueFull:
+                # Shed load: the bounded queue refused this request —
+                # the open-loop client drops it and keeps its arrival
+                # process going (the production behavior the counter
+                # measures), never aborts the harness.
+                n_shed[0] += 1
             except Exception as e:  # noqa: BLE001 — harness must drain
                 errors.append(e)
                 stop.set()
@@ -144,7 +165,8 @@ def sustained_load(
         [t.latency_ms for t in tickets if t.latency_ms is not None],
         np.float64,
     )
-    queries = int(sum(t.n for t in tickets if t.done))
+    queries = int(sum(t.n for t in tickets if t.done and not t.failed))
+    failed = int(sum(1 for t in tickets if t.failed))
     vis = np.asarray(visible_ms, np.float64)
 
     def _pct(a, q):
@@ -167,4 +189,12 @@ def sustained_load(
         "update_visible_p50_ms": _pct(vis, 50),
         "update_visible_p99_ms": _pct(vis, 99),
         "index_epoch": stats.get("index_epoch", 0),
+        # Fault-mode telemetry: queue-full refusals seen by the open-
+        # loop clients, and tickets that missed their deadline (both 0
+        # on a clean run with no timeout).
+        "shed": int(n_shed[0]),
+        "deadline_failures": failed,
+        "submit_timeout_s": (
+            float(submit_timeout_s) if submit_timeout_s else 0.0
+        ),
     }

@@ -88,9 +88,18 @@ def run_ladders(run_step, hint_key, pair_budget, merge_rounds):
     plus its pair stats, so drivers can surface live-pair volume and
     kernel passes (the achieved-FLOP/s model) without a second fetch.
     """
+    import os
+
     from .log import get_logger
 
     this_pair = pair_budget
+    if this_pair is None:
+        # Operator knob: a known-dense deployment can pin the budget
+        # process-wide and skip the overflow-rerun (and its recompile)
+        # on every cold fit.
+        env = os.environ.get("PYPARDIS_PAIR_BUDGET")
+        if env:
+            this_pair = int(env)
     pair_attempts = 2  # exact-total retry: one is always enough
     this_rounds = merge_rounds
     rounds_attempts = 2
@@ -105,21 +114,45 @@ def run_ladders(run_step, hint_key, pair_budget, merge_rounds):
         if retry_pair:
             pair_attempts -= 1
             if pair_attempts <= 0:
-                raise RuntimeError(
+                from .retry import note_giveup
+
+                err = RuntimeError(
                     f"live tile-pair budget overflow persisted after an "
-                    f"exact-total retry ({retry_pair})"
+                    f"exact-total retry: the kernels need at least "
+                    f"{retry_pair} live tile pairs; pass "
+                    f"pair_budget={retry_pair} (or set "
+                    f"PYPARDIS_PAIR_BUDGET={retry_pair}) — labels from "
+                    f"a truncated pair list would be silently wrong, "
+                    f"so this never returns"
                 )
+                note_giveup("pair_budget", err)
+                raise err
+            from .retry import note_retry
+
+            note_retry(
+                "pair_budget", 0.0,
+                RuntimeError(f"pair budget overflow, need {retry_pair}"),
+            )
             this_pair = retry_pair
             overflowed = True
             continue
         if not bool(np.asarray(converged)):
             rounds_attempts -= 1
             if rounds_attempts <= 0:
-                raise unconverged_error(this_rounds)
+                from .retry import note_giveup
+
+                err = unconverged_error(this_rounds)
+                note_giveup("merge_rounds", err)
+                raise err
             nxt = max(1, 4 * this_rounds)
             from ..obs import event as obs_event
+            from .retry import note_retry
 
             obs_event("merge_unconverged", rounds=this_rounds, next=nxt)
+            note_retry(
+                "merge_rounds", 0.0,
+                RuntimeError(f"unconverged at {this_rounds} rounds"),
+            )
             get_logger().warning(
                 "label merge unconverged after %d rounds; retrying with "
                 "%d", this_rounds, nxt,

@@ -1,0 +1,248 @@
+"""Deterministic fault injection for fault-tolerance testing.
+
+The north-star run is an hours-long multi-phase job; proving it
+survives a mid-flight failure requires *producing* one on demand, at a
+named point in the pipeline, on a reproducible occurrence — not waiting
+for the tunnel to hiccup.  This module is that switchboard: a
+:class:`FaultPlan` (parsed from the ``PYPARDIS_FAULTS`` env var or
+installed programmatically) maps **injection sites** — stable names
+threaded through the hot paths — to counted-occurrence fault kinds.
+
+Spec grammar (comma-separated entries)::
+
+    site[:occurrence]=kind[(arg)]
+
+    gm.ring_round:2=transfer_error     # 2nd arrival at the site fails
+    stepped.batch:5=oom                # 5th round batch raises an OOM
+    serve.drain:1=hang(3s)             # 1st drain stalls 3 seconds
+    chained.partition:*=hang(0.2)      # EVERY partition stalls 0.2s
+
+Occurrences are 1-based arrival counts per site (``*`` = every
+arrival), so a test or probe replays the identical failure every run.
+
+Fault kinds:
+
+* ``transfer_error`` — raises a :class:`FaultInjected` whose message
+  carries ``UNAVAILABLE`` (the axon tunnel's transient-fault signature),
+  so the unified retry layer (:mod:`pypardis_tpu.utils.retry`)
+  classifies and retries it exactly like the real thing;
+* ``oom`` — raises with ``RESOURCE_EXHAUSTED ... Out of memory``:
+  retryable where a recovery action exists (the staging layer evicts
+  its device cache first), degradable otherwise (merge host-spill,
+  global-Morton → KD mode fallback);
+* ``error`` — a terminal, non-retryable failure (exercises giveup
+  paths and the jobstate kill window without a subprocess);
+* ``hang(Ns)`` — sleeps N seconds and returns (a stuck ticket /
+  watchdog stall; the serving deadline machinery must fail the ticket
+  rather than wait forever — and probes use it to widen kill windows
+  deterministically).
+
+Injection sites (each a ``maybe_fail`` call placed INSIDE the retry
+scope that owns recovery, so an injected transient recovers through the
+very machinery a real fault would exercise):
+
+===================== ====================================================
+``staging.device_put`` host→device slab transfers (:func:`pypardis_tpu.
+                       parallel.staging.transfer`)
+``pipeline.cluster``   fused single-shard kernel dispatch
+``stepped.batch``      host-stepped propagation round batches
+``chained.partition``  1-device chained per-partition dispatches
+``sharded.execute``    KD sharded execute step (degradation rung tests)
+``gm.exchange``        global-Morton boundary-tile exchange
+``gm.ring_round``      each boundary-tile ppermute ring round
+``gm.fixpoint_round``  each cross-device pmin fixpoint round
+``serve.drain``        :meth:`QueryEngine.drain`
+===================== ====================================================
+
+Zero-cost when unset: ``maybe_fail`` is one module-global ``is None``
+check — no parsing, no counters, nothing observable on a clean run
+(``report()["faults"]["injected"] == 0`` is schema-enforced on bench
+rows).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import re
+import time
+from typing import Dict, List, Optional, Tuple
+
+_KINDS = ("transfer_error", "oom", "error", "hang")
+
+_ENTRY_RE = re.compile(
+    r"^(?P<site>[a-z0-9_.]+?)(?::(?P<occ>\*|\d+))?="
+    r"(?P<kind>[a-z_]+)(?:\((?P<arg>[^)]*)\))?$"
+)
+
+
+class FaultInjected(RuntimeError):
+    """An injected fault (never raised on a clean run).
+
+    The message embeds the runtime error-class signature the kind
+    imitates, so the production retry/degradation classifiers treat it
+    exactly like the real failure.
+    """
+
+    def __init__(self, site: str, kind: str, message: str):
+        super().__init__(message)
+        self.site = site
+        self.kind = kind
+
+
+class FaultPlan:
+    """Parsed injection plan with per-site arrival counters."""
+
+    def __init__(self, entries: Dict[str, List[Tuple[object, str, float]]],
+                 spec: str):
+        # site -> [(occurrence | "*", kind, arg), ...]
+        self.entries = entries
+        self.spec = spec
+        self._arrivals: Dict[str, int] = {}
+        self.injected: Dict[str, int] = {}
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        entries: Dict[str, List[Tuple[object, str, float]]] = {}
+        for raw in str(spec).split(","):
+            raw = raw.strip()
+            if not raw:
+                continue
+            m = _ENTRY_RE.match(raw)
+            if not m:
+                raise ValueError(
+                    f"bad PYPARDIS_FAULTS entry {raw!r}; expected "
+                    f"site[:occurrence]=kind[(arg)], e.g. "
+                    f"gm.ring_round:2=transfer_error or "
+                    f"serve.drain:1=hang(3s)"
+                )
+            kind = m.group("kind")
+            if kind not in _KINDS:
+                raise ValueError(
+                    f"unknown fault kind {kind!r} in {raw!r}; one of "
+                    f"{'|'.join(_KINDS)}"
+                )
+            occ: object = m.group("occ") or "1"
+            if occ != "*":
+                occ = int(occ)
+                if occ < 1:
+                    raise ValueError(
+                        f"occurrence must be >= 1 or '*' in {raw!r}"
+                    )
+            arg = 0.0
+            if m.group("arg"):
+                arg = float(m.group("arg").rstrip("s"))
+            entries.setdefault(m.group("site"), []).append(
+                (occ, kind, arg)
+            )
+        return cls(entries, str(spec))
+
+    def check(self, site: str) -> None:
+        rules = self.entries.get(site)
+        if rules is None:
+            return
+        n = self._arrivals.get(site, 0) + 1
+        self._arrivals[site] = n
+        for occ, kind, arg in rules:
+            if occ == "*" or occ == n:
+                self._fire(site, kind, arg, n)
+
+    def _fire(self, site: str, kind: str, arg: float, occurrence: int
+              ) -> None:
+        self.injected[site] = self.injected.get(site, 0) + 1
+        # Telemetry before the raise: the fit's recorder counts every
+        # injection (report()["faults"]["injected"]); the event names
+        # the site so a flight replay shows exactly where it landed.
+        try:
+            from ..obs import current, event
+
+            current().metrics.inc("faults.injected")
+            # NB: the event() helper's positional is named ``kind`` —
+            # the injected fault's kind rides as ``fault_kind``.
+            event("fault_injected", site=site, fault_kind=kind,
+                  occurrence=occurrence)
+        except Exception:  # noqa: BLE001 — injection must not need obs
+            pass
+        from .log import get_logger
+
+        get_logger().warning(
+            "fault injection: %s at %s (occurrence %d)",
+            kind, site, occurrence,
+        )
+        if kind == "hang":
+            time.sleep(max(arg, 0.0))
+            return
+        if kind == "transfer_error":
+            raise FaultInjected(
+                site, kind,
+                f"UNAVAILABLE: injected transfer_error at {site} "
+                f"(PYPARDIS_FAULTS occurrence {occurrence})",
+            )
+        if kind == "oom":
+            raise FaultInjected(
+                site, kind,
+                f"RESOURCE_EXHAUSTED: injected oom at {site}: Out of "
+                f"memory (PYPARDIS_FAULTS occurrence {occurrence})",
+            )
+        raise FaultInjected(
+            site, kind,
+            f"injected terminal error at {site} "
+            f"(PYPARDIS_FAULTS occurrence {occurrence})",
+        )
+
+
+# The active plan.  None on clean runs — maybe_fail's entire cost is
+# this one check.
+_PLAN: Optional[FaultPlan] = None
+
+
+def _init_from_env() -> None:
+    global _PLAN
+    spec = os.environ.get("PYPARDIS_FAULTS")
+    if spec:
+        _PLAN = FaultPlan.parse(spec)
+
+
+_init_from_env()
+
+
+def install(spec: Optional[str]) -> Optional[FaultPlan]:
+    """Install a plan programmatically (None clears); returns it.
+    Arrival counters start fresh — reinstalling the same spec replays
+    the same injections."""
+    global _PLAN
+    _PLAN = FaultPlan.parse(spec) if spec else None
+    return _PLAN
+
+
+def clear() -> None:
+    install(None)
+
+
+def active() -> Optional[FaultPlan]:
+    return _PLAN
+
+
+@contextlib.contextmanager
+def plan(spec: str):
+    """Scoped plan for tests: installed on entry, previous plan (almost
+    always None) restored on exit."""
+    global _PLAN
+    prev = _PLAN
+    _PLAN = FaultPlan.parse(spec)
+    try:
+        yield _PLAN
+    finally:
+        _PLAN = prev
+
+
+def maybe_fail(site: str) -> None:
+    """The injection hook: a no-op unless a plan names this site."""
+    if _PLAN is None:
+        return
+    _PLAN.check(site)
+
+
+def fault_stats() -> Dict[str, int]:
+    """{site -> injections fired} for the active plan ({} when none)."""
+    return dict(_PLAN.injected) if _PLAN is not None else {}

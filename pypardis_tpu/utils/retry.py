@@ -1,0 +1,208 @@
+"""Unified retry/backoff layer.
+
+Before this module every recovery path rolled its own loop: the fused
+pipeline's 0/10/75s transient ladder (``ops.pipeline._transient_retry``),
+the pair-budget/merge-rounds ladder (``utils.budget.run_ladders``), the
+ring ``hcap`` doubling and the global-Morton ``btcap`` ladder — four
+spellings of "try again, observably".  This module is the one engine
+they all report through:
+
+* :class:`Retrier` — attempts, an explicit wait ladder OR exponential
+  backoff with jitter, an optional wall-clock deadline, and per-site
+  obs counters ``retry.<site>.attempts`` / ``retry.<site>.giveups``
+  (summed into ``report()["faults"]["retried"/"giveups"]``).  Used
+  directly by the transient-fault scopes: fused/stepped kernel
+  dispatch, the chained partition loop, the global-Morton ring and
+  fixpoint rounds, and staging ``device_put``s
+  (:func:`pypardis_tpu.parallel.staging.transfer`).
+
+* :func:`note_retry` / :func:`note_giveup` — the same counters for the
+  capacity ladders (pair budget, hcap, btcap, merge rounds) whose
+  *control flow* must stay ladder-shaped (each retry changes a
+  capacity, not just waits) but whose telemetry must be uniform.
+
+* :func:`note_degraded` — records a graceful-degradation rung
+  (``Pallas→XLA`` kernel fallback, ``merge='device'``→``'host'``
+  spill, ``global_morton``→KD owner-computes mode fallback): one
+  ``degraded`` event + the ``faults.degraded_to`` gauge.  Every rung is
+  label-safe — each fallback mode is pinned byte-identical to the mode
+  it replaces.
+
+Error classification helpers (:func:`is_transient_error`,
+:func:`is_oom_error`, :func:`is_degradable_error`) are shared with the
+fault-injection kinds (:mod:`pypardis_tpu.utils.faults`), so injected
+faults exercise exactly the production classification.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import time
+from typing import Callable, Optional, Sequence
+
+# The historical transient ladder (ops.pipeline round-3): immediate
+# retry, then two backed-off ones — a crashed tunnel worker needs tens
+# of seconds to restart.
+DEFAULT_WAITS = (0.0, 10.0, 75.0)
+
+
+def is_transient_error(e: BaseException) -> bool:
+    """Axon-runtime transient signatures (same set _transient_retry has
+    classified since round 3) — the identical call succeeds moments
+    later."""
+    msg = f"{type(e).__name__}: {e}"
+    return any(
+        s in msg
+        for s in ("UNAVAILABLE", "INTERNAL", "INVALID_ARGUMENT",
+                  "InvalidArgument")
+    )
+
+
+def is_oom_error(e: BaseException) -> bool:
+    """Out-of-memory signatures (XLA RESOURCE_EXHAUSTED, allocator
+    messages, injected ``oom`` faults)."""
+    msg = f"{type(e).__name__}: {e}".lower()
+    return "resource_exhausted" in msg or "out of memory" in msg \
+        or "oom" in msg.split(":")[0]
+
+
+def is_degradable_error(e: BaseException) -> bool:
+    """Whether a terminal failure justifies dropping a degradation rung
+    (host-spill merge, mode fallback): OOM-class only — a persistent
+    transient means the runtime is down, and a ValueError means the
+    caller's inputs are wrong; neither is cured by a cheaper mode."""
+    return is_oom_error(e)
+
+
+def _key(site: str, leaf: str) -> str:
+    from ..obs.registry import sanitize_segment
+
+    return "retry." + ".".join(
+        sanitize_segment(s) for s in str(site).split(".")
+    ) + f".{leaf}"
+
+
+def note_retry(site: str, wait_s: float, error: BaseException) -> None:
+    """One retry, observably: the ``retry.<site>`` event (the report's
+    ``transient_retry`` family), the ``retry.<site>.attempts`` counter,
+    and a warning line."""
+    from ..obs import current, event
+    from ..obs.registry import sanitize_segment
+    from .log import get_logger
+
+    event(
+        "retry." + ".".join(
+            sanitize_segment(s) for s in str(site).split(".")
+        ),
+        wait_s=round(float(wait_s), 3), error=str(error)[:160],
+    )
+    current().metrics.inc(_key(site, "attempts"))
+    get_logger().warning(
+        "retryable fault in %s; retrying in %.1fs: %s",
+        site, wait_s, str(error)[:160],
+    )
+
+
+def note_giveup(site: str, error: BaseException) -> None:
+    from ..obs import current, event
+
+    event("retry_giveup", site=str(site), error=str(error)[:160])
+    current().metrics.inc(_key(site, "giveups"))
+
+
+def note_degraded(rung: str, **fields) -> None:
+    """Record one graceful-degradation rung (kernel_xla / merge_host /
+    kd_owner_computes / ...)."""
+    from ..obs import current, event
+    from .log import get_logger
+
+    event("degraded", rung=str(rung), **fields)
+    m = current().metrics
+    m.inc("faults.degraded")
+    m.set("faults.degraded_to", str(rung))
+    get_logger().warning("degrading to %s after terminal failure", rung)
+
+
+class Retrier:
+    """Retry a callable through transient faults, observably.
+
+    ``waits`` is an explicit ladder of sleeps between attempts (its
+    length caps the retries, matching the historical 0/10/75 ladder);
+    otherwise ``attempts``/``base_s``/``factor``/``max_wait_s`` define
+    an exponential schedule.  Nonzero waits get up to ``jitter``
+    fractional randomization (herd-avoidance on multi-process meshes;
+    determinism of the retried *computation* never depends on timing).
+    ``deadline_s`` (or ``PYPARDIS_RETRY_DEADLINE_S``) bounds the total
+    wall clock spent inside :meth:`run` — a retry whose sleep would
+    cross it gives up immediately instead of overshooting.
+    """
+
+    def __init__(
+        self,
+        site: str,
+        *,
+        waits: Optional[Sequence[float]] = None,
+        attempts: int = 3,
+        base_s: float = 0.5,
+        factor: float = 6.0,
+        max_wait_s: float = 75.0,
+        jitter: float = 0.25,
+        deadline_s: Optional[float] = None,
+    ):
+        self.site = str(site)
+        if waits is not None:
+            self.waits = tuple(float(w) for w in waits)
+        else:
+            self.waits = tuple(
+                min(base_s * factor ** i, max_wait_s)
+                for i in range(max(int(attempts) - 1, 0))
+            )
+        self.jitter = float(jitter)
+        if deadline_s is None:
+            env = os.environ.get("PYPARDIS_RETRY_DEADLINE_S")
+            deadline_s = float(env) if env else None
+        self.deadline_s = deadline_s
+
+    def run(
+        self,
+        fn: Callable,
+        *,
+        retryable: Optional[Callable[[BaseException], bool]] = None,
+        on_retry: Optional[Callable[[BaseException], None]] = None,
+    ):
+        """Call ``fn()`` with up to ``len(waits)`` retries.
+
+        ``retryable`` classifies which exceptions are worth a retry
+        (default: :func:`is_transient_error`); everything else
+        re-raises immediately.  ``on_retry(error)`` runs before each
+        retry — the hook for recovery actions (the staging layer evicts
+        its device cache there, so a retried OOM has HBM headroom).
+        """
+        if retryable is None:
+            retryable = is_transient_error
+        t0 = time.perf_counter()
+        last: Optional[BaseException] = None
+        for i in range(len(self.waits) + 1):
+            if i > 0:
+                wait = self.waits[i - 1]
+                if wait > 0 and self.jitter > 0:
+                    wait *= 1.0 + self.jitter * random.random()
+                if (
+                    self.deadline_s is not None
+                    and time.perf_counter() - t0 + wait > self.deadline_s
+                ):
+                    break
+                note_retry(self.site, wait, last)
+                if on_retry is not None:
+                    on_retry(last)
+                if wait > 0:
+                    time.sleep(wait)
+            try:
+                return fn()
+            except Exception as e:  # noqa: BLE001 — re-raised below
+                if not retryable(e):
+                    raise
+                last = e
+        note_giveup(self.site, last)
+        raise last

@@ -132,9 +132,36 @@ def main() -> None:
                 "staging_pool_bytes"):
         number("resources", key)
     for key in ("restage", "pair_overflow", "halo_overflow",
-                "merge_unconverged", "compile"):
+                "merge_unconverged", "compile", "fault_injected",
+                "degraded"):
         if key not in tel["events"]:
             fail(f"telemetry.events missing {key!r}")
+    # Fault-tolerance contract (ISSUE 9): every row carries the faults
+    # block — injection volume, unified-retry attempts/giveups, and the
+    # degradation rung taken.  Clean rows (anything not emitted by the
+    # fault probe itself) must show ZERO injections: the injection
+    # sites compile to no-ops when PYPARDIS_FAULTS is unset, and a
+    # nonzero count on a bench row means a plan leaked into CI.
+    fa = tel.get("faults")
+    if not isinstance(fa, dict):
+        fail("missing/invalid 'faults' block")
+    for key in ("injected", "retried", "giveups", "degraded"):
+        v = fa.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            fail(
+                f"telemetry.faults.{key} is {v!r}, expected a "
+                f"non-negative int"
+            )
+    if not isinstance(fa.get("degraded_to"), str):
+        fail(
+            f"telemetry.faults.degraded_to is "
+            f"{fa.get('degraded_to')!r}, expected a string"
+        )
+    if not str(row["metric"]).startswith("fault") and fa["injected"]:
+        fail(
+            f"clean row has telemetry.faults.injected == "
+            f"{fa['injected']} (PYPARDIS_FAULTS leaked into this run?)"
+        )
     if not tel["phases"]:
         fail("telemetry.phases is empty")
     if "points" not in tel["devices"]:
@@ -194,7 +221,8 @@ def main() -> None:
             fail(f"telemetry.serving is {type(serving).__name__}")
         for key in ("qps", "p50_ms", "p99_ms", "batch_fill"):
             number("serving", key)
-        for key in ("queries", "batches", "n_core", "n_leaves"):
+        for key in ("queries", "batches", "n_core", "n_leaves",
+                    "shed_total", "deadline_failures"):
             v = serving.get(key)
             if not isinstance(v, int) or v < 0:
                 fail(

@@ -1,0 +1,256 @@
+#!/usr/bin/env python
+"""Fault-tolerance probe (``make fault-probe``, wired into bench-smoke).
+
+Proves the ISSUE-9 acceptance criteria end to end on the faked 8-device
+CPU mesh:
+
+1. **mid-fixpoint shard failure** — ``gm.fixpoint_round:1=
+   transfer_error`` injected into a global-Morton fit recovers through
+   the unified retry layer with labels BYTE-IDENTICAL to the clean run;
+2. **staging OOM** — ``staging.device_put:1=oom`` injected into the KD
+   owner-computes fit recovers via the evict-and-retry rung, labels
+   byte-identical (and byte-identical across the two modes, the pinned
+   parity contract);
+3. **serving hang** — a ``serve.drain`` hang against a submit deadline
+   fails the ticket with ``DeadlineExceeded`` within bounded time
+   instead of hanging, and the engine serves cleanly afterwards;
+4. **kill/resume parity** — a child process fit (global-Morton, with a
+   per-round hang widening the kill window and ``PYPARDIS_CKPT``
+   snapshots) is SIGKILLed mid-fixpoint; ``DBSCAN.train(resume=)`` in a
+   fresh process replays the snapshot and produces labels
+   byte-identical to the uninterrupted fit.
+
+Emits ONE bench-style JSON row (``metric="fault_probe_scenarios"``)
+whose telemetry block is the FAULTY global-Morton fit's report — so the
+``faults`` block carries real injected/retried counts, which
+``scripts/check_bench_json.py`` permits only on ``fault*`` rows (clean
+rows must be all-zero).
+
+Geometry via env: FAULT_N (default 3000).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+_N_DEV = int(os.environ.get("PYPARDIS_PROBE_DEVICES", "8"))
+
+
+def _force_cpu_mesh() -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={_N_DEV}"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if "jax_num_cpu_devices" in jax.config._value_holders:
+        jax.config.update("jax_num_cpu_devices", _N_DEV)
+
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+KW = dict(eps=0.45, min_samples=5, block=64)
+
+
+def chain_data(n: int):
+    """The multi-round fixpoint geometry: one cluster threading every
+    Morton shard, so the pmin merge needs several rounds (a wide,
+    deterministic window for injections and kills)."""
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    X = np.stack(
+        [np.arange(n) * 0.1, rng.normal(0, 0.05, n)], axis=1
+    )
+    return X.astype(np.float32)
+
+
+def child_fit(out_path: str, ckpt: str, resume: bool) -> None:
+    _force_cpu_mesh()
+    import numpy as np
+
+    from pypardis_tpu import DBSCAN
+
+    n = int(os.environ.get("FAULT_N", 3000))
+    X = chain_data(n)
+    model = DBSCAN(mode="global_morton", merge="device", **KW)
+    model.train(X, resume=ckpt)
+    np.savez(
+        out_path,
+        labels=model.labels_,
+        core=model.core_sample_mask_,
+        restored_rounds=np.int64(
+            model._jobstate.restored_rounds if model._jobstate else 0
+        ),
+    )
+
+
+def check(msg: str, ok: bool) -> int:
+    print(f"fault-probe: {msg}: {'ok' if ok else 'FAILED'}",
+          file=sys.stderr)
+    if not ok:
+        sys.exit(1)
+    return 1
+
+
+def _run_child(env_extra, out, ckpt, resume=False):
+    env = dict(os.environ)
+    env.update(env_extra)
+    args = [sys.executable, os.path.abspath(__file__), "--child", out,
+            ckpt]
+    if resume:
+        args.append("--resume")
+    return subprocess.Popen(args, env=env)
+
+
+def main() -> None:
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        child_fit(sys.argv[2], sys.argv[3], "--resume" in sys.argv)
+        return
+
+    _force_cpu_mesh()
+    import tempfile
+
+    import numpy as np
+
+    from pypardis_tpu import DBSCAN
+    from pypardis_tpu.parallel import staging
+    from pypardis_tpu.utils import faults
+
+    n = int(os.environ.get("FAULT_N", 3000))
+    X = chain_data(n)
+    passed = 0
+
+    # -- clean baselines ---------------------------------------------------
+    clean_gm = DBSCAN(mode="global_morton", merge="device", **KW)
+    clean_gm.fit(X)
+    base_labels = np.asarray(clean_gm.labels_)
+    assert clean_gm.report()["faults"]["injected"] == 0
+
+    # -- 1: mid-fixpoint shard failure ------------------------------------
+    staging.clear()
+    with faults.plan("gm.fixpoint_round:1=transfer_error"):
+        faulty = DBSCAN(mode="global_morton", merge="device", **KW)
+        faulty.fit(X)
+    rep = faulty.report()
+    passed += check(
+        "injected fixpoint transfer_error recovered byte-identically "
+        f"(injected={rep['faults']['injected']}, "
+        f"retried={rep['faults']['retried']})",
+        np.array_equal(faulty.labels_, base_labels)
+        and rep["faults"]["injected"] >= 1
+        and rep["faults"]["retried"] >= 1,
+    )
+
+    # -- 2: staging OOM on the KD owner-computes route ---------------------
+    staging.clear()
+    with faults.plan("staging.device_put:1=oom"):
+        kd = DBSCAN(max_partitions=8, **KW)
+        kd.fit(X)
+    kd_rep = kd.report()
+    passed += check(
+        "injected staging OOM recovered via evict-and-retry, labels "
+        "byte-identical across modes",
+        np.array_equal(kd.labels_, base_labels)
+        and kd_rep["faults"]["injected"] >= 1,
+    )
+
+    # -- 3: serving hang vs deadline --------------------------------------
+    from pypardis_tpu.serve.engine import DeadlineExceeded
+
+    eng = clean_gm.query_engine()
+    t0 = time.perf_counter()
+    with faults.plan("serve.drain:1=hang(0.3)"):
+        ticket = eng.submit(X[:16], timeout_s=0.05)
+        eng.drain()
+    waited = time.perf_counter() - t0
+    failed_right = False
+    try:
+        ticket.result()
+    except DeadlineExceeded:
+        failed_right = True
+    clean_labels = eng.predict(X[:16])
+    passed += check(
+        f"stuck drain failed the ticket within bounds ({waited:.2f}s) "
+        "and the engine serves cleanly after",
+        failed_right and waited < 5.0
+        and eng.serving_stats()["deadline_failures"] == 1
+        and clean_labels.shape == (16,),
+    )
+
+    # -- 4: kill/resume parity --------------------------------------------
+    tmp = tempfile.mkdtemp(prefix="fault_probe_")
+    ckpt = os.path.join(tmp, "fit.ckpt.npz")
+    out = os.path.join(tmp, "resumed.npz")
+    killed = False
+    deadline = time.time() + float(os.environ.get(
+        "FAULT_TIMEOUT_S", 300
+    ))
+    for attempt in range(4):
+        if os.path.exists(ckpt):
+            os.unlink(ckpt)
+        hang = 0.4 * (attempt + 1)
+        proc = _run_child(
+            {
+                "PYPARDIS_FAULTS":
+                    f"gm.fixpoint_round:*=hang({hang})",
+                "PYPARDIS_CKPT_EVERY_S": "0",
+            },
+            out, ckpt,
+        )
+        try:
+            while time.time() < deadline:
+                if proc.poll() is not None:
+                    break  # finished before we could kill — retry
+                if os.path.exists(ckpt):
+                    time.sleep(hang * 0.5)  # land INSIDE a later round
+                    break
+                time.sleep(0.02)
+        finally:
+            alive = proc.poll() is None
+            proc.send_signal(signal.SIGKILL)
+            proc.wait()
+        if alive and os.path.exists(ckpt):
+            killed = True
+            break
+        print(
+            f"fault-probe: attempt {attempt}: kill landed too late "
+            f"(alive={alive}); widening the hang", file=sys.stderr,
+        )
+    check("SIGKILL landed mid-fixpoint with a snapshot on disk", killed)
+    rc = _run_child({}, out, ckpt, resume=True).wait()
+    check("resumed child fit completed", rc == 0)
+    with np.load(out) as z:
+        resumed = z["labels"]
+        restored = int(z["restored_rounds"])
+    passed += check(
+        f"kill/resume parity: resumed labels byte-identical "
+        f"(restored_rounds={restored})",
+        np.array_equal(resumed, base_labels) and restored >= 1,
+    )
+
+    row = {
+        "metric": "fault_probe_scenarios",
+        "value": passed,
+        "unit": "scenarios",
+        "n": n,
+        "mesh_devices": _N_DEV,
+        "kill_resume": {
+            "restored_rounds": restored,
+            "labels_match": True,
+        },
+        "telemetry": rep,
+    }
+    print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()

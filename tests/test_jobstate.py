@@ -1,0 +1,208 @@
+"""Checkpoint-resumable fits (ISSUE 9 tentpole, prong 3).
+
+Phase-boundary snapshots (``pypardis_tpu.utils.jobstate``) + the
+``DBSCAN.train(resume=path)`` surface, plus the ladder-exhaustion
+error-message satellites (the raises must name the env knob).
+
+The resume contract under test: a fit interrupted mid-run (here via an
+injected TERMINAL fault — the in-process stand-in for SIGKILL, which
+``make fault-probe`` exercises for real with a subprocess kill)
+resumes to labels BYTE-IDENTICAL to an uninterrupted fit, replaying
+only the unfinished partitions/rounds.
+"""
+
+import numpy as np
+import pytest
+from sklearn.datasets import make_blobs
+
+from pypardis_tpu import DBSCAN
+from pypardis_tpu.parallel import default_mesh, sharded_dbscan, staging
+from pypardis_tpu.partition import KDPartitioner
+from pypardis_tpu.utils import faults
+from pypardis_tpu.utils.jobstate import JobState, fit_meta
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    faults.clear()
+    staging.clear()
+    yield
+    faults.clear()
+    staging.clear()
+
+
+@pytest.fixture()
+def blob_data():
+    X, _ = make_blobs(
+        n_samples=4000, centers=10, n_features=3, cluster_std=0.3,
+        random_state=5,
+    )
+    return X.astype(np.float32)
+
+
+@pytest.fixture()
+def chain_data():
+    rng = np.random.default_rng(0)
+    n = 3000
+    X = np.stack(
+        [np.arange(n) * 0.1, rng.normal(0, 0.05, n)], axis=1
+    )
+    return X.astype(np.float32)
+
+
+KW = dict(eps=0.45, min_samples=5, block=64)
+
+
+def test_chained_resume_byte_identical(blob_data, tmp_path):
+    """Kill the chained route mid-loop (terminal injected error at
+    partition 5), resume from the snapshot: only the unfinished
+    partitions recompute and labels match the uninterrupted run."""
+    part = KDPartitioner(blob_data, max_partitions=8)
+    mesh1 = default_mesh(1)
+    clean, clean_core, _ = sharded_dbscan(
+        blob_data, part, mesh=mesh1, **KW
+    )
+    path = str(tmp_path / "chained.ckpt.npz")
+    meta = fit_meta(blob_data, eps=KW["eps"],
+                    min_samples=KW["min_samples"], metric="euclidean",
+                    block=KW["block"], mode="kd")
+
+    staging.clear()
+    js = JobState.open(path, meta)
+    with faults.plan("chained.partition:5=error"):
+        with pytest.raises(faults.FaultInjected):
+            sharded_dbscan(blob_data, part, mesh=mesh1, jobstate=js,
+                           **KW)
+
+    staging.clear()
+    js2 = JobState.open(path, meta, resume=True)
+    labels, core, _stats = sharded_dbscan(
+        blob_data, part, mesh=mesh1, jobstate=js2, **KW
+    )
+    assert js2.restored_partitions == 4  # partitions 0-3 replayed
+    np.testing.assert_array_equal(labels, clean)
+    np.testing.assert_array_equal(core, clean_core)
+
+
+def test_gm_resume_via_train(chain_data, tmp_path):
+    """DBSCAN.train(resume=) on the global-Morton route: die inside
+    fixpoint round 2, resume from the saved lab_map, labels
+    byte-identical to the uninterrupted fit."""
+    clean = DBSCAN(mode="global_morton", merge="device", **KW)
+    clean.fit(chain_data)
+    path = str(tmp_path / "gm.ckpt")
+
+    staging.clear()
+    with faults.plan("gm.fixpoint_round:2=error"):
+        with pytest.raises(faults.FaultInjected):
+            DBSCAN(mode="global_morton", merge="device", **KW).train(
+                chain_data, resume=path
+            )
+
+    staging.clear()
+    model = DBSCAN(mode="global_morton", merge="device", **KW)
+    model.train(chain_data, resume=path)
+    np.testing.assert_array_equal(model.labels_, clean.labels_)
+    np.testing.assert_array_equal(
+        model.core_sample_mask_, clean.core_sample_mask_
+    )
+    # the resume really replayed saved fixpoint state
+    assert model._jobstate.restored_rounds >= 1
+    assert model.report()["metrics"]["counters"].get(
+        "events.jobstate_restore", 0
+    ) >= 1
+
+
+def test_resume_rejects_mismatched_fit(chain_data, blob_data, tmp_path):
+    path = str(tmp_path / "mismatch.ckpt")
+    with faults.plan("gm.fixpoint_round:1=error"):
+        with pytest.raises(faults.FaultInjected):
+            DBSCAN(mode="global_morton", merge="device", **KW).train(
+                chain_data, resume=path
+            )
+    with pytest.raises(ValueError, match="different fit"):
+        DBSCAN(mode="global_morton", merge="device", **KW).train(
+            blob_data, resume=path
+        )
+
+
+def test_budget_mismatch_invalidates_snapshot(tmp_path):
+    """Tables snapshotted under one pair budget are never served to a
+    run using another — a ladder retry with a bigger budget must
+    recompute, not consume tables built from a truncated pair list."""
+    js = JobState(str(tmp_path / "b.npz"), {"schema": "x"})
+    js.chained_note(0, np.zeros(8, np.int32), np.zeros(8, bool),
+                    np.zeros(5, np.int64), budget=0)
+    assert set(js.chained_restore(0)) == {0}
+    assert js.chained_restore(4096) == {}
+    js.chained_note(1, np.zeros(8, np.int32), np.zeros(8, bool),
+                    np.zeros(5, np.int64), budget=4096)
+    # the budget generation reset dropped the old entry
+    assert set(js.chained_restore(4096)) == {1}
+    assert js.chained_restore(0) == {}
+
+
+def test_jobstate_atomic_roundtrip(tmp_path):
+    path = str(tmp_path / "rt.npz")
+    meta = {"schema": "pypardis_tpu/jobstate@1", "eps": 0.5}
+    js = JobState.open(path, meta)
+    js.gm_note(np.arange(17, dtype=np.int32), 3, budget=0)
+    js.stepped_note(np.arange(32, dtype=np.int32), 2, budget=64)
+    js.flush(force=True)
+    js2 = JobState.open(path, meta, resume=True)
+    lab, rounds = js2.gm_restore(0, 17)
+    np.testing.assert_array_equal(lab, np.arange(17, dtype=np.int32))
+    assert rounds == 3
+    f, batches = js2.stepped_restore(64, 32)
+    np.testing.assert_array_equal(f, np.arange(32, dtype=np.int32))
+    assert batches == 2
+    # shape / budget mismatches refuse
+    assert js2.gm_restore(0, 18) is None
+    assert js2.stepped_restore(0, 32) is None
+
+
+# ---------------------------------------------------------------------------
+# ladder-exhaustion messages name their knobs (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_pair_budget_exhaustion_names_knob():
+    from pypardis_tpu.utils.budget import run_ladders
+
+    def run_step(pb, _mr):
+        # always overflows: total 50000 against whatever budget
+        return None, np.asarray([[50000, 10, 1, 0, 0]]), True
+
+    with pytest.raises(RuntimeError) as ei:
+        run_ladders(run_step, ("t",), None, 8)
+    msg = str(ei.value)
+    assert "pair_budget=" in msg
+    assert "PYPARDIS_PAIR_BUDGET" in msg
+
+
+def test_pair_budget_env_knob(monkeypatch):
+    from pypardis_tpu.utils.budget import run_ladders
+
+    seen = []
+
+    def run_step(pb, _mr):
+        seen.append(pb)
+        return "out", np.asarray([[100, 0, 1, 0, 0]]), True
+
+    monkeypatch.setenv("PYPARDIS_PAIR_BUDGET", "8192")
+    out, _ = run_ladders(run_step, ("t2",), None, 8)
+    assert seen == [8192]
+
+
+def test_btcap_exhaustion_names_knob(blob_data):
+    from pypardis_tpu.parallel.global_morton import global_morton_dbscan
+
+    with pytest.raises(RuntimeError) as ei:
+        # eps large enough that every tile is a boundary tile: an
+        # explicit btcap=1 must overflow and fail loudly
+        global_morton_dbscan(
+            blob_data, eps=5.0, min_samples=5, block=64, btcap=1,
+        )
+    msg = str(ei.value)
+    assert "btcap" in msg
+    assert "PYPARDIS_GM_BTCAP" in msg

@@ -213,9 +213,16 @@ def test_report_schema_on_mesh(fitted_model):
     # restage / ladder event counts always present
     assert set(r["events"]) == {
         "restage", "transient_retry", "pair_overflow", "halo_overflow",
-        "merge_unconverged", "compile",
+        "merge_unconverged", "compile", "fault_injected", "degraded",
     }
     assert r["events"]["restage"] == 0
+
+    # fault-tolerance block: always present, all-zero on a clean fit
+    # (the injection sites are no-ops without PYPARDIS_FAULTS)
+    assert r["faults"] == {
+        "injected": 0, "retried": 0, "giveups": 0, "degraded": 0,
+        "degraded_to": "",
+    }
 
     # registry dump rides along
     assert "phase.cluster" in r["metrics"]["timings"]
