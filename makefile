@@ -5,7 +5,8 @@ PY ?= python
 
 .PHONY: all wheel native test verify tpu-smoke bench bench-smoke \
 	partition-probe serve-probe live-probe global-morton-probe \
-	fault-probe bench-diff flight-check demo clean
+	fault-probe bench-diff flight-check northstar northstar-smoke \
+	streammem-probe sort-probe demo clean
 
 all: native test
 
@@ -46,7 +47,7 @@ bench:
 # then the CI-sized partitioner depth-scaling probe (fails when the
 # level builder's mp-doubling cost ratio exceeds 1.5x).
 bench-smoke: partition-probe serve-probe live-probe global-morton-probe \
-		fault-probe bench-diff flight-check
+		fault-probe bench-diff flight-check northstar-smoke
 	JAX_PLATFORMS=cpu BENCH_N=2000 BENCH_DIM=4 BENCH_REPS=1 \
 	BENCH_DEV_REPS=1 $(PY) bench.py \
 	| $(PY) scripts/bench_diff.py --annotate --baseline-dir . \
@@ -71,6 +72,43 @@ bench-diff:
 fault-probe:
 	FAULT_N=$${FAULT_N:-3000} $(PY) scripts/fault_probe.py \
 	| $(PY) scripts/check_bench_json.py
+
+# North-star run (ISSUE 10 / ROADMAP item 1): chunked blob generation
+# straight to a disk memmap, streaming global-Morton build (external
+# sample-sort), chained (1-device) or distributed (mesh) execute, host
+# merge, PYPARDIS_CKPT resume on — one schema'd northstar@1 row
+# decomposing build/exchange/compute/merge seconds + peak RssAnon.
+# Defaults: 100M x 16-D on TPU hardware; 2M (the largest CPU-feasible
+# smoke) elsewhere.  Override: `NS_N=100000000 make northstar`.
+northstar:
+	$(PY) scripts/northstar_run.py \
+	| $(PY) scripts/check_bench_json.py
+
+# CI-sized northstar composition (wired into bench-smoke): the same
+# full driver at 120k proves the plumbing + row schema on every PR.
+northstar-smoke:
+	JAX_PLATFORMS=cpu \
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	NS_N=$${NS_N:-120000} NS_DIM=$${NS_DIM:-16} \
+	$(PY) scripts/northstar_run.py \
+	| $(PY) scripts/check_bench_json.py
+
+# Streaming-build memory probe (ISSUE 10 acceptance gauge): peak host
+# ANON memory of the external sample-sort + per-shard assembly vs the
+# in-RAM morton_range_split build, on a disk-backed memmap.  The
+# acceptance geometry: `STREAMMEM_N=10000000 make streammem-probe`
+# (gate: stream build anon < 0.25x dataset bytes; exits nonzero past
+# it).
+streammem-probe:
+	$(PY) scripts/streammem_probe.py $${STREAMMEM_N:-2000000} \
+	$${STREAMMEM_DIM:-16} $${STREAMMEM_EPS:-2.4} \
+	$${STREAMMEM_MODE:-gm_stream}
+
+# Device sort/morton/gather primitive costs + (--stream) the host
+# external sample-sort vs in-RAM morton_range_split at the same N.
+sort-probe:
+	$(PY) scripts/sort_probe.py $${SORT_N:-1000000} \
+	$${SORT_DIM:-16} --stream
 
 # Crash-safety smoke: fit with the flight recorder enabled, SIGKILL it
 # mid-run, then reconstruct a Chrome trace + partial report from the

@@ -1045,13 +1045,11 @@ class DBSCAN:
                     "the global Morton keying runs on the host "
                     "(device-resident inputs take the KD ring route)"
                 )
-            if isinstance(points, np.memmap):
-                raise ValueError(
-                    "mode='global_morton' does not stream memmaps: the "
-                    "global Morton keying materializes one f32 copy of "
-                    "the dataset; use the default KD ring route for "
-                    "disk-backed inputs"
-                )
+            # A disk-backed memmap streams: the global Morton order
+            # comes from the external sample-sort
+            # (partition.morton_range_split_streaming) and shard slabs
+            # assemble one device at a time — host RAM never holds the
+            # dataset as anonymous memory (ISSUE 10 tentpole).
             try:
                 self._train_sharded_global_morton(points, timer)
                 return
@@ -1267,7 +1265,7 @@ class DBSCAN:
         self.metrics_.update(stats)
         self.metrics_["partition_builder"] = "morton_range"
         self.metrics_["partition_levels_s"] = []
-        if parity is not None:
+        if parity is not None and "order" in parity:
             order = np.asarray(parity["order"])
             starts = np.asarray(parity["starts"], dtype=np.int64)
             lo = np.asarray(parity["box_lo"])
@@ -1290,6 +1288,29 @@ class DBSCAN:
             self.cluster_dict = _partition_cluster_dict(
                 part.result, self.labels_
             )
+        elif parity is not None:
+            # Streaming/chained build: the O(N) permutation is exactly
+            # what the out-of-core route avoids, so the parity surface
+            # is ranges + boxes only (partitioner_ stays None — range
+            # membership is a property of the on-disk sorted spill, not
+            # something worth O(N) host memory to replay).
+            starts = np.asarray(parity["starts"], dtype=np.int64)
+            lo = np.asarray(parity["box_lo"])
+            hi = np.asarray(parity["box_hi"])
+            boxes = {
+                s: BoundingBox(lower=lo[s], upper=hi[s])
+                for s in range(min(len(lo), len(starts) - 1))
+                if starts[s + 1] > starts[s]
+            }
+            self.partitioner_ = None
+            self.metrics_["n_partitions"] = len(starts) - 1
+            if boxes:
+                self.bounding_boxes = boxes
+                self.expanded_boxes = {
+                    l: b.expand(2 * self.eps) for l, b in boxes.items()
+                }
+            self.neighbors = None
+            self.cluster_dict = {}
 
     def save(self, path: str) -> None:
         """Checkpoint the trained model (labels, boxes, hyperparams)."""

@@ -56,6 +56,14 @@ _SHARDING_KEYS = (
     "sent_tiles",
     "ring_rounds",
     "fixpoint_rounds",
+    # Streaming external sample-sort build (ISSUE 10): spill-bucket
+    # geometry of the out-of-core global-Morton sort, plus the chained
+    # single-device route's flag.
+    "stream_buckets",
+    "stream_max_bucket_rows",
+    "stream_sample_rows",
+    "spill_bytes",
+    "chained",
 )
 
 # Model-FLOP peak per chip for the MFU denominator, matched by
@@ -420,6 +428,11 @@ def format_summary(report: Dict) -> str:
         f"pad_waste {sh['pad_waste']:.3f}",
         f"dup_work {sh['duplicated_work_factor']:.2f}x",
     ]
+    if sh.get("input") == "stream":
+        bits = f"stream ({sh.get('stream_buckets', '?')} buckets)"
+        if sh.get("chained"):
+            bits += " chained"
+        shard_bits.append(bits)
     if sh.get("mode") == "global_morton":
         shard_bits.append(
             f"boundary {sh.get('boundary_tiles', 0)} tiles "

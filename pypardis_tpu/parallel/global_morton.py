@@ -56,12 +56,21 @@ owned-owned or owned-boundary edge on at least one device, boundary
 core flags are the owners' exact verdicts, and the merge consumes the
 exact wire format the KD occurrence tables use.
 
-Caveats: the global Morton keying needs the dataset row-indexable in
-host RAM (one f32 copy during the sort — disk-backed memmaps should
-keep the KD ring/streaming route), and per-round fixpoint/ring syncs
-trade ~one scalar fetch per round for the convergence probe and the
-trace separation of exchange vs compute time (cheap on CPU meshes;
-hardware sessions should re-measure).
+Disk-backed ``np.memmap`` inputs STREAM (ISSUE 10): the global Morton
+order comes from an external sample-sort over memmap chunks
+(:func:`pypardis_tpu.partition.morton_range_split_streaming`,
+byte-identical order/starts/center to the in-RAM keying) and shard
+slabs assemble on their devices one at a time
+(:func:`build_morton_shards_streaming`) — peak host anonymous memory
+is O(chunk + sample + one spill bucket), never the in-RAM path's f32
+copy + full permutation.  A 1-device mesh can additionally CHAIN the
+stream (``chain=R`` / ``PYPARDIS_GM_CHAIN``): R Morton ranges visit
+the single chip in turn with exact tile-granular boundary context
+(:func:`_gm_chained_dbscan`) — the 100M-on-one-chip route.  Remaining
+caveat: per-round fixpoint/ring syncs trade ~one scalar fetch per
+round for the convergence probe and the trace separation of exchange
+vs compute time (cheap on CPU meshes; hardware sessions should
+re-measure).
 """
 
 from __future__ import annotations
@@ -156,14 +165,28 @@ def _gm_segment_layout(rows, block, eps):
     layout.
     """
     m, k = rows.shape
-    if m == 0:
-        return np.empty(0, np.int64), 0
-    if (
-        eps is None or m < 4 * block or k > 64
-        or os.environ.get("PYPARDIS_GM_SEGBREAK", "1") == "0"
-    ):
+    if _segbreak_skip(m, k, block, eps):
         return np.arange(m, dtype=np.int64), round_up(m, block)
     d2 = np.sum((rows[1:] - rows[:-1]) ** 2, axis=1)
+    return _segment_layout_from_d2(d2, m, block, eps)
+
+
+def _segbreak_skip(m, k, block, eps) -> bool:
+    """Gates under which a shard keeps the identity layout (same as
+    the fused engine's)."""
+    return bool(
+        m == 0 or eps is None or m < 4 * block or k > 64
+        or os.environ.get("PYPARDIS_GM_SEGBREAK", "1") == "0"
+    )
+
+
+def _segment_plan_from_d2(d2, m, block, eps):
+    """Break plan from precomputed consecutive-row jump distances —
+    split from :func:`_gm_segment_layout` so the streaming build can
+    accumulate ``d2`` chunkwise (elementwise, so byte-identical)
+    without ever holding a whole range's (m, k) diff temp in host RAM.
+    Returns ``(brk_pos, tgt0, src0, plen)``: tiny metadata from which
+    any row span's slab targets rebuild (:func:`_plan_targets`)."""
     thr = np.float32(16.0) * np.float32(eps) ** 2
     bt = max(1, m // block)
     brk = d2 > thr
@@ -175,8 +198,30 @@ def _gm_segment_layout(rows, block, eps):
     padded = -(-seg_len // block) * block
     tgt0 = np.cumsum(padded) - padded
     src0 = np.cumsum(seg_len) - seg_len
-    target = tgt0[seg] + np.arange(m, dtype=np.int64) - src0[seg]
-    return target, int(padded.sum())
+    return np.flatnonzero(brk), tgt0, src0, int(padded.sum())
+
+
+def _plan_targets(plan, off, ln):
+    """Slab slot targets for rows [off, off+ln) of a range, from its
+    compressed break plan (identity when ``plan`` is None).  Breaks at
+    d2 position p separate rows p and p+1, so a row's segment id is
+    the count of break positions < its index — ``searchsorted`` on the
+    sorted break list, exactly ``cumsum(brk)`` restricted to the
+    span."""
+    idx = np.arange(off, off + ln, dtype=np.int64)
+    if plan is None:
+        return idx
+    brk_pos, tgt0, src0 = plan
+    seg = np.searchsorted(brk_pos, idx, side="left")
+    return tgt0[seg] + idx - src0[seg]
+
+
+def _segment_layout_from_d2(d2, m, block, eps):
+    """(target, padded_len) from precomputed jump distances."""
+    if m == 0:
+        return np.empty(0, np.int64), 0
+    brk_pos, tgt0, src0, plen = _segment_plan_from_d2(d2, m, block, eps)
+    return _plan_targets((brk_pos, tgt0, src0), 0, m), plen
 
 
 def build_morton_shards(points, n_shards, block, sharding, eps=None):
@@ -245,6 +290,157 @@ def build_morton_shards(points, n_shards, block, sharding, eps=None):
     ))
     staging.device_put_cached("gm_owned", base, arrays, aux=aux)
     return arrays, aux, bufs, base
+
+
+def _stream_range_plan(split, s, block, eps):
+    """One range's segment-break plan + extent box, streamed.
+
+    Walks the range in pieces (:meth:`MortonStreamSplit
+    .iter_range_rows`), accumulating the consecutive-row jump
+    distances ``d2`` (elementwise — byte-identical to the in-RAM
+    diff) and the range extrema, then derives the break plan from
+    :func:`_segment_layout_from_d2`'s body.  Returns ``(plan, plen,
+    lo, hi)`` where ``plan`` is None for the identity layout or
+    ``(brk_pos, tgt0, src0)`` — tiny metadata from which any piece's
+    slab targets rebuild (:func:`_plan_targets`), so the full (m,)
+    target array never has to persist across ranges.
+    """
+    a, b = int(split.starts[s]), int(split.starts[s + 1])
+    m, k = b - a, split.k
+    lo = np.full(k, np.float32(np.inf), np.float32)
+    hi = np.full(k, np.float32(-np.inf), np.float32)
+    skip = _segbreak_skip(m, k, block, eps)
+    if m == 0:
+        return None, 0, lo, hi
+    d2 = None if skip else np.empty(max(m - 1, 0), np.float32)
+    prev = None
+    for off, _ids, rows in split.iter_range_rows(s):
+        np.minimum(lo, rows.min(axis=0), out=lo)
+        np.maximum(hi, rows.max(axis=0), out=hi)
+        if d2 is not None:
+            if prev is not None and off > 0:
+                d2[off - 1] = np.sum((rows[0] - prev) ** 2)
+            if len(rows) > 1:
+                diff = rows[1:] - rows[:-1]
+                d2[off:off + len(rows) - 1] = np.sum(diff * diff,
+                                                     axis=1)
+            prev = rows[-1].copy()
+    if skip:
+        return None, round_up(m, block), lo, hi
+    brk_pos, tgt0, src0, plen = _segment_plan_from_d2(d2, m, block, eps)
+    return (brk_pos, tgt0, src0), plen, lo, hi
+
+
+def build_morton_shards_streaming(points, n_shards, block, sharding,
+                                  eps=None):
+    """Out-of-core twin of :func:`build_morton_shards`.
+
+    ``points`` is any row-sliceable array — typically a disk-backed
+    ``np.memmap``.  The global Morton order comes from the external
+    sample-sort (:func:`pypardis_tpu.partition
+    .morton_range_split_streaming`, byte-identical per-range order /
+    starts / center), and each shard's slab is assembled ALONE from
+    spill-range pieces and shipped to its device before the next
+    begins — peak host anonymous memory is O(stream chunk + sample +
+    one spill bucket + one shard slab), never the full f32 copy + full
+    permutation + all-shard slab of the in-RAM build.  Slab layout
+    (segment breaks, capacity, gid placement) is byte-identical to the
+    in-RAM build, so labels ride identical through the whole engine.
+
+    Returns the :func:`build_morton_shards` contract ``(arrays, aux,
+    host_bufs, base)`` with ``arrays`` already device-resident and
+    ``aux["parity"]`` carrying starts/boxes but NO full order array
+    (the O(N) permutation is exactly what this path exists to avoid).
+    """
+    from ..partition import morton_range_split_streaming
+
+    n, k = points.shape
+    base = _gm_cache_key(points, n_shards, block, sharding)
+    cached = staging.device_get("gm_owned", base)
+    if cached is not None:
+        arrays, aux = cached
+        return arrays, aux, [], base
+    mesh = sharding.mesh
+    devices = mesh.devices.reshape(-1)
+    split = morton_range_split_streaming(
+        points, n_shards, eps=eps, block=block
+    )
+    try:
+        plans, plens, sizes = [], [], []
+        lo = np.full((n_shards, k), np.inf)
+        hi = np.full((n_shards, k), -np.inf)
+        for s in range(n_shards):
+            plan, plen, rlo, rhi = _stream_range_plan(
+                split, s, block, eps
+            )
+            plans.append(plan)
+            plens.append(plen)
+            m = int(split.starts[s + 1] - split.starts[s])
+            sizes.append(m)
+            if m:
+                lo[s] = rlo + split.center
+                hi[s] = rhi + split.center
+        cap = round_up(max(plens + [1]), block)
+        parts = ([], [], [])
+        for s in range(n_shards):
+            # Device-side slab assembly: the host never allocates a
+            # cap-sized buffer — spill pieces ship as they are read
+            # and scatter into the device-resident slab, so peak host
+            # anon stays O(piece) and "one shard" lives in HBM where
+            # it belongs (on the CPU mesh device buffers are host
+            # anon — the streammem probe's documented caveat).  The
+            # mask derives from the gid slab in-place, saving a third
+            # of the transfers.
+            dev = devices[s]
+            # device_put COMMITS the slab to its device (an
+            # uncommitted default_device array migrates back to
+            # device 0 and breaks the single-device assembly);
+            # committed operands then pin every .at[].set there.
+            ow = jax.device_put(jnp.zeros((cap, k), jnp.float32), dev)
+            gd = jax.device_put(jnp.full((cap,), n, jnp.int32), dev)
+            for off, ids, rows in split.iter_range_rows(
+                s, chunk=1 << 19
+            ):
+                tgt = _plan_targets(plans[s], off, len(ids))
+                ow, gd = staging.transfer(
+                    lambda ow=ow, gd=gd, tgt=tgt, rows=rows,
+                    ids=ids: (
+                        ow.at[tgt].set(rows),
+                        gd.at[tgt].set(ids),
+                    )
+                )
+            ms = gd != jnp.int32(n)
+            parts[0].append(ow[None])
+            parts[1].append(ms[None])
+            parts[2].append(gd[None])
+            del ow, ms, gd
+        owned = jax.make_array_from_single_device_arrays(
+            (n_shards, cap, k), sharding, parts[0]
+        )
+        msk = jax.make_array_from_single_device_arrays(
+            (n_shards, cap), sharding, parts[1]
+        )
+        gid = jax.make_array_from_single_device_arrays(
+            (n_shards, cap), sharding, parts[2]
+        )
+        aux = {
+            "owned_cap": cap,
+            "n_shard_partitions": n_shards,
+            "pad_waste": float(n_shards * cap) / max(n, 1) - 1.0,
+            "partition_sizes": sizes,
+            "input": "stream",
+            **split.stats,
+            "parity": {
+                "starts": [int(x) for x in split.starts],
+                "box_lo": lo.tolist(),
+                "box_hi": hi.tolist(),
+            },
+        }
+    finally:
+        split.close()
+    arrays = (owned, msk, gid)
+    staging.device_put_cached("gm_owned", base, arrays, aux=aux)
+    return arrays, aux, [], base
 
 
 # ---------------------------------------------------------------------------
@@ -743,6 +939,434 @@ def _gm_fixpoint(home_label, core_g, bgid, b_glab, *, mesh, axis,
 
 
 # ---------------------------------------------------------------------------
+# chained 1-device route (streaming ranges through one chip)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "owned", "eps", "min_samples", "metric", "block", "precision",
+        "backend", "pair_budget",
+    ),
+)
+def _gm_chain_counts(pts, msk, *, owned, eps, min_samples, metric,
+                     block, precision, backend, pair_budget):
+    """One range's owner-computes COUNTS pass on a single device: the
+    per-device half of :func:`_gm_cluster_step` minus every collective
+    — owned rows count against owned + boundary columns, nothing else
+    runs.  Returns ``(own_core (owned,), pair_stats (5,))``."""
+    kind, pairs, st = oc_extract(
+        pts, eps, msk, owned=owned, metric=metric, block=block,
+        precision=precision, backend=backend, pair_budget=pair_budget,
+    )
+    core, band = oc_counts_banded(
+        pts, eps, min_samples, msk, owned=owned, metric=metric,
+        block=block, precision=precision, kind=kind, pairs=pairs,
+    )
+    return core, jnp.concatenate(
+        [st, jnp.ones(1, jnp.int32), band]
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "owned", "eps", "metric", "block", "precision", "backend",
+        "pair_budget",
+    ),
+)
+def _gm_chain_propagate(pts, msk, core_all, gid, *, owned, eps, metric,
+                        block, precision, backend, pair_budget):
+    """One range's relay PROPAGATION pass with host-supplied core
+    flags (the chained analogue of :func:`sharded._oc_cluster_step`'s
+    per-device body).  Returns ``(glabel (rows,), pair_stats (5,))``
+    — global root-gid labels over owned + boundary slots, the exact
+    occurrence-table wire format the host union-find consumes."""
+    kind, pairs, st = oc_extract(
+        pts, eps, msk, owned=owned, metric=metric, block=block,
+        precision=precision, backend=backend, pair_budget=pair_budget,
+    )
+    labels, passes, band = oc_propagate_banded(
+        pts, eps, msk, core_all, owned=owned, metric=metric,
+        block=block, precision=precision, kind=kind, pairs=pairs,
+    )
+    glabel = jnp.where(
+        labels >= 0, jnp.take(gid, jnp.clip(labels, 0, None)), -1
+    ).astype(jnp.int32)
+    return glabel, jnp.concatenate([st, (1 + passes)[None], band])
+
+
+def _chain_boundary_tiles(split, starts, block, eps, n, n_ranges):
+    """Tile-granular boundary cover per range, from the streamed
+    global tile boxes.
+
+    A tile t is boundary context for range s iff its box lies within
+    eps of SOME tile box of s — the same box-gap bound the ring's
+    :func:`halo.boundary_send_select` uses, so the cover is exact
+    (every cross-range eps-pair lives in a tile pair whose boxes are
+    within eps).  A union-box prefilter cuts the exact pass to the
+    candidate frontier.  Returns ``(tile_sel, boundary_rows)``.
+    """
+    tlo, thi = split.tile_lo, split.tile_hi
+    nt, k = tlo.shape
+    eps2 = np.float32(eps) ** 2
+    tile_sel, brows = [], []
+    for s in range(n_ranges):
+        a, b = int(starts[s]), int(starts[s + 1])
+        if b <= a:
+            tile_sel.append(np.empty(0, np.int64))
+            brows.append(0)
+            continue
+        ts, te = a // block, -(-b // block)
+        ulo = tlo[ts:te].min(axis=0)
+        uhi = thi[ts:te].max(axis=0)
+        gap_u = np.maximum(
+            0.0, np.maximum(ulo[None] - thi, tlo - uhi[None])
+        )
+        cand = np.flatnonzero(np.sum(gap_u * gap_u, axis=1) <= eps2)
+        cand = cand[(cand < ts) | (cand >= te)]
+        if len(cand):
+            keep = np.zeros(len(cand), bool)
+            rlo, rhi = tlo[ts:te], thi[ts:te]
+            # Same bounded-transient budget as _weights_from_boxes.
+            step = max(1, (1 << 23) // max((te - ts) * k, 1))
+            for c0 in range(0, len(cand), step):
+                c1 = min(c0 + step, len(cand))
+                g = np.maximum(
+                    0.0,
+                    np.maximum(rlo[None] - thi[cand[c0:c1], None],
+                               tlo[cand[c0:c1], None] - rhi[None]),
+                )
+                keep[c0:c1] = (
+                    np.sum(g * g, axis=-1) <= eps2
+                ).any(axis=1)
+            cand = cand[keep]
+        tile_sel.append(cand)
+        brows.append(int(sum(
+            min((int(t) + 1) * block, n) - int(t) * block
+            for t in cand
+        )))
+    return tile_sel, brows
+
+
+def _chain_fill_boundary(split, tiles, bcap, block, n, k):
+    """(bcap, k) boundary slab for one range: each selected tile keeps
+    its own block-aligned slot (sender-tight boxes — the same
+    quantization the ring's transport preserves), contiguous tile runs
+    coalesced into single spill reads."""
+    bp = np.zeros((bcap, k), np.float32)
+    bm = np.zeros(bcap, bool)
+    bg = np.full(bcap, n, np.int32)
+    if len(tiles) == 0:
+        return bp, bm, bg
+    run_starts = np.flatnonzero(
+        np.concatenate([[True], np.diff(tiles) > 1])
+    )
+    run_ends = np.append(run_starts[1:], len(tiles))
+    slot = 0
+    for r0, r1 in zip(run_starts, run_ends):
+        t0, t1 = int(tiles[r0]), int(tiles[r1 - 1]) + 1
+        a, b = t0 * block, min(t1 * block, n)
+        ids_r, rows_r = split.row_span(a, b)
+        for j in range(t1 - t0):
+            p0 = j * block
+            p1 = min(p0 + block, len(ids_r))
+            dst = slot * block
+            bp[dst:dst + (p1 - p0)] = rows_r[p0:p1]
+            bm[dst:dst + (p1 - p0)] = True
+            bg[dst:dst + (p1 - p0)] = ids_r[p0:p1]
+            slot += 1
+    return bp, bm, bg
+
+
+def _gm_chained_dbscan(
+    points, eps, min_samples, *, metric, block, precision, backend,
+    pair_budget, merge_rounds, n_ranges, mesh, jobstate=None,
+):
+    """Chained single-device global-Morton clustering of a streamed
+    dataset: contiguous Morton ranges visit ONE device one at a time.
+
+    The composition the 100M single-chip north star runs: the external
+    sample-sort supplies per-range rows + global tile boxes; each
+    range's slab is the fused layout (segment breaks) plus its exact
+    tile-granular boundary cover; two chained passes — owner-computes
+    counts (exact core verdicts, host-relayed like
+    :func:`sharded._oc_counts_step`), then relay propagation — emit
+    the standard occurrence tables, and the collective-free host
+    union-find merges them (:func:`sharded._host_merge_finish`'s
+    machinery on pre-accumulated (N,) tables).  Labels are
+    byte-identical to the mesh global-Morton engine and the fused
+    single-device engine (pinned).
+
+    Peak device memory is one range's owned + boundary slab; peak host
+    anonymous memory is O(stream chunk + one spill bucket + one range
+    slab + (N,) label/core tables).  ``duplicated_work_factor`` is 1.0
+    — owned rows cluster exactly once; boundary tiles are columns, not
+    clustered rows.
+
+    With a ``jobstate``, each range's propagation tables snapshot at
+    the checkpoint cadence (the chained payload); a SIGKILLed fit
+    resumes past completed ranges byte-identically.
+    """
+    import time as _time
+
+    from ..partition import morton_range_split_streaming
+    from .merge import merge_occurrences
+
+    n, k = points.shape
+    n1 = n + 1
+    t_wall = _time.perf_counter()
+    split = morton_range_split_streaming(
+        points, n_ranges, eps=eps, block=block
+    )
+    try:
+        with obs_span("gm.build", chained=True, ranges=n_ranges):
+            plans, plens, sizes = [], [], []
+            for s in range(n_ranges):
+                plan, plen, _lo, _hi = _stream_range_plan(
+                    split, s, block, eps
+                )
+                plans.append(plan)
+                plens.append(plen)
+                sizes.append(
+                    int(split.starts[s + 1] - split.starts[s])
+                )
+            cap = round_up(max(plens + [1]), block)
+        t_build = _time.perf_counter() - t_wall
+
+        t0 = _time.perf_counter()
+        with obs_span("gm.exchange", chained=True):
+            tile_sel, brows = _chain_boundary_tiles(
+                split, split.starts, block, eps, n, n_ranges
+            )
+            btiles = max((len(c) for c in tile_sel), default=0)
+            bcap = round_up(max(btiles, 1) * block, block)
+        t_exchange = _time.perf_counter() - t0
+
+        starts = split.starts
+        be = gm_backend(
+            backend, metric, cap + bcap, cap, block, k, precision
+        )
+        hint_key = (
+            "gm_chain", (n_ranges, cap, k), bcap, block, precision,
+            float(eps), metric,
+        )
+        _note_first_compile(
+            "global_morton_chained",
+            ((n_ranges, cap, k), bcap, block, precision, be),
+        )
+        t_exec_cell = [0.0]
+
+        def _range_slab(s):
+            ow = np.zeros((cap, k), np.float32)
+            om = np.zeros(cap, bool)
+            og = np.full(cap, n, np.int32)
+            for off, ids, rows in split.iter_range_rows(s):
+                tgt = _plan_targets(plans[s], off, len(ids))
+                ow[tgt] = rows
+                om[tgt] = True
+                og[tgt] = ids
+            bp, bm, bg = _chain_fill_boundary(
+                split, tile_sel[s], bcap, block, n, k
+            )
+            pts = np.concatenate([ow, bp], axis=0)
+            msk = np.concatenate([om, bm])
+            return pts, msk, og, bg
+
+        def run_step(pb, _mr, be=be):
+            t_exec = _time.perf_counter()
+            faults.maybe_fail("gm.execute")
+            # Snapshots key by the EFFECTIVE pair budget (the ladder's
+            # pb, not the caller's arg): tables computed under a
+            # budget that later overflowed must never be replayed.
+            budget_tag = int(pb or 0)
+            restored = (
+                jobstate.chained_restore(budget_tag)
+                if jobstate is not None else {}
+            )
+            if restored:
+                obs_event("jobstate_restore", route="gm_chained",
+                          partitions=len(restored))
+            core_full = np.zeros(n1, bool)
+            pstats_rows = []
+            t_loop = _time.perf_counter()
+            with obs_span("gm.execute", merge="host", chained=True):
+                # Pass A: exact owner core verdicts, range by range.
+                # Slabs are NOT cached between passes — pass B rebuilds
+                # each from spill, keeping peak host memory at ONE
+                # range's slab (the whole point of the chained route).
+                for s in range(n_ranges):
+                    if s in restored:
+                        _glab_r, core_r, _ps_r = restored[s]
+                        og = _restored_gids(split, plans, s, cap, n)
+                        sel = og < n
+                        core_full[og[sel]] = core_r[:cap][sel]
+                        continue
+                    pts, msk, og, bg = _range_slab(s)
+
+                    def one_counts(pts=pts, msk=msk):
+                        faults.maybe_fail("gm.chained_range")
+                        core, ps = _with_kernel_fallback(
+                            lambda b2: _gm_chain_counts(
+                                pts, msk, owned=cap, eps=float(eps),
+                                min_samples=int(min_samples),
+                                metric=metric, block=block,
+                                precision=precision, backend=b2,
+                                pair_budget=pb,
+                            ),
+                            be,
+                        )
+                        return np.asarray(core), np.asarray(ps)
+
+                    core_np, ps = Retrier("gm.chained_range").run(
+                        one_counts
+                    )
+                    pstats_rows.append(ps)
+                    sel = og < n
+                    core_full[og[sel]] = core_np[sel]
+                    del pts, msk, og, bg
+                    obs_heartbeat(
+                        "gm.chained_counts", s + 1, n_ranges, t_loop
+                    )
+                # Pass B: relay propagation with global core flags.
+                home_label = np.full(n, -1, np.int32)
+                halo_gids, halo_labs = [], []
+                t_loop2 = _time.perf_counter()
+                for s in range(n_ranges):
+                    if s in restored:
+                        glab_r, _core_r, ps_r = restored[s]
+                        og = _restored_gids(split, plans, s, cap, n)
+                        bg = _restored_bgids(
+                            split, tile_sel[s], bcap, block, n
+                        )
+                        pstats_rows.append(np.asarray(ps_r))
+                    else:
+                        pts, msk, og, bg = _range_slab(s)
+                        core_all = np.concatenate([
+                            core_full[np.clip(og, 0, n)] & (og < n),
+                            core_full[np.clip(bg, 0, n)] & (bg < n),
+                        ])
+                        gid_full = np.concatenate([og, bg])
+
+                        def one_prop(pts=pts, msk=msk,
+                                     core_all=core_all,
+                                     gid_full=gid_full):
+                            faults.maybe_fail("gm.chained_range")
+                            glab, ps = _with_kernel_fallback(
+                                lambda b2: _gm_chain_propagate(
+                                    pts, msk, core_all, gid_full,
+                                    owned=cap, eps=float(eps),
+                                    metric=metric, block=block,
+                                    precision=precision, backend=b2,
+                                    pair_budget=pb,
+                                ),
+                                be,
+                            )
+                            return np.asarray(glab), np.asarray(ps)
+
+                        glab_r, ps = Retrier("gm.chained_range").run(
+                            one_prop
+                        )
+                        pstats_rows.append(ps)
+                        if jobstate is not None and jobstate.due():
+                            jobstate.chained_note(
+                                s, glab_r,
+                                core_full[np.clip(og, 0, n)]
+                                & (og < n),
+                                ps, budget_tag,
+                            )
+                    sel = og < n
+                    home_label[og[sel]] = glab_r[:cap][sel]
+                    hsel = bg < n
+                    halo_gids.append(bg[hsel])
+                    halo_labs.append(glab_r[cap:][hsel])
+                    obs_heartbeat(
+                        "gm.chained_propagate", s + 1, n_ranges,
+                        t_loop2,
+                    )
+            t_exec_cell[0] = _time.perf_counter() - t_exec
+            pstats = np.stack(pstats_rows) if pstats_rows else (
+                np.zeros((1, 5), np.int32)
+            )
+            out = (home_label, core_full[:n],
+                   np.concatenate(halo_gids) if halo_gids
+                   else np.empty(0, np.int32),
+                   np.concatenate(halo_labs) if halo_labs
+                   else np.empty(0, np.int32))
+            return out, pstats, True
+
+        (home_label, core, halo_gid, halo_lab), pstats = run_ladders(
+            run_step, hint_key, pair_budget, merge_rounds
+        )
+        t0 = _time.perf_counter()
+        with obs_span("gm.merge_host", chained=True):
+            labels, _mapping = merge_occurrences(
+                home_label, core, halo_gid, halo_lab
+            )
+        t_merge = _time.perf_counter() - t0
+
+        boundary_rows = int(sum(brows))
+        boundary_tiles = int(sum(len(c) for c in tile_sel))
+        stats = {
+            "owned_cap": cap,
+            "n_shard_partitions": n_ranges,
+            "pad_waste": float(n_ranges * cap) / max(n, 1) - 1.0,
+            "partition_sizes": sizes,
+            "input": "stream",
+            **split.stats,
+            "mode": "global_morton",
+            "halo_exchange": "chained_tiles",
+            "chained": True,
+            "ring_rounds": 0,
+            "fixpoint_rounds": 0,
+            "merge": "host",
+            "boundary_tiles": boundary_tiles,
+            "boundary_rows": boundary_rows,
+            "sent_tiles": boundary_tiles,
+            "boundary_tile_bytes": boundary_tiles * block * k * 4,
+            "boundary_tile_caps": [int(btiles), int(btiles)],
+            "exchange_tile": int(block),
+            "halo_factor": float(boundary_rows) / max(n, 1),
+            "halo_bytes": boundary_tiles * block * k * 4,
+            "halo_cap": int(bcap),
+            "parity": {
+                "starts": [int(x) for x in starts],
+                "box_lo": [], "box_hi": [],
+            },
+            "gm_build_s": round(t_build, 6),
+            "gm_exchange_s": round(t_exchange, 6),
+            "gm_execute_s": round(t_exec_cell[0], 6),
+            "gm_merge_s": round(t_merge, 6),
+        }
+        _exec_stats(stats, oc_on=True, pstats=pstats, block=block,
+                    k=k, precision=precision, n=n)
+        stats["duplicated_work_factor"] = 1.0
+        stats["owner_computes"] = True
+        return _canonicalize_roots(labels, core), core, stats
+    finally:
+        split.close()
+
+
+def _restored_gids(split, plans, s, cap, n):
+    """Replay a restored range's deterministic owned-gid table (the
+    spill order is deterministic, so this matches the killed run's)."""
+    og = np.full(cap, n, np.int32)
+    for off, ids, _rows in split.iter_range_rows(s):
+        tgt = _plan_targets(plans[s], off, len(ids))
+        og[tgt] = ids
+    return og
+
+
+def _restored_bgids(split, tiles, bcap, block, n):
+    """Replay a restored range's boundary-gid table."""
+    _bp, _bm, bg = _chain_fill_boundary(
+        split, tiles, bcap, block, n, split.k
+    )
+    return bg
+
+
+# ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 
@@ -760,6 +1384,8 @@ def global_morton_dbscan(
     pair_budget: Optional[int] = None,
     merge_rounds: int = 32,
     btcap: Optional[int] = None,
+    stream: Optional[bool] = None,
+    chain: Optional[int] = None,
     jobstate=None,
 ):
     """Cluster ``points`` over the mesh with zero row duplication.
@@ -781,6 +1407,17 @@ def global_morton_dbscan(
     exchange).  ``merge`` as in :func:`sharded.sharded_dbscan`; the
     device route's fixpoint is host-stepped (spans + convergence
     probe), the host route is the collective-free union-find spill.
+
+    ``stream`` routes the shard build through the external sample-sort
+    (:func:`build_morton_shards_streaming`): host RAM stays bounded by
+    O(chunk + sample + one shard) instead of one f32 copy + one
+    permutation + all slabs.  ``None`` auto-enables it for
+    ``np.memmap`` inputs — the memmap dispatch the KD ring route has
+    always had, now on the fastest engine.  ``chain`` (or
+    ``PYPARDIS_GM_CHAIN``) on a 1-device mesh splits the stream into
+    that many Morton ranges chained through the single device
+    (:func:`_gm_chained_dbscan`) — the 100M single-chip route; labels
+    stay byte-identical to the mesh engine.
     """
     from ..ops.distances import _norm_metric
 
@@ -794,12 +1431,29 @@ def global_morton_dbscan(
         mesh = default_mesh()
     n_shards = mesh.devices.size
     axis = mesh.axis_names[0]
-    points = np.asarray(points)
+    # np.asarray would strip the memmap subclass and defeat the
+    # streaming auto-dispatch (same guard as DBSCAN._as_array).
+    if not isinstance(points, np.memmap):
+        points = np.asarray(points)
     n, k = points.shape
-    if btcap is None:
-        env_btcap = os.environ.get("PYPARDIS_GM_BTCAP")
-        if env_btcap:
-            btcap = int(env_btcap)
+    if stream is None:
+        stream = isinstance(points, np.memmap)
+    if chain is None:
+        chain = int(os.environ.get("PYPARDIS_GM_CHAIN", "0") or 0)
+    if n_shards == 1 and int(chain) > 1:
+        import time as _time
+
+        t0 = _time.perf_counter()
+        staging.begin_fit()
+        block_c = clamp_block(block, -(-n // int(chain)))
+        labels, core, stats = _gm_chained_dbscan(
+            points, eps, min_samples, metric=metric, block=block_c,
+            precision=precision, backend=backend,
+            pair_budget=pair_budget, merge_rounds=merge_rounds,
+            n_ranges=int(chain), mesh=mesh, jobstate=jobstate,
+        )
+        stats["gm_total_s"] = round(_time.perf_counter() - t0, 6)
+        return labels, core, stats
     if merge == "auto":
         # Host-RSS pressure (PYPARDIS_RSS_SOFT_LIMIT crossed) takes the
         # host-spill merge preemptively — same rung the degradation
@@ -811,21 +1465,31 @@ def global_morton_dbscan(
             "host" if n >= MERGE_HOST_AUTO or memory_pressure()
             else "device"
         )
+    import time as _time
+
     block = clamp_block(block, -(-n // max(n_shards, 1)))
     sharding = NamedSharding(mesh, P(axis))
     staging.begin_fit()
 
-    with obs_span("gm.build"):
-        arrays, bstats, host_bufs, base = build_morton_shards(
+    t0 = _time.perf_counter()
+    with obs_span("gm.build", stream=bool(stream)):
+        builder = (
+            build_morton_shards_streaming if stream
+            else build_morton_shards
+        )
+        arrays, bstats, host_bufs, base = builder(
             points, n_shards, block, sharding, eps=eps
         )
+    t_build = _time.perf_counter() - t0
     owned, omsk, ogid = arrays
     cap = int(bstats["owned_cap"])
 
+    t0 = _time.perf_counter()
     (bnd, bmsk, bgid), xstats = _gm_boundary_tiles(
         arrays, eps, mesh=mesh, axis=axis, block=block, btcap=btcap,
         base=base,
     )
+    t_exchange = _time.perf_counter() - t0
     brows = int(bnd.shape[1])
     be = gm_backend(backend, metric, cap + brows, cap, block, k, precision)
     hint_key = (
@@ -840,7 +1504,10 @@ def global_morton_dbscan(
     stats = {
         k_: bstats[k_]
         for k_ in ("owned_cap", "n_shard_partitions", "pad_waste",
-                   "partition_sizes", "parity")
+                   "partition_sizes", "parity", "input",
+                   "stream_buckets", "stream_max_bucket_rows",
+                   "stream_sample_rows", "spill_bytes")
+        if k_ in bstats
     }
     stats.update(xstats)
     stats.update(
@@ -868,17 +1535,22 @@ def global_morton_dbscan(
             # The host union-find merge is exact — no rounds ladder.
             return out[:3], out[3], True
 
+        t0 = _time.perf_counter()
         with obs_span("gm.execute", merge="host"):
             (own_glab, own_core, halo_glab), pstats = run_ladders(
                 run_step, hint_key, pair_budget, merge_rounds
             )
+        t_execute = _time.perf_counter() - t0
+        t0 = _time.perf_counter()
         with obs_span("gm.merge_host"):
             labels, core = _host_merge_finish(
                 n, ogid, own_glab, own_core, bgid, halo_glab
             )
+        t_merge = _time.perf_counter() - t0
         stats.update(merge="host", fixpoint_rounds=0)
     else:
         rounds_cell = [0]
+        merge_s_cell = [0.0]
 
         def run_step(pb, mr):
             faults.maybe_fail("gm.execute")
@@ -892,6 +1564,7 @@ def global_morton_dbscan(
                 ),
                 be,
             )
+            t_fix = _time.perf_counter()
             with obs_span("gm.fixpoint") as sp:
                 lab_map, rounds, converged = _gm_fixpoint(
                     home_label, core_g, bgid, b_glab, mesh=mesh,
@@ -899,9 +1572,11 @@ def global_morton_dbscan(
                     jobstate=jobstate, budget_tag=int(pb or 0),
                 )
                 sp.set(rounds=rounds, converged=converged)
+            merge_s_cell[0] = _time.perf_counter() - t_fix
             rounds_cell[0] = rounds
             return (home_label, core_g, lab_map), pstats, converged
 
+        t0 = _time.perf_counter()
         with obs_span("gm.execute", merge="device"):
             try:
                 (home_label, core_g, lab_map), pstats = run_ladders(
@@ -924,8 +1599,11 @@ def global_morton_dbscan(
                     metric=metric, block=block, mesh=mesh,
                     precision=precision, backend=backend, merge="host",
                     pair_budget=pair_budget, merge_rounds=merge_rounds,
-                    btcap=btcap, jobstate=jobstate,
+                    btcap=btcap, stream=stream, chain=chain,
+                    jobstate=jobstate,
                 )
+        t_merge = merge_s_cell[0]
+        t_execute = _time.perf_counter() - t0 - t_merge
         lab_np = np.asarray(lab_map)
         home_np = np.asarray(home_label)
         final = np.where(
@@ -940,6 +1618,14 @@ def global_morton_dbscan(
             merge_converged=True, fixpoint_rounds=int(rounds_cell[0]),
         )
 
+    # Build / exchange / compute / merge decomposition (the north-star
+    # artifact row's columns; surfaced as report() phases).
+    stats.update(
+        gm_build_s=round(t_build, 6),
+        gm_exchange_s=round(t_exchange, 6),
+        gm_execute_s=round(max(t_execute, 0.0), 6),
+        gm_merge_s=round(t_merge, 6),
+    )
     _exec_stats(stats, oc_on=True, pstats=pstats, block=block, k=k,
                 precision=precision, n=n)
     # Zero duplicated ROWS by construction: every point is neighbor-

@@ -1921,8 +1921,10 @@ def sharded_dbscan(
     partitioner, no halo slabs, ``duplicated_work_factor == 1.0`` by
     construction — and only boundary TILES ride the exchange ring.
     Under that mode ``partitioner`` may be None and the KD-specific
-    knobs (``halo``/``hcap``/``stream``/``owner_computes``/``overlap``)
-    are ignored.
+    knobs (``halo``/``hcap``/``owner_computes``/``overlap``) are
+    ignored; ``stream`` threads through (``None`` auto-streams memmap
+    inputs via the external sample-sort build, so the fastest engine
+    is no longer the only one that cannot run out-of-core).
 
     ``owner_computes`` (default True) clusters each device's OWNED
     slots only: halo slots contribute neighbor counts and relay
@@ -1981,11 +1983,15 @@ def sharded_dbscan(
     if mode == "global_morton":
         from .global_morton import global_morton_dbscan
 
+        # ``stream`` threads through (None auto-enables the external
+        # sample-sort build for memmap inputs — the same dispatch the
+        # KD ring route has below); the KD-only knobs stay ignored.
         return global_morton_dbscan(
             points, eps=eps, min_samples=min_samples, metric=metric,
             block=block, mesh=mesh, precision=precision, backend=backend,
             merge=merge, pair_budget=pair_budget,
-            merge_rounds=merge_rounds,
+            merge_rounds=merge_rounds, stream=stream,
+            jobstate=jobstate,
         )
     if mode != "kd":
         raise ValueError(

@@ -23,6 +23,9 @@ Split strategies (names and semantics from the reference):
 
 from __future__ import annotations
 
+import os
+import shutil
+import tempfile
 import time
 from collections import deque
 from typing import Dict, Optional
@@ -132,6 +135,31 @@ def interleave_bit_words(q_axes, bits: int, word_bits: int, zeros, shift):
     return words
 
 
+def _morton_quantize_words(points: np.ndarray, lo, span, bits: int):
+    """Quantize an (M, k) chunk against a FIXED (lo, span) frame and
+    interleave into uint64 words.
+
+    The elementwise body of :func:`morton_codes`, factored out so the
+    streaming external sort (:func:`morton_range_split_streaming`) can
+    key memmap chunks one at a time against the globally-computed frame
+    and stay byte-identical to the in-RAM keying — quantization and
+    interleave are elementwise, so chunking cannot change a single bit.
+    """
+    k = points.shape[1]
+    if k == 0 or bits == 0:
+        return [np.zeros(len(points), dtype=np.uint64)]
+    q = np.minimum(
+        ((points - lo) / span * (1 << bits)).astype(np.uint64), (1 << bits) - 1
+    )
+    return interleave_bit_words(
+        [q[:, a] for a in range(k)],
+        bits,
+        64,
+        lambda: np.zeros(len(points), dtype=np.uint64),
+        np.uint64,
+    )
+
+
 def morton_codes(points: np.ndarray):
     """Morton (Z-order) code words for (N, k) points.
 
@@ -155,16 +183,7 @@ def morton_codes(points: np.ndarray):
     # Floor must not underflow the input dtype (1e-300 is 0 in float32,
     # which made all-equal axes divide by zero).
     span = np.maximum(points.max(axis=0) - lo, np.finfo(points.dtype).tiny)
-    q = np.minimum(
-        ((points - lo) / span * (1 << bits)).astype(np.uint64), (1 << bits) - 1
-    )
-    return interleave_bit_words(
-        [q[:, a] for a in range(k)],
-        bits,
-        64,
-        lambda: np.zeros(len(points), dtype=np.uint64),
-        np.uint64,
-    )
+    return _morton_quantize_words(points, lo, span, bits)
 
 
 def expanded_members(tree, points: np.ndarray, margin: float):
@@ -257,18 +276,9 @@ def spatial_order(points: np.ndarray) -> np.ndarray:
     return np.lexsort(words[::-1])  # np.lexsort: last key is primary
 
 
-def _morton_range_weights(sub: np.ndarray, order: np.ndarray,
-                          block: int, eps: float,
-                          max_cols: int = 4096) -> np.ndarray:
-    """Per-tile work estimate for the balanced range split: the number
-    of live (box-gap <= eps) column tiles each row tile of the sorted
-    layout sees — exactly the tiled kernels' cost model (work = live
-    tile pairs x block^2), computed on (nt, k) host boxes in
-    milliseconds.  Past ``max_cols`` tiles the column side is sampled
-    on an even stride (Morton-adjacent tiles are spatially redundant,
-    so a stride is representative) and the count scaled back up — the
-    estimate only has to RANK density, the split quantizes it anyway.
-    """
+def _tile_boxes_inram(sub: np.ndarray, order: np.ndarray,
+                      block: int):
+    """(nt, k) per-tile f32 bounding boxes of the sorted layout."""
     n, k = sub.shape
     nt = -(-n // block)
     lo = np.empty((nt, k), np.float32)
@@ -283,11 +293,27 @@ def _morton_range_weights(sub: np.ndarray, order: np.ndarray,
         tiles = rows.reshape(t1 - t0, block, k)
         lo[t0:t1] = tiles.min(axis=1)
         hi[t0:t1] = tiles.max(axis=1)
+    return lo, hi
+
+
+def _weights_from_boxes(lo: np.ndarray, hi: np.ndarray, eps: float,
+                        max_cols: int = 4096) -> np.ndarray:
+    """Per-tile live-column counts from (nt, k) tile boxes — the tiled
+    kernels' own cost model, shared between the in-RAM and the
+    streaming range splits so work-balanced cuts are byte-identical
+    whichever builder produced the boxes (f32 tile min/max is exact and
+    order-independent, so the boxes themselves already match)."""
+    nt, k = lo.shape
     stride = max(1, -(-nt // max_cols))
     clo, chi = lo[::stride], hi[::stride]
     eps2 = np.float32(eps) ** 2
     w = np.zeros(nt)
-    chunk = max(1, (1 << 26) // max(len(clo) * k, 1))
+    # Row-chunk the (chunk, cols, k) gap broadcast to ~8M elements:
+    # the old 2^26 budget meant three ~270MB f32 temps live at once at
+    # the 10M geometry — the single biggest transient of the whole
+    # streaming build.  Chunking is along rows only, so w is
+    # byte-identical at any budget.
+    chunk = max(1, (1 << 23) // max(len(clo) * k, 1))
     for s in range(0, nt, chunk):
         e = min(s + chunk, nt)
         gap = np.maximum(
@@ -297,6 +323,42 @@ def _morton_range_weights(sub: np.ndarray, order: np.ndarray,
         )
         w[s:e] = (np.sum(gap * gap, axis=-1) <= eps2).sum(axis=1)
     return w * stride
+
+
+def _morton_range_weights(sub: np.ndarray, order: np.ndarray,
+                          block: int, eps: float,
+                          max_cols: int = 4096) -> np.ndarray:
+    """Per-tile work estimate for the balanced range split: the number
+    of live (box-gap <= eps) column tiles each row tile of the sorted
+    layout sees — exactly the tiled kernels' cost model (work = live
+    tile pairs x block^2), computed on (nt, k) host boxes in
+    milliseconds.  Past ``max_cols`` tiles the column side is sampled
+    on an even stride (Morton-adjacent tiles are spatially redundant,
+    so a stride is representative) and the count scaled back up — the
+    estimate only has to RANK density, the split quantizes it anyway.
+    """
+    lo, hi = _tile_boxes_inram(sub, order, block)
+    return _weights_from_boxes(lo, hi, eps, max_cols)
+
+
+_CENTER_CHUNK = 1 << 20
+
+
+def _chunked_center(points, n: int, k: int,
+                    chunk: int = _CENTER_CHUNK) -> np.ndarray:
+    """float64 dataset mean by fixed-size chunked accumulation.
+
+    One definition for BOTH the in-RAM and streaming range splits:
+    floating-point summation is grouping-sensitive, so the two paths
+    must consume identical chunk boundaries (``_CENTER_CHUNK`` rows) to
+    produce a byte-identical center — the recentred-f32 frame every
+    downstream slab row and sort key lives in.
+    """
+    acc = np.zeros(k, np.float64)
+    for s in range(0, n, chunk):
+        acc += np.sum(points[s:min(s + chunk, n)], axis=0,
+                      dtype=np.float64)
+    return acc / max(n, 1)
 
 
 def _balanced_starts(w: np.ndarray, n: int, block: int,
@@ -359,10 +421,14 @@ def morton_range_split(points: np.ndarray, n_ranges: int,
     in, :func:`pypardis_tpu.parallel.sharded._recentre_rows`), so slab
     rows and sort keys can never disagree about borderline ordering.
 
-    Requires an in-RAM row-indexable array: the keying materializes one
-    f32 copy of the dataset (the KD ring/streaming path remains the
-    memmap route).  Returns ``(order, starts, center)``: ``order`` the
-    (N,) int32 global Morton permutation, ``starts`` the
+    This path materializes one f32 copy of the dataset plus the full
+    (N,) permutation, so it wants the input comfortably in host RAM.
+    Datasets that do not fit take
+    :func:`morton_range_split_streaming` — an external sample-sort
+    over memmap chunks producing the byte-identical per-range order,
+    starts, and center with host memory bounded by O(chunk + sample +
+    one spill bucket).  Returns ``(order, starts, center)``: ``order``
+    the (N,) int32 global Morton permutation, ``starts`` the
     (n_ranges + 1,) int64 range boundaries (equal ``ceil(N /
     n_ranges)``-row ranges, or work-balanced cuts when ``eps`` and
     ``block`` are given), ``center`` the float64 dataset mean.
@@ -370,7 +436,10 @@ def morton_range_split(points: np.ndarray, n_ranges: int,
     points = np.asarray(points)
     n, k = points.shape
     n_ranges = max(1, int(n_ranges))
-    center = points.mean(axis=0, dtype=np.float64)
+    # Chunked f64 accumulation (not np.mean): the ONE center definition
+    # shared with the streaming split, so the two paths' recentred-f32
+    # frames are byte-identical (see _chunked_center).
+    center = _chunked_center(points, n, k)
     sub = np.empty((n, k), np.float32)
     for s in range(0, n, chunk):
         e = min(s + chunk, n)
@@ -386,6 +455,400 @@ def morton_range_split(points: np.ndarray, n_ranges: int,
         )
     del sub
     return order, starts, center
+
+
+# ---------------------------------------------------------------------------
+# Streaming external sample-sort over memmap chunks (ISSUE 10 tentpole)
+# ---------------------------------------------------------------------------
+
+
+def _lex_searchsorted(cols, spl_cols) -> np.ndarray:
+    """Vectorized lexicographic bucket assignment.
+
+    ``cols``: per-row key columns (most-significant first; the last is
+    a unique tiebreak, e.g. the row id); ``spl_cols``: the splitters'
+    matching columns, lexicographically ascending.  Returns, for each
+    row, the count of splitters <= the row's key — i.e. its bucket
+    index in ``[0, len(splitters)]``.  Because the composite key is
+    UNIQUE (the id column), all-duplicate coordinate geometries still
+    spread evenly across buckets instead of collapsing into one.
+    """
+    n = len(cols[0])
+    b1 = len(spl_cols[0])
+    lo = np.zeros(n, np.int64)
+    if b1 == 0:
+        return lo
+    hi = np.full(n, b1, np.int64)
+    while True:
+        active = lo < hi
+        if not active.any():
+            return lo
+        mid = (lo + hi) >> 1
+        midc = np.minimum(mid, b1 - 1)
+        # le[r] = splitter[mid[r]] <= row r, by column cascade.
+        le = np.zeros(n, bool)
+        decided = np.zeros(n, bool)
+        for c, sc in zip(cols, spl_cols):
+            sv = sc[midc]
+            le |= ~decided & (sv < c)
+            decided |= sv != c
+        le |= ~decided  # fully equal -> <=
+        lo = np.where(active & le, mid + 1, lo)
+        hi = np.where(active & ~le, mid, hi)
+
+
+def _accum_tile_boxes(tlo, thi, rows, gpos: int, block: int) -> None:
+    """Fold sorted rows at global positions [gpos, gpos+len) into the
+    per-tile min/max boxes — exact whatever chunking delivers them."""
+    m, _k = rows.shape
+    if m == 0:
+        return
+    pos = 0
+    head = (-gpos) % block
+    if head:
+        h = min(head, m)
+        t = gpos // block
+        np.minimum(tlo[t], rows[:h].min(axis=0), out=tlo[t])
+        np.maximum(thi[t], rows[:h].max(axis=0), out=thi[t])
+        pos = h
+    full = (m - pos) // block
+    if full:
+        t0 = (gpos + pos) // block
+        tiles = rows[pos:pos + full * block].reshape(full, block, -1)
+        np.minimum(tlo[t0:t0 + full], tiles.min(axis=1),
+                   out=tlo[t0:t0 + full])
+        np.maximum(thi[t0:t0 + full], tiles.max(axis=1),
+                   out=thi[t0:t0 + full])
+        pos += full * block
+    if pos < m:
+        t = (gpos + pos) // block
+        np.minimum(tlo[t], rows[pos:].min(axis=0), out=tlo[t])
+        np.maximum(thi[t], rows[pos:].max(axis=0), out=thi[t])
+
+
+class MortonStreamSplit:
+    """The streaming global-Morton split's product handle.
+
+    Produced by :func:`morton_range_split_streaming`.  Holds the range
+    boundaries / center / per-tile boxes as tiny metadata plus one
+    sorted on-disk spill file; per-range rows are read back on demand
+    (:meth:`range_rows` / :meth:`iter_range_rows`) so no caller ever
+    needs the full sorted array or the full permutation in host RAM.
+    Spill files are tempdir-scoped: :meth:`close` (also via context
+    manager and best-effort ``__del__``) removes the directory on both
+    success and failure paths.
+    """
+
+    def __init__(self, n: int, k: int, starts: np.ndarray,
+                 center: np.ndarray, spill_dir: str, sorted_path: str,
+                 rec2, tile_lo, tile_hi, stats: Dict):
+        self.n = int(n)
+        self.k = int(k)
+        self.starts = np.asarray(starts, dtype=np.int64)
+        self.center = np.asarray(center, dtype=np.float64)
+        self.tile_lo = tile_lo
+        self.tile_hi = tile_hi
+        self.stats = dict(stats)
+        self._spill_dir = spill_dir
+        self._sorted_path = sorted_path
+        self._rec2 = rec2
+        self._closed = False
+
+    @property
+    def n_ranges(self) -> int:
+        return len(self.starts) - 1
+
+    def _read(self, a: int, b: int) -> np.ndarray:
+        if self._closed:
+            raise RuntimeError("MortonStreamSplit is closed")
+        itemsize = self._rec2.itemsize
+        with open(self._sorted_path, "rb") as f:
+            f.seek(a * itemsize)
+            buf = f.read((b - a) * itemsize)
+        return np.frombuffer(buf, dtype=self._rec2)
+
+    def range_rows(self, s: int):
+        """(ids int32, rows f32 (m, k)) of range ``s`` — the recentred
+        f32 rows in global Morton order, exactly what
+        ``_recentre_rows(points, order[a:b], center)`` returns on the
+        in-RAM path (pinned)."""
+        a, b = int(self.starts[s]), int(self.starts[s + 1])
+        arr = self._read(a, b)
+        return arr["id"].astype(np.int32), arr["x"]
+
+    def iter_range_rows(self, s: int, chunk: int = 1 << 16):
+        """Yield ``(offset, ids int32, rows f32)`` pieces of range
+        ``s`` so callers can fill slabs without ever materializing a
+        whole range (the 100M-run memory contract)."""
+        a, b = int(self.starts[s]), int(self.starts[s + 1])
+        for c in range(a, b, chunk):
+            e = min(c + chunk, b)
+            arr = self._read(c, e)
+            yield c - a, arr["id"].astype(np.int32), arr["x"]
+
+    def range_ids(self, s: int) -> np.ndarray:
+        """The int32 global Morton order restricted to range ``s``."""
+        return self.range_rows(s)[0]
+
+    def row_span(self, a: int, b: int):
+        """(ids, rows) for an arbitrary global sorted-position span —
+        the chained route's tile-granular boundary reads."""
+        arr = self._read(int(a), int(b))
+        return arr["id"].astype(np.int32), arr["x"]
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            shutil.rmtree(self._spill_dir, ignore_errors=True)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):  # best-effort: tempdir never outlives the handle
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+
+def morton_range_split_streaming(
+    points, n_ranges: int, eps: float = None, block: int = None,
+    chunk: int = 1 << 17, spill_dir: Optional[str] = None,
+    bucket_bytes: Optional[int] = None,
+    sample_per_bucket: int = 512, seed: int = 0,
+) -> MortonStreamSplit:
+    """External sample-sort for the global Morton order.
+
+    The out-of-core twin of :func:`morton_range_split`: ``points`` is
+    any row-sliceable (N, k) array — typically a disk-backed
+    ``np.memmap`` — and host anonymous memory stays bounded by
+    O(chunk + sample + one spill bucket + one range) instead of the
+    in-RAM path's full f32 copy + full permutation.  Three passes:
+
+    1. **scan** — chunked f64 center accumulation (the shared
+       :func:`_chunked_center` grouping, so the recentred frame is
+       byte-identical to the in-RAM split) plus exact per-axis extrema;
+       then a uniform row sample is keyed in the recentred-f32 frame
+       and ``B - 1`` splitter keys are read off its quantiles.
+       Splitters live in the UNIQUE composite key domain
+       ``(morton words..., row id)`` — the id tiebreak is exactly what
+       a stable sort uses, so a degenerate all-duplicate-rows geometry
+       (every Morton key identical) still buckets evenly instead of
+       spilling the dataset into one bucket.
+    2. **bucket-append** — each chunk is recentred, Morton-keyed
+       against the global frame (:func:`_morton_quantize_words` — the
+       in-RAM keying, elementwise), and its rows (int64 id + f32
+       coords + key words) appended to per-bucket spill files.
+    3. **per-bucket sort** — each bucket alone is loaded, stably
+       sorted by (words, id), and appended to one sorted spill file;
+       per-tile bounding boxes of the global sorted layout accumulate
+       on the way through.  Concatenated buckets ARE the stable global
+       Morton sort (buckets partition the key domain in order; the id
+       column reproduces stability), so every range read is
+       byte-identical to ``order[a:b]`` of the in-RAM split — pinned
+       by tests/test_global_morton.py.
+
+    ``starts`` then come from the SAME formulas as the in-RAM split:
+    equal rows, or work-balanced cuts via :func:`_weights_from_boxes`
+    over the streamed tile boxes when ``eps`` and ``block`` are given
+    — byte-identical either way.
+
+    Bucket count is sized so one bucket's spill records fit in
+    ``bucket_bytes`` (default ``PYPARDIS_STREAM_BUCKET_MB``, 32MB —
+    the bucket sort holds ~2.5 bucket-sized temps, and 32MB keeps the
+    whole sort under the one-shard term of the memory budget);
+    with ``sample_per_bucket`` splitter samples per bucket the max
+    bucket stays within ~1.5x the equal share with overwhelming
+    probability (NOWSort-style sample-sort bound; the realized max is
+    reported in ``stats['stream_max_bucket_rows']``).  Spill lives in
+    a fresh tempdir under ``spill_dir`` (default
+    ``PYPARDIS_SPILL_DIR`` or the system tempdir) and is removed by
+    :meth:`MortonStreamSplit.close` on success and failure alike.
+
+    For d > 32 the axis subset is chosen by chunked-moment variance —
+    the same axes as the in-RAM split up to f32-vs-f64 variance
+    rounding on near-tied axes; byte parity is pinned for d <= 32
+    (every axis keyed).
+    """
+    n, k = points.shape
+    n_ranges = max(1, int(n_ranges))
+    if n >= np.iinfo(np.int32).max:
+        raise ValueError(
+            "morton_range_split_streaming: N must fit int32 gids"
+        )
+    center = _chunked_center(points, n, k)
+
+    # -- pass 1: exact extrema (+ moments for the d>32 axis subset) ----
+    lo_raw = np.full(k, np.inf)
+    hi_raw = np.full(k, -np.inf)
+    sumsq = np.zeros(k, np.float64)
+    for s in range(0, n, _CENTER_CHUNK):
+        c = np.asarray(points[s:min(s + _CENTER_CHUNK, n)])
+        np.minimum(lo_raw, c.min(axis=0), out=lo_raw)
+        np.maximum(hi_raw, c.max(axis=0), out=hi_raw)
+        if k > 32:
+            d = c.astype(np.float64) - center
+            sumsq += np.sum(d * d, axis=0)
+    ka, bits = morton_plan(k)
+    axes = np.arange(k)
+    if k > ka:
+        axes = np.sort(np.argsort(sumsq / max(n, 1))[::-1][:ka])
+    # f32(x - center) is monotone in x, so the recentred-f32 extrema
+    # are the recentred raw extrema — byte-equal to sub.min()/max() of
+    # the in-RAM path's full f32 copy.
+    lo32 = np.empty(k, np.float32)
+    hi32 = np.empty(k, np.float32)
+    np.subtract(lo_raw, center, out=lo32, casting="unsafe")
+    np.subtract(hi_raw, center, out=hi32, casting="unsafe")
+    lo32, hi32 = lo32[axes], hi32[axes]
+    span = np.maximum(hi32 - lo32, np.finfo(np.float32).tiny)
+    n_words = max(1, -(-bits * len(axes) // 64)) if len(axes) else 1
+
+    def _keys(sub_chunk):
+        return _morton_quantize_words(sub_chunk[:, axes], lo32, span,
+                                      bits)
+
+    def _recentred(s, e):
+        sub = np.empty((e - s, k), np.float32)
+        np.subtract(np.asarray(points[s:e]), center, out=sub,
+                    casting="unsafe")
+        return sub
+
+    # -- splitters from a uniform sample -------------------------------
+    rec_bytes = 8 * n_words + 8 + 4 * k
+    if bucket_bytes is None:
+        bucket_bytes = int(float(os.environ.get(
+            "PYPARDIS_STREAM_BUCKET_MB", 32)) * 1e6)
+    n_buckets = int(min(max(1, -(-n * rec_bytes // max(bucket_bytes, 1))),
+                        512))
+    rng = np.random.default_rng(seed)
+    n_sample = int(min(n, max(4096, sample_per_bucket * n_buckets)))
+    sampled = 0
+    if n_buckets > 1 and n:
+        sample_ids = np.unique(rng.integers(0, n, n_sample))
+        sampled = len(sample_ids)
+        sw = _keys(_recentred_rows_at(points, sample_ids, center, k))
+        s_order = np.lexsort(
+            (sample_ids,) + tuple(sw[::-1])
+        )
+        pos = (np.arange(1, n_buckets)
+               * len(sample_ids)) // n_buckets
+        sel = s_order[pos]
+        spl_cols = [w[sel] for w in sw] + [sample_ids[sel].astype(
+            np.int64)]
+    else:
+        n_buckets = 1
+        spl_cols = None
+
+    # -- pass 2: bucket-append spill -----------------------------------
+    base_dir = spill_dir or os.environ.get("PYPARDIS_SPILL_DIR")
+    sdir = tempfile.mkdtemp(prefix="pypardis_gm_spill_", dir=base_dir)
+    rec = np.dtype([("w", "<u8", (n_words,)), ("id", "<i8"),
+                    ("x", "<f4", (k,))])
+    rec2 = np.dtype([("id", "<i8"), ("x", "<f4", (k,))])
+    try:
+        counts = np.zeros(n_buckets, np.int64)
+        files = [open(os.path.join(sdir, f"b{b:04d}.bin"), "wb")
+                 for b in range(n_buckets)]
+        try:
+            for s in range(0, n, chunk):
+                e = min(s + chunk, n)
+                sub = _recentred(s, e)
+                words = _keys(sub)
+                ids = np.arange(s, e, dtype=np.int64)
+                arr = np.empty(e - s, rec)
+                for j, w in enumerate(words):
+                    arr["w"][:, j] = w
+                arr["id"] = ids
+                arr["x"] = sub
+                if n_buckets > 1:
+                    bkt = _lex_searchsorted(words + [ids], spl_cols)
+                    order = np.argsort(bkt, kind="stable")
+                    arr = arr[order]
+                    bounds = np.searchsorted(
+                        bkt[order], np.arange(n_buckets + 1)
+                    )
+                else:
+                    bounds = np.array([0, e - s])
+                for b in range(n_buckets):
+                    a0, a1 = int(bounds[b]), int(bounds[b + 1])
+                    if a1 > a0:
+                        files[b].write(arr[a0:a1].tobytes())
+                        counts[b] += a1 - a0
+        finally:
+            for f in files:
+                f.close()
+
+        # -- pass 3: sort each bucket alone, stream tile boxes ---------
+        nt = -(-n // block) if block else 0
+        tlo = np.full((nt, k), np.float32(np.inf)) if nt else None
+        thi = np.full((nt, k), np.float32(-np.inf)) if nt else None
+        sorted_path = os.path.join(sdir, "sorted.bin")
+        gpos = 0
+        with open(sorted_path, "wb") as out:
+            for b in range(n_buckets):
+                path = os.path.join(sdir, f"b{b:04d}.bin")
+                raw = np.fromfile(path, dtype=rec)
+                os.unlink(path)
+                if len(raw) == 0:
+                    continue
+                perm = np.lexsort(
+                    (raw["id"],) + tuple(
+                        raw["w"][:, j]
+                        for j in range(n_words - 1, -1, -1)
+                    )
+                )
+                srt = raw[perm]
+                del raw, perm
+                # Piecewise re-pack + write: a whole-bucket rec2 copy
+                # plus its tobytes() was two more bucket-sized temps
+                # live at the sort's peak for no reason.
+                piece = 1 << 17
+                for p0 in range(0, len(srt), piece):
+                    p1 = min(p0 + piece, len(srt))
+                    o2 = np.empty(p1 - p0, rec2)
+                    o2["id"] = srt["id"][p0:p1]
+                    o2["x"] = srt["x"][p0:p1]
+                    out.write(o2.tobytes())
+                    del o2
+                if nt:
+                    _accum_tile_boxes(tlo, thi, srt["x"], gpos, block)
+                gpos += len(srt)
+                del srt
+
+        # -- starts: the in-RAM formulas, verbatim ---------------------
+        if eps is not None and block is not None and n_ranges > 1 and n:
+            w = _weights_from_boxes(tlo, thi, float(eps))
+            starts = _balanced_starts(w, n, int(block), n_ranges)
+        else:
+            per = -(-n // n_ranges)
+            starts = np.minimum(
+                np.arange(n_ranges + 1, dtype=np.int64) * per, n
+            )
+        stats = {
+            "stream_buckets": int(n_buckets),
+            "stream_max_bucket_rows": int(counts.max()) if n else 0,
+            "stream_sample_rows": int(sampled),
+            "spill_bytes": int(n * (rec.itemsize + rec2.itemsize)),
+        }
+        return MortonStreamSplit(
+            n, k, starts, center, sdir, sorted_path, rec2, tlo, thi,
+            stats,
+        )
+    except BaseException:
+        shutil.rmtree(sdir, ignore_errors=True)
+        raise
+
+
+def _recentred_rows_at(points, ids, center, k):
+    """Gather + recentre specific rows (sample keying)."""
+    sub = np.empty((len(ids), k), np.float32)
+    np.subtract(np.asarray(points[ids]), center, out=sub,
+                casting="unsafe")
+    return sub
 
 
 class MortonRangePartitioner:
@@ -502,7 +965,10 @@ class KDPartitioner:
     the identical stream (regression-pinned).  ``"legacy"`` keeps the
     original node-at-a-time builder; ``"auto"`` selects it for
     ``np.memmap`` inputs, where the level buffer's +1x dataset copy
-    would defeat the larger-than-RAM streaming premise.
+    would defeat the larger-than-RAM streaming premise.  (Memmaps that
+    want the zero-duplication engine skip KD partitioning entirely:
+    ``mode="global_morton"`` keys them through the external
+    sample-sort, :func:`morton_range_split_streaming`.)
 
     ``level_times_s`` records per-level build seconds for either
     builder — surfaced as ``partition_levels_s`` in
@@ -551,6 +1017,11 @@ class KDPartitioner:
                 f"builder must be one of {_VALID_BUILDERS}, got {builder!r}"
             )
         if builder == "auto":
+            # Memmaps keep the O(index)-memory legacy build on the KD
+            # route (the level buffer would copy the dataset); the
+            # streaming GLOBAL-MORTON route never builds a KD tree at
+            # all — morton_range_split_streaming external-sorts the
+            # memmap with O(chunk + bucket) host memory instead.
             builder = "legacy" if isinstance(data, np.memmap) else "level"
         self.builder = builder
         self.level_times_s: list = []

@@ -297,6 +297,56 @@ def main() -> None:
                 f"{rep.get('per_device_index_bytes')!r}"
             )
 
+    # North-star contract (ISSUE 10 / ROADMAP item 1): a northstar row
+    # is the measured 100M-trajectory artifact — it must decompose the
+    # fit into finite build / exchange / compute / merge seconds that
+    # actually account for the wall (no silent unattributed time), say
+    # what ran (n / dim / mode / devices), carry the sampled peak
+    # RssAnon (the out-of-core claim is a MEASURED number, not prose),
+    # and state whether checkpoint-resume replayed prior work.  The
+    # clean-row faults.injected==0 gate above already applies.
+    if str(row["metric"]).startswith("northstar"):
+        if row.get("schema") != "pypardis_tpu/northstar@1":
+            fail(f"northstar row schema is {row.get('schema')!r}")
+        for key in ("n", "dim", "mesh_devices"):
+            v = row.get(key)
+            if not isinstance(v, int) or v <= 0:
+                fail(f"northstar row.{key} is {v!r}, expected int > 0")
+        if row.get("mode") not in ("gm_mesh", "gm_chained"):
+            fail(f"northstar row.mode is {row.get('mode')!r}")
+        comps = {}
+        for key in ("build_s", "exchange_s", "compute_s", "merge_s"):
+            v = row.get(key)
+            if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                    or v != v or v < 0 or v == float("inf"):
+                fail(f"northstar row.{key} is {v!r}, expected a "
+                     f"finite number >= 0")
+            comps[key] = float(v)
+        wall = float(row["value"])
+        total = sum(comps.values())
+        if total > wall * 1.02 + 0.5:
+            fail(
+                f"northstar phase seconds sum to {total:.3f}s, above "
+                f"the {wall:.3f}s wall"
+            )
+        if total < 0.4 * wall - 0.5:
+            fail(
+                f"northstar phase seconds sum to {total:.3f}s — less "
+                f"than 40% of the {wall:.3f}s wall is attributed; the "
+                f"decomposition is not honest"
+            )
+        rss = row.get("rss_anon_peak_gb")
+        if not isinstance(rss, (int, float)) or isinstance(rss, bool) \
+                or rss != rss or rss <= 0:
+            fail(f"northstar rss_anon_peak_gb is {rss!r}")
+        if not isinstance(row.get("resume_used"), bool):
+            fail(
+                f"northstar resume_used is {row.get('resume_used')!r}, "
+                f"expected bool"
+            )
+        if tel["sharding"].get("mode") != "global_morton":
+            fail("northstar row did not run the global-Morton engine")
+
     # Regression-gate contract (ISSUE 6): rows produced under `make
     # bench-smoke` ride through bench_diff --annotate first; the
     # verdict must be present and must not be a real regression.

@@ -19,6 +19,14 @@ CPU mesh:
    snapshots) is SIGKILLed mid-fixpoint; ``DBSCAN.train(resume=)`` in a
    fresh process replays the snapshot and produces labels
    byte-identical to the uninterrupted fit.
+5. **streaming-GM fault/resume (ISSUE 10)** — the same ladder coverage
+   on the OUT-OF-CORE route: a ``staging.transfer`` OOM injected into
+   a memmap streaming-GM fit recovers byte-identically through the
+   evict-and-retry rung; a child streaming fit is SIGKILLed
+   mid-fixpoint and ``train(resume=)`` recovers byte-identically; and
+   the external sort's spill files (PYPARDIS_SPILL_DIR-scoped) are
+   verified cleaned up after every fit, including the injected-fault
+   ones.
 
 Emits ONE bench-style JSON row (``metric="fault_probe_scenarios"``)
 whose telemetry block is the FAULTY global-Morton fit's report — so the
@@ -81,6 +89,18 @@ def child_fit(out_path: str, ckpt: str, resume: bool) -> None:
 
     n = int(os.environ.get("FAULT_N", 3000))
     X = chain_data(n)
+    if os.environ.get("FAULT_STREAM"):
+        # Scenario-5 child: the same fit, out-of-core — a disk-backed
+        # memmap through the streaming external sample-sort build.
+        import tempfile
+
+        f = tempfile.NamedTemporaryFile(suffix=".f32")
+        mm = np.memmap(f.name, dtype=np.float32, mode="w+",
+                       shape=X.shape)
+        mm[:] = X
+        mm.flush()
+        X = np.memmap(f.name, dtype=np.float32, mode="r",
+                      shape=mm.shape)
     model = DBSCAN(mode="global_morton", merge="device", **KW)
     model.train(X, resume=ckpt)
     np.savez(
@@ -187,55 +207,102 @@ def main() -> None:
     )
 
     # -- 4: kill/resume parity --------------------------------------------
-    tmp = tempfile.mkdtemp(prefix="fault_probe_")
-    ckpt = os.path.join(tmp, "fit.ckpt.npz")
-    out = os.path.join(tmp, "resumed.npz")
-    killed = False
-    deadline = time.time() + float(os.environ.get(
-        "FAULT_TIMEOUT_S", 300
-    ))
-    for attempt in range(4):
-        if os.path.exists(ckpt):
-            os.unlink(ckpt)
-        hang = 0.4 * (attempt + 1)
-        proc = _run_child(
-            {
-                "PYPARDIS_FAULTS":
-                    f"gm.fixpoint_round:*=hang({hang})",
-                "PYPARDIS_CKPT_EVERY_S": "0",
-            },
-            out, ckpt,
+    def kill_resume(tag, env_extra):
+        tmp = tempfile.mkdtemp(prefix="fault_probe_")
+        ckpt = os.path.join(tmp, "fit.ckpt.npz")
+        out = os.path.join(tmp, "resumed.npz")
+        killed = False
+        deadline = time.time() + float(os.environ.get(
+            "FAULT_TIMEOUT_S", 300
+        ))
+        for attempt in range(4):
+            if os.path.exists(ckpt):
+                os.unlink(ckpt)
+            hang = 0.4 * (attempt + 1)
+            proc = _run_child(
+                {
+                    "PYPARDIS_FAULTS":
+                        f"gm.fixpoint_round:*=hang({hang})",
+                    "PYPARDIS_CKPT_EVERY_S": "0",
+                    **env_extra,
+                },
+                out, ckpt,
+            )
+            try:
+                while time.time() < deadline:
+                    if proc.poll() is not None:
+                        break  # finished before we could kill — retry
+                    if os.path.exists(ckpt):
+                        time.sleep(hang * 0.5)  # land INSIDE a round
+                        break
+                    time.sleep(0.02)
+            finally:
+                alive = proc.poll() is None
+                proc.send_signal(signal.SIGKILL)
+                proc.wait()
+            if alive and os.path.exists(ckpt):
+                killed = True
+                break
+            print(
+                f"fault-probe: attempt {attempt}: kill landed too late "
+                f"(alive={alive}); widening the hang", file=sys.stderr,
+            )
+        check(f"[{tag}] SIGKILL landed mid-fixpoint with a snapshot "
+              f"on disk", killed)
+        rc = _run_child(env_extra, out, ckpt, resume=True).wait()
+        check(f"[{tag}] resumed child fit completed", rc == 0)
+        with np.load(out) as z:
+            resumed = z["labels"]
+            restored = int(z["restored_rounds"])
+        return check(
+            f"[{tag}] kill/resume parity: resumed labels "
+            f"byte-identical (restored_rounds={restored})",
+            np.array_equal(resumed, base_labels) and restored >= 1,
+        ), restored
+
+    got, restored = kill_resume("in-RAM", {})
+    passed += got
+
+    # -- 5: streaming-GM fault/resume + spill hygiene (ISSUE 10) ----------
+    spill_dir = tempfile.mkdtemp(prefix="fault_probe_spill_")
+    os.environ["PYPARDIS_SPILL_DIR"] = spill_dir
+    try:
+        with tempfile.NamedTemporaryFile(suffix=".f32") as f:
+            mm = np.memmap(f.name, dtype=np.float32, mode="w+",
+                           shape=X.shape)
+            mm[:] = X
+            mm.flush()
+            ro = np.memmap(f.name, dtype=np.float32, mode="r",
+                           shape=X.shape)
+            staging.clear()
+            with faults.plan("staging.device_put:1=oom"):
+                sgm = DBSCAN(mode="global_morton", merge="device",
+                             **KW)
+                sgm.fit(ro)
+            srep = sgm.report()
+            stream_ok = (
+                np.array_equal(sgm.labels_, base_labels)
+                and srep["faults"]["injected"] >= 1
+                and srep["sharding"]["input"] == "stream"
+            )
+        spill_clean = os.listdir(spill_dir) == []
+        passed += check(
+            "streaming-GM fit recovered a staging.transfer OOM "
+            "byte-identically and cleaned its spill "
+            f"(injected={srep['faults']['injected']}, "
+            f"spill_clean={spill_clean})",
+            stream_ok and spill_clean,
         )
-        try:
-            while time.time() < deadline:
-                if proc.poll() is not None:
-                    break  # finished before we could kill — retry
-                if os.path.exists(ckpt):
-                    time.sleep(hang * 0.5)  # land INSIDE a later round
-                    break
-                time.sleep(0.02)
-        finally:
-            alive = proc.poll() is None
-            proc.send_signal(signal.SIGKILL)
-            proc.wait()
-        if alive and os.path.exists(ckpt):
-            killed = True
-            break
-        print(
-            f"fault-probe: attempt {attempt}: kill landed too late "
-            f"(alive={alive}); widening the hang", file=sys.stderr,
+        got_stream, restored_stream = kill_resume(
+            "stream", {"FAULT_STREAM": "1"}
         )
-    check("SIGKILL landed mid-fixpoint with a snapshot on disk", killed)
-    rc = _run_child({}, out, ckpt, resume=True).wait()
-    check("resumed child fit completed", rc == 0)
-    with np.load(out) as z:
-        resumed = z["labels"]
-        restored = int(z["restored_rounds"])
-    passed += check(
-        f"kill/resume parity: resumed labels byte-identical "
-        f"(restored_rounds={restored})",
-        np.array_equal(resumed, base_labels) and restored >= 1,
-    )
+        passed += got_stream
+        passed += check(
+            "spill cleaned after streaming kill/resume children",
+            os.listdir(spill_dir) == [],
+        )
+    finally:
+        del os.environ["PYPARDIS_SPILL_DIR"]
 
     row = {
         "metric": "fault_probe_scenarios",
@@ -245,6 +312,10 @@ def main() -> None:
         "mesh_devices": _N_DEV,
         "kill_resume": {
             "restored_rounds": restored,
+            "labels_match": True,
+        },
+        "kill_resume_stream": {
+            "restored_rounds": restored_stream,
             "labels_match": True,
         },
         "telemetry": rep,

@@ -1,0 +1,203 @@
+"""North-star end-to-end driver (ISSUE 10 / ROADMAP item 1).
+
+Composes the pieces the 100M x 16-D target needs — chunked dataset
+generation straight into a DISK-BACKED memmap (never an in-RAM copy),
+the streaming global-Morton build (external sample-sort), chained
+(1-device) or distributed (mesh) execution, host-spillable merge, and
+``PYPARDIS_CKPT`` checkpoint-resume — and emits ONE schema'd
+``pypardis_tpu/northstar@1`` JSON row decomposing the fit into
+build / exchange / compute / merge seconds plus the sampled peak
+RssAnon, turning the extrapolated <60s claim into a measured
+trajectory.
+
+Knobs (env):
+  NS_N            points (default: 100_000_000 on TPU, else 2_000_000 —
+                  the largest CPU-feasible smoke, committed as
+                  NORTHSTAR_smoke.json)
+  NS_DIM          dimensions (16)
+  NS_EPS          eps (2.4)         NS_MIN_SAMPLES  min_samples (10)
+  NS_BLOCK        kernel block (1024)
+  NS_MERGE        auto|device|host (auto)
+  NS_CHAIN        ranges for the chained 1-device route (default:
+                  ceil(dataset / 512MB), min 8 — only used on a
+                  1-device mesh)
+  NS_DEVICES      mesh size cap (default: all visible devices)
+  NS_DATA         reuse an existing f32 memmap instead of generating
+  NS_ARI          compute ARI vs the generating truth (default 1 when
+                  the dataset is generated here)
+  NS_CKPT         checkpoint path (default: <workdir>/northstar.ckpt)
+
+Usage: python scripts/northstar_run.py [| python scripts/check_bench_json.py]
+"""
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def rss_anon_gb():
+    for line in open("/proc/self/status"):
+        if line.startswith("RssAnon"):
+            return int(line.split()[1]) / 1e6
+    return 0.0
+
+
+class AnonSampler:
+    """Peak anonymous-RSS sampler (RssAnon, not VmHWM: memmap pages are
+    file-backed and evictable — they never pressure the host)."""
+
+    def __init__(self, period=0.05):
+        self.peak = 0.0
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._run, args=(period,),
+                                   daemon=True)
+
+    def _run(self, period):
+        while not self._stop.is_set():
+            self.peak = max(self.peak, rss_anon_gb())
+            time.sleep(period)
+
+    def __enter__(self):
+        self._t.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self._t.join()
+        self.peak = max(self.peak, rss_anon_gb())
+
+
+def gen_blob_memmap(path, truth_path, n, dim, seed=0, spread=10.0,
+                    std=0.4, pts_per_center=6250, chunk=1 << 20):
+    """Chunked blob generation straight to disk — the driver never
+    holds the dataset (or an f64 temp) in RAM.  Same family as
+    benchdata.make_blob_data (uniform centers, one std); truth rides
+    in a second int32 memmap so ARI stays free at any N."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    n_centers = max(32, n // pts_per_center)
+    centers = rng.uniform(-spread, spread, size=(n_centers, dim)).astype(
+        np.float32
+    )
+    X = np.memmap(path, dtype=np.float32, mode="w+", shape=(n, dim))
+    T = np.memmap(truth_path, dtype=np.int32, mode="w+", shape=(n,))
+    for s in range(0, n, chunk):
+        e = min(s + chunk, n)
+        assign = rng.integers(0, n_centers, size=e - s, dtype=np.int32)
+        X[s:e] = centers[assign] + rng.normal(
+            0.0, std, size=(e - s, dim)
+        ).astype(np.float32)
+        T[s:e] = assign
+    X.flush()
+    T.flush()
+    del X, T
+
+
+def main():
+    import jax
+    import numpy as np
+
+    from pypardis_tpu import DBSCAN
+    from pypardis_tpu.parallel import default_mesh
+
+    on_tpu = jax.default_backend() == "tpu"
+    n = int(os.environ.get(
+        "NS_N", 100_000_000 if on_tpu else 2_000_000
+    ))
+    dim = int(os.environ.get("NS_DIM", 16))
+    eps = float(os.environ.get("NS_EPS", 2.4))
+    min_samples = int(os.environ.get("NS_MIN_SAMPLES", 10))
+    block = int(os.environ.get("NS_BLOCK", 1024))
+    merge = os.environ.get("NS_MERGE", "auto")
+    n_dev = min(
+        int(os.environ.get("NS_DEVICES", jax.device_count())),
+        jax.device_count(),
+    )
+    mesh = default_mesh(n_dev)
+
+    workdir = tempfile.mkdtemp(prefix="northstar_")
+    data_path = os.environ.get("NS_DATA")
+    truth_path = None
+    t_gen = 0.0
+    if data_path is None:
+        data_path = os.path.join(workdir, "points.f32")
+        truth_path = os.path.join(workdir, "truth.i32")
+        t0 = time.perf_counter()
+        gen_blob_memmap(data_path, truth_path, n, dim)
+        t_gen = time.perf_counter() - t0
+    ro = np.memmap(data_path, dtype=np.float32, mode="r",
+                   shape=(n, dim))
+
+    chain = 0
+    if n_dev == 1:
+        chain = int(os.environ.get(
+            "NS_CHAIN",
+            max(8, -(-n * dim * 4 // (512 * 1024 * 1024))),
+        ))
+        os.environ["PYPARDIS_GM_CHAIN"] = str(chain)
+    ckpt = os.environ.get(
+        "NS_CKPT", os.path.join(workdir, "northstar.ckpt")
+    )
+
+    model = DBSCAN(
+        eps=eps, min_samples=min_samples, block=block, mesh=mesh,
+        mode="global_morton", merge=merge,
+    )
+    t0 = time.perf_counter()
+    with AnonSampler() as samp:
+        model.train(ro, resume=ckpt)
+    wall = time.perf_counter() - t0
+
+    rep = model.report()
+    phases = rep["phases"]
+    js = model._jobstate
+    resume_used = bool(
+        js is not None
+        and (js.restored_partitions > 0 or js.restored_rounds > 0)
+    )
+    row = {
+        "metric": "northstar_e2e",
+        "value": round(wall, 3),
+        "unit": "s",
+        "schema": "pypardis_tpu/northstar@1",
+        "n": n,
+        "dim": dim,
+        "eps": eps,
+        "min_samples": min_samples,
+        "block": block,
+        "mode": "gm_chained" if chain else "gm_mesh",
+        "mesh_devices": int(n_dev),
+        "chain_ranges": int(chain),
+        "backend": str(jax.default_backend()),
+        "build_s": float(phases.get("gm_build", 0.0)),
+        "exchange_s": float(phases.get("gm_exchange", 0.0)),
+        "compute_s": float(phases.get("gm_execute", 0.0)),
+        "merge_s": float(phases.get("gm_merge", 0.0)),
+        "gen_s": round(t_gen, 3),
+        "pts_per_sec": round(n / wall, 1),
+        "rss_anon_peak_gb": round(samp.peak, 3),
+        "dataset_gb": round(n * dim * 4 / 1e9, 3),
+        "resume_used": resume_used,
+        "telemetry": rep,
+    }
+    if truth_path is not None and os.environ.get("NS_ARI", "1") == "1":
+        from benchdata import ari_vs_truth
+
+        truth = np.memmap(truth_path, dtype=np.int32, mode="r",
+                          shape=(n,))
+        row["ari_vs_truth"] = round(
+            ari_vs_truth(model.labels_, np.asarray(truth)), 4
+        )
+    print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()

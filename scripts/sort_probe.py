@@ -1,4 +1,14 @@
-"""Isolate device-side sort/morton/gather costs at scale."""
+"""Isolate device-side sort/morton/gather costs at scale.
+
+These are the device-side primitives the fused engine's layout pass
+pays; the HOST-side analogue at out-of-core scale is the external
+sample-sort (``partition.morton_range_split_streaming``), timed here
+alongside them when ``--stream`` is passed — one probe for both ends
+of the ROADMAP item 1 sort story.
+
+Usage: python scripts/sort_probe.py N [DIM] [--stream]
+       (makefile: `SORT_N=4000000 make sort-probe`)
+"""
 import sys
 import time
 
@@ -22,8 +32,9 @@ def t(fn, *args, reps=2):
 
 
 def main():
-    n = int(sys.argv[1])
-    d = 16
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    n = int(args[0])
+    d = int(args[1]) if len(args) > 1 else 16
     rng = np.random.default_rng(0)
     keys = [jnp.asarray(rng.integers(0, 2**32, n, dtype=np.uint32))
             for _ in range(4)]
@@ -45,6 +56,35 @@ def main():
     perm = lex1(keys)
     gather = jax.jit(lambda p, i: jnp.take(p, i, axis=1))
     print(f"gather (d,n): {t(gather, pts, perm):.2f}s")
+
+    if "--stream" in sys.argv:
+        import os
+        import tempfile
+
+        sys.path.insert(0, os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        ))
+        from pypardis_tpu.partition import (
+            morton_range_split,
+            morton_range_split_streaming,
+        )
+
+        host = np.asarray(pts).T.copy()  # (n, d) C-layout
+        t0 = time.perf_counter()
+        morton_range_split(host, 8)
+        print(f"host in-RAM morton_range_split: "
+              f"{time.perf_counter() - t0:.2f}s")
+        with tempfile.NamedTemporaryFile(suffix=".f32") as f:
+            mm = np.memmap(f.name, dtype=np.float32, mode="w+",
+                           shape=host.shape)
+            mm[:] = host
+            mm.flush()
+            ro = np.memmap(f.name, dtype=np.float32, mode="r",
+                           shape=host.shape)
+            t0 = time.perf_counter()
+            morton_range_split_streaming(ro, 8).close()
+            print(f"host streaming sample-sort:     "
+                  f"{time.perf_counter() - t0:.2f}s")
 
 
 if __name__ == "__main__":

@@ -243,13 +243,27 @@ def test_mode_input_validation(blobs, tmp_path):
     model = DBSCAN(mode="global_morton", mesh=default_mesh(8), **KW)
     with pytest.raises(ValueError, match="host-resident"):
         model.fit(jax.device_put(np.asarray(blobs)))
+    # A memmap now STREAMS through the external sample-sort build
+    # (ISSUE 10) instead of being rejected; the report says so, and
+    # the parity surface degrades gracefully (ranges + boxes, no O(N)
+    # permutation — partitioner_ stays None).
+    from pypardis_tpu.parallel import staging
+
+    staging.clear()
     mm = np.memmap(
         tmp_path / "x.dat", dtype=np.float32, mode="w+",
         shape=blobs.shape,
     )
     mm[:] = blobs.astype(np.float32)
-    with pytest.raises(ValueError, match="memmap"):
-        DBSCAN(mode="global_morton", mesh=default_mesh(8), **KW).fit(mm)
+    m = DBSCAN(mode="global_morton", mesh=default_mesh(8), **KW)
+    m.fit(mm)
+    assert m.metrics_.get("input") == "stream"
+    assert m.partitioner_ is None
+    rep = m.report()
+    assert rep["sharding"]["input"] == "stream"
+    assert rep["sharding"]["stream_buckets"] >= 1
+    assert "stream" in m.summary()
+    staging.clear()
 
 
 def test_1dev_chained_route_reports_honestly(blobs):
@@ -264,6 +278,183 @@ def test_1dev_chained_route_reports_honestly(blobs):
     assert stats["owner_computes"] is False
     assert np.isfinite(stats["duplicated_work_factor"])
     assert stats["duplicated_work_factor"] > 1.0
+
+
+@pytest.fixture
+def mm_points(tmp_path):
+    """A disk-backed f32 memmap + its in-RAM f32 twin (parity must
+    compare f32-vs-f32 — the memmap rounds the f64 blobs once)."""
+    X, _ = make_blobs(
+        n_samples=3000, centers=6, n_features=3, cluster_std=0.3,
+        random_state=3,
+    )
+    X = X.astype(np.float32)
+    path = tmp_path / "pts.f32"
+    mm = np.memmap(path, dtype=np.float32, mode="w+", shape=X.shape)
+    mm[:] = X
+    mm.flush()
+    return np.memmap(path, dtype=np.float32, mode="r", shape=X.shape), X
+
+
+def test_streaming_split_byte_parity(blobs):
+    """ISSUE 10 satellite: the external sample-sort produces identical
+    (order-per-range, starts, center) to the in-RAM split — plain
+    equal-rows AND work-balanced cuts — with many spill buckets in
+    play (tiny bucket_bytes forces real bucketing)."""
+    from pypardis_tpu.partition import morton_range_split_streaming
+
+    for kw in ({}, dict(eps=0.4, block=128)):
+        order, starts, center = morton_range_split(blobs, 8, **kw)
+        with morton_range_split_streaming(
+            blobs, 8, bucket_bytes=50_000, **kw
+        ) as sp:
+            np.testing.assert_array_equal(sp.center, center)
+            np.testing.assert_array_equal(sp.starts, starts)
+            assert sp.stats["stream_buckets"] > 1
+            cat = np.concatenate(
+                [sp.range_ids(s) for s in range(8)]
+            )
+            np.testing.assert_array_equal(cat, order)
+            # Rows are the recentred-f32 frame rows, byte-for-byte.
+            ids0, rows0 = sp.range_rows(0)
+            ref = (blobs[order[:len(ids0)]] - center).astype(
+                np.float32
+            )
+            np.testing.assert_array_equal(rows0, ref)
+
+
+def test_streaming_split_all_duplicate_rows():
+    """Degenerate geometry where every Morton key collides: the
+    (key, id) composite splitter domain still buckets evenly (the id
+    tiebreak IS stable-sort order), and the order comes back as the
+    identity — byte-identical to the in-RAM stable sort."""
+    from pypardis_tpu.partition import morton_range_split_streaming
+
+    D = np.ones((4096, 3), np.float32)
+    order, starts, center = morton_range_split(D, 8, eps=0.4, block=64)
+    with morton_range_split_streaming(
+        D, 8, eps=0.4, block=64, bucket_bytes=20_000
+    ) as sp:
+        np.testing.assert_array_equal(sp.center, center)
+        np.testing.assert_array_equal(sp.starts, starts)
+        cat = np.concatenate([sp.range_ids(s) for s in range(8)])
+        np.testing.assert_array_equal(cat, order)
+        # Splitter keys collide on coordinates; the id column must
+        # still have spread the rows across several buckets.
+        assert sp.stats["stream_buckets"] > 1
+        assert sp.stats["stream_max_bucket_rows"] < 4096
+
+
+def test_streaming_gm_byte_parity_meshes(mm_points):
+    """Memmap streaming-GM labels byte-match the in-RAM global-Morton
+    fit AND the fused engine on 1/4/8-device meshes, both merges."""
+    from pypardis_tpu.parallel import staging
+
+    mm, X = mm_points
+    kw = dict(eps=0.4, min_samples=5, block=128)
+    fm = DBSCAN(mesh=default_mesh(1), **kw)
+    fm.fit(X)
+    ref = canon(fm.labels_, fm.core_sample_mask_)
+    ref_core = np.asarray(fm.core_sample_mask_)
+    for n_dev, merge in ((1, "device"), (4, "host"), (8, "device"),
+                         (8, "host")):
+        staging.clear()
+        inram, inram_core, _ = global_morton_dbscan(
+            X, mesh=default_mesh(n_dev), merge=merge, **kw
+        )
+        staging.clear()
+        labels, core, stats = global_morton_dbscan(
+            mm, mesh=default_mesh(n_dev), merge=merge, **kw
+        )
+        tag = f"stream gm {n_dev}dev merge={merge}"
+        assert stats["input"] == "stream", tag
+        assert stats["mode"] == "global_morton", tag
+        assert stats["duplicated_work_factor"] == 1.0, tag
+        np.testing.assert_array_equal(labels, inram, err_msg=tag)
+        np.testing.assert_array_equal(core, inram_core, err_msg=tag)
+        np.testing.assert_array_equal(
+            densify_labels(labels), ref, err_msg=tag
+        )
+        np.testing.assert_array_equal(core, ref_core, err_msg=tag)
+        # The out-of-core phase decomposition rides on every row.
+        for key in ("gm_build_s", "gm_exchange_s", "gm_execute_s",
+                    "gm_merge_s"):
+            assert np.isfinite(stats[key]) and stats[key] >= 0, tag
+    staging.clear()
+
+
+def test_streaming_gm_chained_route(mm_points):
+    """The chained 1-device route (ranges visiting one chip in turn)
+    is byte-identical to the mesh engine and reports honestly."""
+    from pypardis_tpu.parallel import staging
+
+    mm, X = mm_points
+    kw = dict(eps=0.4, min_samples=5, block=128)
+    staging.clear()
+    ref, ref_core, _ = global_morton_dbscan(
+        X, mesh=default_mesh(8), **kw
+    )
+    staging.clear()
+    labels, core, stats = global_morton_dbscan(
+        mm, mesh=default_mesh(1), chain=4, **kw
+    )
+    np.testing.assert_array_equal(labels, ref)
+    np.testing.assert_array_equal(core, ref_core)
+    assert stats["mode"] == "global_morton"
+    assert stats["halo_exchange"] == "chained_tiles"
+    assert stats["chained"] is True
+    assert stats["n_shard_partitions"] == 4
+    assert stats["duplicated_work_factor"] == 1.0
+    assert stats["owner_computes"] is True
+    assert stats["boundary_tiles"] > 0
+    # Env-var spelling of the same knob (the northstar driver's path).
+    import os
+
+    staging.clear()
+    os.environ["PYPARDIS_GM_CHAIN"] = "4"
+    try:
+        labels2, _, stats2 = global_morton_dbscan(
+            mm, mesh=default_mesh(1), **kw
+        )
+    finally:
+        del os.environ["PYPARDIS_GM_CHAIN"]
+    np.testing.assert_array_equal(labels2, ref)
+    assert stats2["chained"] is True
+    staging.clear()
+
+
+def test_streaming_spill_cleanup(mm_points, tmp_path):
+    """Spill files are tempdir-scoped and removed on success AND on a
+    terminal failure mid-build (ISSUE 10 satellite)."""
+    import os
+
+    from pypardis_tpu.parallel import staging
+    from pypardis_tpu.utils import faults
+
+    mm, _X = mm_points
+    spill = tmp_path / "spill"
+    spill.mkdir()
+    os.environ["PYPARDIS_SPILL_DIR"] = str(spill)
+    try:
+        staging.clear()
+        global_morton_dbscan(
+            mm, mesh=default_mesh(8), eps=0.4, min_samples=5,
+            block=128,
+        )
+        assert list(spill.iterdir()) == [], "spill left after success"
+        # Persistent transfer OOM: the staging ladder gives up, the
+        # build unwinds — and the spill dir must still come back empty.
+        staging.clear()
+        with faults.plan("staging.device_put:*=oom"):
+            with pytest.raises(Exception):
+                global_morton_dbscan(
+                    mm, mesh=default_mesh(8), eps=0.4, min_samples=5,
+                    block=128,
+                )
+        assert list(spill.iterdir()) == [], "spill left after giveup"
+    finally:
+        del os.environ["PYPARDIS_SPILL_DIR"]
+        staging.clear()
 
 
 def test_morton_range_split_products(blobs):

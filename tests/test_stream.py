@@ -81,6 +81,49 @@ def test_streaming_host_merge_spill(mm_blobs):
     np.testing.assert_array_equal(labels, ref)
 
 
+def test_gm_stream_composition_200k(tmp_path):
+    """ISSUE 10 satellite: the 100M path's PLUMBING at CI scale —
+    a 200k x 16-D disk-backed memmap fits through the streaming
+    global-Morton engine on the 8-device mesh (multi-bucket external
+    sample-sort forced via a tiny bucket budget) with labels
+    byte-identical to the in-RAM global-Morton fit.  Every PR
+    exercises the north-star composition, not only hardware runs."""
+    import os
+
+    from benchdata import make_blob_data
+    from pypardis_tpu.parallel import staging
+    from pypardis_tpu.parallel.global_morton import global_morton_dbscan
+
+    X, _truth = make_blob_data(200_000, 16)
+    kw = dict(eps=2.4, min_samples=10, block=256)
+    mesh = default_mesh(8)
+    staging.clear()
+    ref, ref_core, ref_stats = global_morton_dbscan(X, mesh=mesh, **kw)
+    staging.clear()
+    path = tmp_path / "ns.f32"
+    mm = np.memmap(path, dtype=np.float32, mode="w+", shape=X.shape)
+    mm[:] = X
+    mm.flush()
+    ro = np.memmap(path, dtype=np.float32, mode="r", shape=X.shape)
+    os.environ["PYPARDIS_STREAM_BUCKET_MB"] = "4"
+    try:
+        labels, core, stats = global_morton_dbscan(ro, mesh=mesh, **kw)
+    finally:
+        del os.environ["PYPARDIS_STREAM_BUCKET_MB"]
+    assert stats["input"] == "stream"
+    assert stats["stream_buckets"] > 1  # real external bucketing ran
+    assert stats["duplicated_work_factor"] == 1.0
+    assert stats["halo_exchange"] == "morton_ring"
+    np.testing.assert_array_equal(labels, ref)
+    np.testing.assert_array_equal(core, ref_core)
+    # Same slab geometry as the in-RAM build — the layouts (not just
+    # the labels) are interchangeable, so staging/layout caches and
+    # compiled programs are shared between the two builders.
+    assert stats["owned_cap"] == ref_stats["owned_cap"]
+    assert stats["partition_sizes"] == ref_stats["partition_sizes"]
+    staging.clear()
+
+
 def test_dbscan_fit_memmap_routes_streaming(mm_blobs):
     mm, X = mm_blobs
     ref = DBSCAN(eps=0.4, min_samples=5, block=128).fit_predict(X)
