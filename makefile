@@ -6,7 +6,7 @@ PY ?= python
 .PHONY: all wheel native test verify tpu-smoke bench bench-smoke \
 	partition-probe serve-probe live-probe global-morton-probe \
 	fault-probe bench-diff flight-check northstar northstar-smoke \
-	streammem-probe sort-probe demo clean
+	streammem-probe sort-probe kernel-probe demo clean
 
 all: native test
 
@@ -47,11 +47,24 @@ bench:
 # then the CI-sized partitioner depth-scaling probe (fails when the
 # level builder's mp-doubling cost ratio exceeds 1.5x).
 bench-smoke: partition-probe serve-probe live-probe global-morton-probe \
-		fault-probe bench-diff flight-check northstar-smoke
+		fault-probe bench-diff flight-check northstar-smoke \
+		kernel-probe
 	JAX_PLATFORMS=cpu BENCH_N=2000 BENCH_DIM=4 BENCH_REPS=1 \
 	BENCH_DEV_REPS=1 $(PY) bench.py \
 	| $(PY) scripts/bench_diff.py --annotate --baseline-dir . \
 	| $(PY) scripts/check_bench_json.py --require-diff
+
+# Dispatch-level sparsity sweep (ISSUE 11): the XLA counts pass under
+# dense T^2 dispatch vs the compacted live tile-pair list on the same
+# Morton-sorted input — per-mode seconds + the measured
+# live_pair_fraction, byte-parity asserted (exits nonzero on
+# mismatch).  The dense-dispatch win only appears past a few hundred
+# tiles (the scan-iteration overhead the compaction removes); the
+# acceptance-scale row is `KP_N=2000000 KP_BLOCK=1024 make
+# kernel-probe`.
+kernel-probe:
+	JAX_PLATFORMS=cpu $(PY) scripts/kernel_probe.py \
+	$${KP_N:-40000} $${KP_DIM:-16} $${KP_BLOCK:-256}
 
 # Cross-round bench regression gate on the committed archives: the
 # r4->r5 4.7% delta must come back as the PR 2 manual diagnosis did —
@@ -80,9 +93,14 @@ fault-probe:
 # decomposing build/exchange/compute/merge seconds + peak RssAnon.
 # Defaults: 100M x 16-D on TPU hardware; 2M (the largest CPU-feasible
 # smoke) elsewhere.  Override: `NS_N=100000000 make northstar`.
+# The emitted row pipes through the same cross-round range gate BENCH
+# rows get: bench_diff finds the latest committed NORTHSTAR_*.json at
+# the SAME geometry (n/dim/devices/mode), attaches the verdict, and
+# check_bench_json --require-diff fails CI on a regression verdict.
 northstar:
 	$(PY) scripts/northstar_run.py \
-	| $(PY) scripts/check_bench_json.py
+	| $(PY) scripts/bench_diff.py --annotate --baseline-dir . \
+	| $(PY) scripts/check_bench_json.py --require-diff
 
 # CI-sized northstar composition (wired into bench-smoke): the same
 # full driver at 120k proves the plumbing + row schema on every PR.
@@ -91,7 +109,8 @@ northstar-smoke:
 	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 	NS_N=$${NS_N:-120000} NS_DIM=$${NS_DIM:-16} \
 	$(PY) scripts/northstar_run.py \
-	| $(PY) scripts/check_bench_json.py
+	| $(PY) scripts/bench_diff.py --annotate --baseline-dir . \
+	| $(PY) scripts/check_bench_json.py --require-diff
 
 # Streaming-build memory probe (ISSUE 10 acceptance gauge): peak host
 # ANON memory of the external sample-sort + per-shard assembly vs the

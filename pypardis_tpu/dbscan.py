@@ -340,9 +340,11 @@ def _pad_and_run(
     # hints with their string spellings.
     from .ops.distances import _norm_metric
     from .utils.budget import run_ladders
+    from .utils.hints import dispatch_tag
 
     budget_key = (
-        (k, cap), block, precision, float(eps), _norm_metric(metric)
+        dispatch_tag(cap // block), (k, cap), block, precision,
+        float(eps), _norm_metric(metric),
     )
 
     def ladder(be):
@@ -382,16 +384,26 @@ def _pad_and_run(
     roots, core, total, _budget, passes, band_pairs, rescored = (
         unpack_pipeline_result(packed)
     )
+    from .obs import current as obs_current
     from .ops.pallas_kernels import _norm_precision_mode, effective_tile
 
     reused, shipped = _dev_staging.fit_stats()
+    eff_block = int(
+        effective_tile(block, cap, k, _norm_precision_mode(precision))
+        or block
+    )
+    # The kernel grid's true tile count (the pipeline gauges it — the
+    # segment-break layout can pad the kernel capacity past cap, which
+    # the packed result doesn't carry): live_pair_fraction's
+    # denominator is tiles^2.
+    tiles = int(
+        obs_current().metrics.gauge("pipeline.kernel_tiles", 0) or 0
+    )
     info = {
         "live_pairs": int(total),
         "kernel_passes": int(passes),
-        "kernel_block": int(
-            effective_tile(block, cap, k, _norm_precision_mode(precision))
-            or block
-        ),
+        "kernel_tiles": tiles if tiles > 0 else max(1, cap // eff_block),
+        "kernel_block": eff_block,
         # Mixed-precision band telemetry (zeros off precision="mixed"):
         # pairs whose fast-pass d^2 landed in the rescore band, and
         # tile-pair visits re-run at high precision.

@@ -149,6 +149,17 @@ def _compute_section(
     passes = int(metrics.get("kernel_passes", 0) or 0)
     band_pairs = int(metrics.get("band_pairs", 0) or 0)
     rescored_tiles = int(metrics.get("rescored_tiles", 0) or 0)
+    tiles = int(metrics.get("kernel_tiles", 0) or 0)
+    try:
+        overlap_eff = float(
+            metrics.get("exchange_overlap_efficiency", 0.0) or 0.0
+        )
+    except (TypeError, ValueError):
+        overlap_eff = 0.0
+    if overlap_eff != overlap_eff or overlap_eff in (
+        float("inf"), float("-inf")
+    ):
+        overlap_eff = 0.0
     cluster_s = float(phases.get("cluster", 0.0) or 0.0)
     flops = float(pairs) * block * block * (n_dims + 2) * 2.0 * passes
     achieved = flops / cluster_s if cluster_s > 0 else 0.0
@@ -168,6 +179,19 @@ def _compute_section(
         "live_pairs": pairs,
         "kernel_block": block,
         "kernel_passes": passes,
+        # Dispatch-level sparsity gauges (ISSUE 11): the fraction of
+        # the dense T^2 tile grid the box-gap extraction kept (the
+        # work the compacted dispatch actually visits; < 1.0 on any
+        # clustered geometry, == 1.0 when every pair is live), and the
+        # share of boundary-ring seconds that ran concurrently with
+        # the overlapped owned-prefix counts pass (global-Morton mesh
+        # route; 0.0 everywhere else).  Always present and finite.
+        "live_pair_fraction": (
+            round(min(pairs / float(tiles * tiles), 1.0), 8)
+            if tiles > 0 else 0.0
+        ),
+        "kernel_tiles": tiles,
+        "exchange_overlap_efficiency": round(overlap_eff, 6),
         "model_flops": flops,
         "achieved_flops_per_sec": round(achieved, 1),
         "peak_flops": peak,
@@ -439,6 +463,11 @@ def format_summary(report: Dict) -> str:
             f"({_fmt_bytes(sh.get('boundary_tile_bytes', 0))}, "
             f"{sh.get('fixpoint_rounds', 0)} fixpoint round(s))"
         )
+        xov = report.get("compute", {}).get(
+            "exchange_overlap_efficiency", 0
+        )
+        if xov:
+            shard_bits.append(f"ring {xov:.0%} hidden behind counts")
         # Ring-traffic counters (gm.ring_bytes_sent accumulates the
         # actual bytes every ppermute circulation carried, ladder
         # retries included; gm.ring_tiles_kept the tiles receivers
@@ -496,10 +525,16 @@ def format_summary(report: Dict) -> str:
                 f"{comp.get('rescored_visit_fraction', 0):.0%} of tile "
                 f"visits rescored"
             )
+        frac_bit = ""
+        if comp.get("kernel_tiles", 0) > 0:
+            frac_bit = (
+                f", {comp.get('live_pair_fraction', 0.0):.2%} of tile "
+                f"pairs live"
+            )
         lines.append(
             f"  compute: {comp['live_pairs']:,} live pairs x "
             f"{comp['kernel_passes']} pass(es) @ block "
-            f"{comp['kernel_block']} -> "
+            f"{comp['kernel_block']}{frac_bit} -> "
             f"{comp['achieved_flops_per_sec'] / 1e9:,.1f} GFLOP/s "
             f"(mfu {comp['mfu']:.2%} of {comp['peak_flops'] / 1e12:.0f} "
             f"TFLOP/s {comp['peak_source']} peak{mixed_bit})"

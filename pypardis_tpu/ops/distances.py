@@ -23,6 +23,7 @@ sets to a fixed capacity and pass a validity mask.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -603,6 +604,214 @@ def live_tile_pairs(
     return rows_t[order], cols_t[order], total
 
 
+# Below this tile count the dense grid stays the default: its scan
+# overhead is ~nt^2 cheap cond iterations (sub-second below ~2k
+# tiles), while the compacted path adds the two-level extraction graph
+# to EVERY kernel program — measured as a 10x compile-time tax on the
+# CI-sized sharded programs (8 unrolled partitions x extraction each),
+# for zero runtime win at small nt (the 200k x 16-D probe measures
+# dense == pair at nt<=800).  Past it, the dense scan's quadratic
+# iteration count dominates runtime (the 5M north-star's 666.5s
+# compute wall) and the one-time compile is noise.
+PAIR_DISPATCH_MIN_TILES = int(
+    os.environ.get("PYPARDIS_PAIR_DISPATCH_TILES", 2048)
+)
+
+
+def pair_dispatch_enabled(nt: int | None = None) -> bool:
+    """Whether the XLA kernels dispatch over the compacted live
+    tile-pair list instead of scanning the dense T^2 column grid and
+    disproving pruned pairs one ``lax.cond`` at a time.
+
+    ``PYPARDIS_DISPATCH``: ``auto`` (default) compacts once the grid
+    reaches :data:`PAIR_DISPATCH_MIN_TILES` tiles (``nt`` — callers
+    pass their slab's tile count; None means "unknown", treated as
+    small); ``pair`` forces the compacted path everywhere; ``dense``
+    restores the dense grid — the parity oracle for the compacted path
+    (labels are byte-identical by construction: box-gap pruning is the
+    soundness argument either way, and integer count/min accumulation
+    commutes).  Read at TRACE time: flipping the env mid-process only
+    affects programs compiled afterwards (tests call
+    ``jax.clear_caches()`` around a flip).
+    """
+    env = os.environ.get("PYPARDIS_DISPATCH", "auto")
+    if env == "dense":
+        return False
+    if env == "pair":
+        return True
+    return nt is not None and nt >= PAIR_DISPATCH_MIN_TILES
+
+
+def xla_pair_list(
+    points, mask, eps, block: int, layout: str, budget: int | None = None,
+):
+    """Live tile-pair list sized to the XLA kernels' OWN tile grid
+    (``nt = n / block``) — the twin of
+    :func:`pypardis_tpu.ops.pallas_kernels.kernel_pair_list` for the
+    pure-XLA tiled passes.  Extracted ONCE per fit and shared by the
+    counts pass and every propagation pass; the list covers validity
+    boxes, a superset of any per-pass source subset (core masks), so
+    sharing is sound.  Returns ``((rows, cols), (2,) int32 [total,
+    budget])`` with the usual overflow contract: ``total > budget``
+    means pairs were dropped and results built from the list are
+    INVALID — the drivers' ladder retries with the exact total.
+    """
+    layout = _norm_layout(layout)
+    nt, pts, msk = _tiles_t(points, mask, block, layout)
+    lo, hi = tile_bounds(pts, msk)
+    if budget is None:
+        budget = default_pair_budget(nt)
+    budget = min(budget, nt * nt)
+    rows, cols, total = live_tile_pairs(lo, hi, eps, budget=budget)
+    return (rows, cols), jnp.stack([total, jnp.int32(budget)])
+
+
+# Pairs per inner scan of the compacted XLA dispatch: each chunk's
+# per-pair (block,) partial rows materialize as one (chunk, block)
+# scan output (block=1024 -> 16MB int32) and fold into the (nt+1,
+# block) accumulator with ONE unconditional scatter — the accumulator
+# never threads through a per-pair lax.cond, whose operand copies were
+# measured to dwarf the live compute (a 4MB carry copied per pair at
+# north-star tile counts is hundreds of GB of memcpy per pass).
+_XLA_PAIR_CHUNK = 4096
+
+
+def _pair_scan_chunks(pairs, nt, per_pair, fold, identity, block):
+    """Shared driver for the compacted XLA dispatch.
+
+    ``per_pair(r, c) -> ((block,) row, (2,) band)`` computes one live
+    tile pair (behind a ``lax.cond`` whose carry is only scalars —
+    skipped/padding pairs cost an iteration, never a tile of compute
+    or an accumulator copy); ``fold(acc, tgt, vals)`` scatters a
+    chunk's rows into the (nt+1, block) accumulator (row ``nt`` is the
+    dump row padding/skipped pairs target).  Returns ``(acc, band)``.
+    """
+    rows, cols = pairs
+    n_pairs = rows.shape[0]
+    chunk = min(_XLA_PAIR_CHUNK, max(n_pairs, 1))
+    nch = -(-n_pairs // chunk)
+    pad = nch * chunk - n_pairs
+    rows = jnp.concatenate([rows, jnp.full(pad, nt, jnp.int32)])
+    cols = jnp.concatenate([cols, jnp.zeros(pad, jnp.int32)])
+    rows = rows.reshape(nch, chunk)
+    cols = cols.reshape(nch, chunk)
+
+    def inner(carry, rc):
+        band = carry
+        r, c = rc
+
+        def compute(b):
+            vals, nb = per_pair(r, c)
+            return b + nb, vals
+
+        def skip(b):
+            return b, jnp.full((block,), identity, jnp.int32)
+
+        band, vals = jax.lax.cond(r >= nt, skip, compute, band)
+        return band, vals
+
+    def outer(carry, rc):
+        acc, band = carry
+        r, c = rc
+        band, vals = jax.lax.scan(inner, band, (r, c))
+        # Padding/skipped pairs carry the identity and target the dump
+        # row, so one unsorted scatter per chunk folds everything.
+        acc = fold(acc, jnp.minimum(r, nt), vals)
+        return (acc, band), None
+
+    acc0 = jnp.full((nt + 1, block), identity, jnp.int32)
+    (acc, band), _ = jax.lax.scan(
+        outer, (acc0, jnp.zeros(2, jnp.int32)), (rows, cols)
+    )
+    return acc[:nt], band
+
+
+def _counts_over_pairs(
+    pts, msk, lo, hi, pairs, eps, eps2, rt, metric, precision, mixed,
+):
+    """Counts pass driven by a compacted pair list — the XLA analogue
+    of the Pallas kernels' pair-list grid.  Padding entries carry row
+    ``nt`` and rows past ``rt`` (the owner-computes row restriction)
+    skip outright, so the MXU/VPU never visits a pair the boxes
+    already ruled out.  Integer adds commute, so counts are
+    byte-identical to the dense scan's.  Returns ``(counts[:rt*block],
+    (2,) band stats)``."""
+    nt, _d, block = pts.shape
+    rows, cols = pairs
+    centers = 0.5 * (lo + hi)
+    # The row restriction folds into the pair ids: restricted rows
+    # become dump-row padding before the shared chunked scan.
+    rows = jnp.where(rows < rt, rows, nt)
+
+    def per_pair(r, c):
+        rr = jnp.minimum(r, nt - 1)
+        cc = jnp.minimum(c, nt - 1)
+        xi, mi = pts[rr], msk[rr]
+        yj, mj = pts[cc], msk[cc]
+        if mixed:
+            adj, n_band, resc = _tile_adjacency_mixed_t(
+                xi, yj, eps2, centers[rr][:, None], mi, mj,
+            )
+        else:
+            adj = _tile_adjacency_t(xi, yj, eps, metric, precision)
+            adj &= mj[None, :]
+            n_band = resc = jnp.int32(0)
+        cnt = jnp.sum(adj, axis=1, dtype=jnp.int32)
+        return cnt, jnp.stack([n_band, resc])
+
+    def fold(acc, tgt, vals):
+        return acc.at[tgt].add(vals)
+
+    acc, band = _pair_scan_chunks(
+        (rows, cols), nt, per_pair, fold, 0, block
+    )
+    return acc[:rt].reshape(-1), band
+
+
+def _minlab_over_pairs(
+    pts, smsk, lab, row_lo, row_hi, pairs, eps, eps2, owned_tiles,
+    metric, precision, mixed,
+):
+    """Min-label pass over a compacted pair list (see
+    :func:`_counts_over_pairs`; min accumulation commutes too).
+    ``owned_tiles`` drops (halo row, halo col) entries exactly like
+    the dense kernel's tile-pair skip; the pair list may cover
+    validity boxes — the extra pairs a tighter source mask would have
+    pruned contribute only INT32_MAX candidates, so the result is
+    identical."""
+    nt, _d, block = pts.shape
+    rows, cols = pairs
+    centers = 0.5 * (row_lo + row_hi)
+    if owned_tiles is not None:
+        halo_halo = (rows >= owned_tiles) & (cols >= owned_tiles)
+        rows = jnp.where(halo_halo, nt, rows)
+
+    def per_pair(r, c):
+        rr = jnp.minimum(r, nt - 1)
+        cc = jnp.minimum(c, nt - 1)
+        xi = pts[rr]
+        yj, mj, lj = pts[cc], smsk[cc], lab[cc]
+        if mixed:
+            adj, n_band, resc = _tile_adjacency_mixed_t(
+                xi, yj, eps2, centers[rr][:, None],
+                jnp.ones((block,), bool), mj, collect_stats=False,
+            )
+        else:
+            adj = _tile_adjacency_t(xi, yj, eps, metric, precision)
+            adj &= mj[None, :]
+            n_band = resc = jnp.int32(0)
+        cand = jnp.where(adj, lj[None, :], _INT_INF)
+        return jnp.min(cand, axis=1), jnp.stack([n_band, resc])
+
+    def fold(acc, tgt, vals):
+        return acc.at[tgt].min(vals)
+
+    acc, band = _pair_scan_chunks(
+        (rows, cols), nt, per_pair, fold, _INT_INF, block
+    )
+    return acc.reshape(-1), band
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("metric", "block", "precision", "layout", "row_tiles"),
@@ -616,6 +825,7 @@ def neighbor_counts(
     precision: str = "high",
     layout: str = "nd",
     row_tiles: int | None = None,
+    pairs=None,
 ) -> jnp.ndarray:
     """Per-point count of valid points within eps (self included).
 
@@ -626,6 +836,16 @@ def neighbor_counts(
     bounding box lies farther than eps from the row tile's are skipped
     (``lax.cond``), so spatially sorted inputs do O(N * local density)
     work instead of O(N^2).
+
+    ``pairs``: optional precomputed ``(rows, cols)`` live tile-pair
+    list from :func:`xla_pair_list` (row-major; padding rows == nt).
+    When given, the kernel dispatches ONE scan step per listed pair
+    instead of walking the dense nt^2 grid — the compacted cell-list
+    dispatch; counts are byte-identical (integer adds commute, and a
+    box-pruned pair provably contributes zero).  The caller owns the
+    overflow contract: a truncated list silently misses pairs, so
+    only lists whose extraction reported ``total <= budget`` are
+    valid.
 
     ``row_tiles`` restricts the computed ROWS to the first
     ``row_tiles * block`` points (the output shrinks to match) while
@@ -653,6 +873,16 @@ def neighbor_counts(
     lo, hi = tile_bounds(pts, msk)
     rt = nt if row_tiles is None else min(row_tiles, nt)
     eps2 = jnp.float32(eps) ** 2
+
+    if pairs is not None:
+        counts, band = _counts_over_pairs(
+            pts, msk, lo, hi, pairs, eps, eps2, rt, metric, precision,
+            mixed,
+        )
+        counts = jnp.where(mask[: rt * block], counts, 0)
+        if not mixed:
+            return counts
+        return counts, band
 
     def row_tile(xi, mi, lo_i, hi_i):
         skip = tile_skip_mask(lo_i, hi_i, lo, hi, eps, metric)
@@ -709,6 +939,7 @@ def min_neighbor_label(
     row_mask: jnp.ndarray | None = None,
     layout: str = "nd",
     owned_tiles: int | None = None,
+    pairs=None,
 ) -> jnp.ndarray:
     """Per-point min label over eps-neighbors drawn from ``src_mask``.
 
@@ -727,6 +958,10 @@ def min_neighbor_label(
     skipped outright.  Halo slots then exchange labels with owned slots
     only — the owner-computes adjacency rule, where halo-halo edges are
     each some partition's owned-halo edge and are recovered there.
+
+    ``pairs``: optional compacted live tile-pair list (see
+    :func:`neighbor_counts`); the same ``owned_tiles`` skip applies per
+    listed entry, so callers share ONE unfiltered list across passes.
 
     With ``precision="mixed"`` the return widens to ``(best,
     band_stats)`` — see :func:`neighbor_counts`; labels are
@@ -754,6 +989,15 @@ def min_neighbor_label(
     row_lo, row_hi = tile_bounds(pts, rmsk)
     col_ids = jnp.arange(nt, dtype=jnp.int32)
     eps2 = jnp.float32(eps) ** 2
+
+    if pairs is not None:
+        best, band = _minlab_over_pairs(
+            pts, smsk, lab, row_lo, row_hi, pairs, eps, eps2,
+            owned_tiles, metric, precision, mixed,
+        )
+        if not mixed:
+            return best
+        return best, band
 
     def row_tile(ri, xi, mi, lo_i, hi_i):
         skip = tile_skip_mask(lo_i, hi_i, lo, hi, eps, metric)

@@ -52,6 +52,19 @@ def _split_band(out, mixed: bool):
     return out, _band_zeros()
 
 
+def pair_dispatch(metric, nt: int | None = None) -> bool:
+    """Whether the XLA kernels run the compacted pair-list dispatch
+    for this metric and grid size: the ``PYPARDIS_DISPATCH`` policy
+    (auto-by-size / pair / dense), restricted to euclidean — the
+    box-gap pair extraction is a squared-distance discipline, so
+    cityblock stays on the dense grid."""
+    from .distances import _norm_metric, pair_dispatch_enabled
+
+    return (
+        pair_dispatch_enabled(nt) and _norm_metric(metric) == "euclidean"
+    )
+
+
 def resolve_backend(
     backend: str, metric: str, n: int = 0, block: int = 1,
     d: int = 2, precision: str = "high",
@@ -311,6 +324,28 @@ def _dbscan_fixed_size_jit(
             min_neighbor_label_pallas, block=block, precision=precision,
             layout=layout, pairs=pairs,
         )
+    elif pair_dispatch(metric, n // block):
+        # Compacted dispatch (auto past PAIR_DISPATCH_MIN_TILES):
+        # extract the live tile-pair list ONCE on the XLA kernels' own
+        # grid and drive every pass over it — the same cell-list discipline the Pallas path has
+        # always run, closing the dense-dispatch gap on the backend
+        # the CPU mesh (and any Pallas fallback) actually exercises.
+        # The stats carry the real [total, budget] overflow contract:
+        # labels built from a truncated list are INVALID and the
+        # drivers' ladder retries with the exact total.
+        from .distances import xla_pair_list
+
+        pairs, pair_stats = xla_pair_list(
+            points, mask, eps, block, layout, budget=pair_budget
+        )
+        count_fn = functools.partial(
+            neighbor_counts, metric=metric, block=block, precision=precision,
+            layout=layout, pairs=pairs,
+        )
+        minlab_fn = functools.partial(
+            min_neighbor_label, metric=metric, block=block, precision=precision,
+            layout=layout, pairs=pairs,
+        )
     else:
         count_fn = functools.partial(
             neighbor_counts, metric=metric, block=block, precision=precision,
@@ -320,16 +355,17 @@ def _dbscan_fixed_size_jit(
             min_neighbor_label, metric=metric, block=block, precision=precision,
             layout=layout,
         )
-        # Real [total, budget] stats on the XLA path too.  budget == 0
-        # when no static budget is in play (the XLA kernels never drop
-        # pairs) — drivers treat 0 as "cannot overflow".  With an
-        # explicit pair_budget the stats mirror the Pallas overflow
-        # contract, which is what lets the drivers' rerun ladder (and
-        # CI, where Mosaic is absent) exercise off-hardware.  The count
-        # runs on the SAME effective tile the Pallas extraction would
-        # use (when one exists): the drivers' hint cache keys budgets by
-        # config, not backend, so a hint seeded by one backend must not
-        # over/undershoot the other's grid after a kernel fallback.
+        # Dense dispatch (PYPARDIS_DISPATCH=dense, or cityblock — its
+        # boxes have no euclidean pair extraction).  Real [total,
+        # budget] stats here too: budget == 0 when no static budget is
+        # in play (the dense kernels never drop pairs) — drivers treat
+        # 0 as "cannot overflow".  With an explicit pair_budget the
+        # stats mirror the Pallas overflow contract, which is what
+        # lets the drivers' rerun ladder exercise off-hardware.  The
+        # count runs on the SAME effective tile the Pallas extraction
+        # would use (when one exists): dense-mode hints share the
+        # pallas grid (pair-mode hints key separately — see
+        # utils.hints.dispatch_tag).
         from .distances import count_live_tile_pairs
         from .pallas_kernels import _norm_precision_mode, effective_tile
 
@@ -463,15 +499,16 @@ def oc_extract(
     """Shared pre-pass for the owner-computes kernels.
 
     Resolves the backend once and extracts whatever the passes share:
-    the Pallas tile-pair list, or (XLA) the diagnostic live-pair count.
-    Returns ``(kind, pairs, stats)`` — ``kind`` in ``("xla",
-    "pallas")``, ``pairs`` None on XLA, ``stats`` (2,) int32
-    ``[live_pairs_total, budget]`` with the usual overflow contract.
+    the Pallas tile-pair list, the XLA pair list (compacted dispatch,
+    the default), or — dense dispatch — the diagnostic live-pair
+    count.  Returns ``(kind, pairs, stats)`` — ``kind`` in ``("xla",
+    "pallas")``, ``pairs`` None only on dense-XLA, ``stats`` (2,)
+    int32 ``[live_pairs_total, budget]`` with the usual overflow
+    contract (pair lists bind the budget to the FULL list).
 
-    The XLA total subtracts the halo-halo tile pairs the propagation
-    will skip, so ``live_pairs`` reflects the work this path actually
-    does (the Pallas total stays the extraction's — its budget
-    semantics bind the full list).
+    The dense-XLA total subtracts the halo-halo tile pairs the
+    propagation will skip, so ``live_pairs`` reflects the work that
+    path actually does.
     """
     from .distances import count_live_tile_pairs
 
@@ -495,6 +532,13 @@ def oc_extract(
             budget=pair_budget,
         )
         return "pallas", pairs, stats
+    if pair_dispatch(metric, n // block):
+        from .distances import xla_pair_list
+
+        pairs, stats = xla_pair_list(
+            points, mask, eps, block, layout, budget=pair_budget
+        )
+        return "xla", pairs, stats
     from .pallas_kernels import _norm_precision_mode, effective_tile
 
     count_block = effective_tile(
@@ -517,17 +561,19 @@ def oc_extract(
     return "xla", None, stats
 
 
-def oc_counts(
-    points, eps, min_samples, mask, *, owned, metric, block, precision,
+def oc_raw_counts(
+    points, eps, mask, *, owned, metric, block, precision,
     kind, pairs, layout: str = "nd",
 ):
-    """Owned-row core flags: counts over owned ROWS x all columns.
+    """Owned-row RAW neighbor counts (no min_samples threshold):
+    counts over owned ROWS x all columns, returned as ``(counts,
+    band_stats)`` uniformly (band zeros off ``precision="mixed"``).
 
-    ``owned`` (static) is the slab prefix length holding owned slots;
-    halo columns contribute to the counts (exactness under the 2*eps
-    halo) but no halo row is ever counted.  Returns (owned,) bool —
-    widened to ``(core, band_stats)`` under ``precision="mixed"`` (the
-    kernel convention, see :func:`neighbor_counts`).
+    Split out of :func:`oc_counts` so the overlapped global-Morton
+    route can SUM an owned-slab pass (dispatched before the boundary
+    exchange) with a boundary-column delta (:func:`oc_counts_delta`)
+    and threshold once — integer adds over disjoint column sets
+    commute, so the sum is byte-identical to the fused counts pass.
     """
     mixed = _is_mixed(precision)
     if kind == "pallas":
@@ -553,10 +599,84 @@ def oc_counts(
             neighbor_counts(
                 points, eps, mask, metric=metric, block=block,
                 precision=precision, layout=layout,
-                row_tiles=owned // block,
+                row_tiles=owned // block, pairs=pairs,
             ),
             mixed,
         )
+    return counts, band
+
+
+def oc_counts_delta(
+    points, eps, mask, *, owned, metric, block, precision,
+    kind, pairs, layout: str = "nd",
+):
+    """Owned ROWS x boundary COLUMNS (cols >= owned) counts — the
+    boundary-evidence delta the overlapped global-Morton counts pass
+    adds after the exchange lands.  Requires a pair list (Pallas, or
+    XLA compacted dispatch): the (owned row, boundary col) restriction
+    IS a pair-list filter.  Returns ``(counts[:owned], band_stats)``.
+    """
+    mixed = _is_mixed(precision)
+    if pairs is None:
+        raise RuntimeError(
+            "oc_counts_delta requires a pair list (Pallas backend or "
+            "PYPARDIS_DISPATCH=pair); the caller gates the overlapped "
+            "route off under dense dispatch"
+        )
+    n = points.shape[0] if layout == "nd" else points.shape[1]
+    if kind == "pallas":
+        from .pallas_kernels import (
+            _norm_precision_mode, _pallas_block, neighbor_counts_pallas,
+        )
+
+        d = points.shape[1] if layout == "nd" else points.shape[0]
+        pb = _pallas_block(block, n, d, _norm_precision_mode(precision))
+        nt, ont = n // pb, owned // pb
+        rows, cols = pairs
+        counts, band = _split_band(
+            neighbor_counts_pallas(
+                points, eps, mask, block=block, precision=precision,
+                layout=layout,
+                pairs=_oc_sorted_pairs(
+                    pairs, (rows < ont) & (cols >= ont), nt
+                ),
+            ),
+            mixed,
+        )
+        counts = counts[:owned]
+    else:
+        nt, ont = n // block, owned // block
+        rows, cols = pairs
+        counts, band = _split_band(
+            neighbor_counts(
+                points, eps, mask, metric=metric, block=block,
+                precision=precision, layout=layout, row_tiles=ont,
+                pairs=_oc_sorted_pairs(
+                    pairs, (rows < ont) & (cols >= ont), nt
+                ),
+            ),
+            mixed,
+        )
+    return counts, band
+
+
+def oc_counts(
+    points, eps, min_samples, mask, *, owned, metric, block, precision,
+    kind, pairs, layout: str = "nd",
+):
+    """Owned-row core flags: counts over owned ROWS x all columns.
+
+    ``owned`` (static) is the slab prefix length holding owned slots;
+    halo columns contribute to the counts (exactness under the 2*eps
+    halo) but no halo row is ever counted.  Returns (owned,) bool —
+    widened to ``(core, band_stats)`` under ``precision="mixed"`` (the
+    kernel convention, see :func:`neighbor_counts`).
+    """
+    mixed = _is_mixed(precision)
+    counts, band = oc_raw_counts(
+        points, eps, mask, owned=owned, metric=metric, block=block,
+        precision=precision, kind=kind, pairs=pairs, layout=layout,
+    )
     # Same self-count clamp as dbscan_fixed_size: a valid point is
     # always within eps of itself, whatever the f32 expansion says.
     core = (jnp.maximum(counts, 1) >= min_samples) & mask[:owned]
@@ -604,7 +724,7 @@ def oc_propagate(
         minlab_fn = functools.partial(
             min_neighbor_label, metric=metric, block=block,
             precision=precision, layout=layout,
-            owned_tiles=owned // block,
+            owned_tiles=owned // block, pairs=pairs,
         )
 
     def minlab_band(f):

@@ -760,6 +760,19 @@ def dbscan_device_pipeline(
                 aux={"cap": cap},
             )
     capk = xs.shape[1]
+    # The kernel grid's tile count (post segment-break capacity / the
+    # effective tile): the denominator of report()'s live_pair_fraction
+    # — the driver cannot see capk (the packed result is cap-sized), so
+    # it rides as a gauge on the fit's registry.
+    from ..obs import current as obs_current
+    from .pallas_kernels import _norm_precision_mode, effective_tile
+
+    _eff = effective_tile(
+        block, capk, xs.shape[0], _norm_precision_mode(precision)
+    ) or min(block, capk)
+    obs_current().metrics.set(
+        "pipeline.kernel_tiles", max(1, capk // _eff)
+    )
     stepped = (
         capk >= STEP_THRESHOLD
         and resolve_backend(

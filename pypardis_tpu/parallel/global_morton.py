@@ -93,8 +93,12 @@ from ..obs import (
 from ..ops.labels import (
     gm_backend,
     oc_counts_banded,
+    oc_counts_delta,
     oc_extract,
     oc_propagate_banded,
+    oc_raw_counts,
+    pair_dispatch,
+    resolve_backend,
 )
 from ..partition import morton_range_split
 from ..utils import clamp_block, faults, round_up, validate_params
@@ -447,6 +451,151 @@ def build_morton_shards_streaming(points, n_shards, block, sharding,
 # boundary-tile exchange programs
 # ---------------------------------------------------------------------------
 
+_BOX_BIG = np.float32(3e38)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("gtile", "mesh", "axis")
+)
+def _gm_plan_step(owned, mask, eps, *, gtile, mesh, axis):
+    """Metadata-only exchange capacity plan.
+
+    Per device, the EXACT count of boundary tiles it must SEND (its
+    tiles within eps of some remote shard's tiles) and RECEIVE (remote
+    tiles within eps of its own) — pure box arithmetic over the
+    all-gathered per-tile bounding boxes; no coordinate ever moves.
+    Sizing the send/recv buffers from this plan makes the btcap/bcap
+    doubling ladder a backstop instead of the common path: the first
+    measured north-star run (5M x 16-D) paid TWO full exchange reruns
+    (select + P-1 ring rounds + flatten + recompiles, ~2/3 of its
+    236.6s exchange wall) climbing the ladder that this one tiny
+    program replaces.
+    """
+
+    def per_device(o, m):
+        cap, k = o.shape[1], o.shape[2]
+        nt = cap // gtile
+        tiles = o[0].reshape(nt, gtile, k)
+        tmsk = m[0].reshape(nt, gtile)
+        from ..ops.distances import cross_tile_live, tile_bounds
+
+        lo, hi = tile_bounds(tiles.transpose(0, 2, 1), tmsk)
+        n_dev = (
+            jax.lax.axis_size(axis)
+            if hasattr(jax.lax, "axis_size")
+            else jax.lax.psum(1, axis)
+        )
+        all_lo = jax.lax.all_gather(lo, axis)
+        all_hi = jax.lax.all_gather(hi, axis)
+        me = jax.lax.axis_index(axis)
+        mine = (jnp.arange(n_dev) == me)[:, None, None]
+        rem_lo = jnp.where(mine, _BOX_BIG, all_lo).reshape(n_dev * nt, k)
+        rem_hi = jnp.where(mine, -_BOX_BIG, all_hi).reshape(n_dev * nt, k)
+        send = cross_tile_live(lo, hi, rem_lo, rem_hi, eps)
+        recv = cross_tile_live(rem_lo, rem_hi, lo, hi, eps)
+        return (
+            jnp.sum(send.astype(jnp.int32))[None],
+            jnp.sum(recv.astype(jnp.int32))[None],
+        )
+
+    sp3 = P("p", None, None)
+    sp2 = P("p", None)
+    sp1 = P("p")
+    return shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(sp3, sp2),
+        out_specs=(sp1, sp1),
+        check_vma=False,
+    )(owned, mask)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "eps", "metric", "block", "mesh", "axis", "precision", "backend",
+        "pair_budget",
+    ),
+)
+def _gm_owned_counts_step(
+    owned, omsk, *, eps, metric, block, mesh, axis, precision, backend,
+    pair_budget,
+):
+    """Owned-slab raw counts (owned rows x owned columns) as its own
+    collective-free program — dispatched BEFORE the boundary exchange
+    so the P-1 host-stepped ring rounds hide behind it.  The boundary
+    columns' contribution lands afterwards as
+    :func:`_gm_counts_delta_step`, and ``owned + delta`` equals the
+    fused counts pass bitwise (integer adds over disjoint column sets
+    commute), so labels cannot depend on the overlap.  Returns
+    ``(counts (P, cap), stats (P, 4) [total, budget, band_pairs,
+    rescored_tiles])``."""
+
+    def per_device(o, om):
+        cap = o.shape[1]
+        kind, pairs, st = oc_extract(
+            o[0], eps, om[0], owned=cap, metric=metric, block=block,
+            precision=precision, backend=backend, pair_budget=pair_budget,
+        )
+        counts, band = oc_raw_counts(
+            o[0], eps, om[0], owned=cap, metric=metric, block=block,
+            precision=precision, kind=kind, pairs=pairs,
+        )
+        return counts[None], jnp.concatenate([st, band])[None]
+
+    sp3 = P("p", None, None)
+    sp2 = P("p", None)
+    return shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(sp3, sp2),
+        out_specs=(sp2, sp2),
+        check_vma=False,
+    )(owned, omsk)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "eps", "metric", "block", "mesh", "axis", "precision", "backend",
+        "pair_budget",
+    ),
+)
+def _gm_counts_delta_step(
+    owned, omsk, bnd, bmsk, *, eps, metric, block, mesh, axis, precision,
+    backend, pair_budget,
+):
+    """Owned rows x boundary columns counts — the exchange-fed half of
+    the overlapped counts pass (:func:`_gm_owned_counts_step`).  The
+    (owned row, boundary col) restriction is a pair-list filter, so
+    this requires the compacted dispatch (Pallas, or XLA pair mode —
+    the driver gates the overlap off otherwise).  Returns ``(delta
+    (P, cap), stats (P, 4))``."""
+
+    def per_device(o, om, bp, bm):
+        cap = o.shape[1]
+        pts = jnp.concatenate([o[0], bp[0]], axis=0)
+        msk = jnp.concatenate([om[0], bm[0]])
+        kind, pairs, st = oc_extract(
+            pts, eps, msk, owned=cap, metric=metric, block=block,
+            precision=precision, backend=backend, pair_budget=pair_budget,
+        )
+        delta, band = oc_counts_delta(
+            pts, eps, msk, owned=cap, metric=metric, block=block,
+            precision=precision, kind=kind, pairs=pairs,
+        )
+        return delta[None], jnp.concatenate([st, band])[None]
+
+    sp3 = P("p", None, None)
+    sp2 = P("p", None)
+    return shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(sp3, sp2, sp3, sp2),
+        out_specs=(sp2, sp2),
+        check_vma=False,
+    )(owned, omsk, bnd, bmsk)
+
 
 @functools.partial(
     jax.jit, static_argnames=("gtile", "btcap", "bcap", "mesh", "axis")
@@ -600,12 +749,20 @@ def _gm_flatten_step(recv_pts, recv_msk, recv_gid, recv_val, my_lo,
     )(recv_pts, recv_msk, recv_gid, recv_val, my_lo, my_hi, eps)
 
 
-def _gm_exchange(arrays, eps, *, mesh, axis, gtile, bt, bc):
+def _gm_exchange(arrays, eps, *, mesh, axis, gtile, bt, bc,
+                 round_hook=None):
     """Run the boundary-tile exchange: select, P-1 spanned ring rounds,
     flatten.  Returns ``((bnd, bmsk, bgid), xstats, send_need,
     recv_overflow)`` — ``send_need`` is the exact per-device max of
     boundary tiles (so a send overflow retries with the exact
     capacity), ``recv_overflow`` the max tiles dropped for ``bc``.
+
+    ``round_hook``, when given, is invoked (no args) after every ring
+    round completes — the overlap driver uses it to timestamp when the
+    concurrently dispatched counts pass went ready, at round
+    granularity.  ``xstats`` carries ``ring_wall_s``, the wall seconds
+    of the host-stepped ring loop alone (the overlap-efficiency
+    denominator).
     """
     import time as _time
 
@@ -647,7 +804,10 @@ def _gm_exchange(arrays, eps, *, mesh, axis, gtile, bt, bc):
                 # — a scalar fetch, so the span measures the round's
                 # execution, not its dispatch.
                 rs.sync_on(state[-1])
+            if round_hook is not None:
+                round_hook()
             obs_heartbeat("gm.ring", r + 1, n_dev - 1, t_ring)
+        ring_wall = _time.perf_counter() - t_ring
         bnd, bmsk, bgid, tiles, rows, kept_tiles = _gm_flatten_step(
             state[5], state[6], state[7], state[8], my_lo, my_hi,
             np.float32(eps), mesh=mesh,
@@ -678,6 +838,7 @@ def _gm_exchange(arrays, eps, *, mesh, axis, gtile, bt, bc):
             "boundary_tile_bytes": sent_tiles * gtile * k * 4,
             "boundary_tile_caps": [int(bt), int(bc)],
             "exchange_tile": int(gtile),
+            "ring_wall_s": round(ring_wall, 6),
         }
         sp.set(boundary_tiles=xstats["boundary_tiles"],
                sent_tiles=sent_tiles)
@@ -698,10 +859,16 @@ def _gm_exchange(arrays, eps, *, mesh, axis, gtile, bt, bc):
     )
 
 
-def _gm_boundary_tiles(arrays, eps, *, mesh, axis, block, btcap, base):
+def _gm_boundary_tiles(arrays, eps, *, mesh, axis, block, btcap, base,
+                       round_hook=None):
     """The boundary exchange behind its capacity ladder and the staging
     cache (route ``gm_boundary``, keyed base + eps): warm refits of the
-    same data/eps skip the select + ring entirely."""
+    same data/eps skip the select + ring entirely.
+
+    With ``btcap=None`` (the default) the send/recv capacities come
+    from the metadata-only :func:`_gm_plan_step` — exact, so the
+    doubling ladder below is a backstop, not two extra full exchange
+    passes per cold fit."""
     faults.maybe_fail("gm.exchange")
     bkey = base + ("boundary", float(eps))
     cached = staging.device_get("gm_boundary", bkey)
@@ -718,13 +885,29 @@ def _gm_boundary_tiles(arrays, eps, *, mesh, axis, block, btcap, base):
     bstep = block // gtile
     nt = cap // gtile
     explicit = btcap is not None
-    bt = min(max(1, int(btcap)), nt) if explicit else max(1, nt // 4)
     bc_hard = round_up(max(n_dev - 1, 1) * nt, bstep)
-    bc = min(round_up(max(1, 2 * bt), bstep), bc_hard)
+    if explicit:
+        bt = min(max(1, int(btcap)), nt)
+        bc = min(round_up(max(1, 2 * bt), bstep), bc_hard)
+    else:
+        # Exact plan: per-device send/recv tile needs from box
+        # metadata alone.  The receive need counts every remote tile
+        # within eps of mine — exactly the tiles the ring rounds will
+        # accept into the recv buffer.
+        n_send_pd, n_recv_pd = _gm_plan_step(
+            arrays[0], arrays[1], np.float32(eps),
+            gtile=gtile, mesh=mesh, axis=axis,
+        )
+        bt = min(max(1, int(np.asarray(n_send_pd).max())), nt)
+        bc = min(
+            round_up(max(1, int(np.asarray(n_recv_pd).max())), bstep),
+            bc_hard,
+        )
     attempts = 6
     while True:
         (bnd, bmsk, bgid), xstats, send_need, recv_ovf = _gm_exchange(
-            arrays, eps, mesh=mesh, axis=axis, gtile=gtile, bt=bt, bc=bc
+            arrays, eps, mesh=mesh, axis=axis, gtile=gtile, bt=bt, bc=bc,
+            round_hook=round_hook,
         )
         send_ovf = max(0, send_need - bt)
         if send_ovf == 0 and recv_ovf == 0:
@@ -791,7 +974,7 @@ def _gm_boundary_tiles(arrays, eps, *, mesh, axis, block, btcap, base):
     ),
 )
 def _gm_cluster_step(
-    owned, omsk, ogid, bnd, bmsk, bgid,
+    owned, omsk, ogid, bnd, bmsk, bgid, own_core=None,
     *, eps, min_samples, metric, block, mesh, axis, n_points,
     precision, backend, pair_budget,
 ):
@@ -804,10 +987,17 @@ def _gm_cluster_step(
     Returns ``(home_label (N+1,) replicated, core_g (N+1,) replicated,
     b_glab (P, brows) sharded, pair_stats (P, 5))`` — everything the
     host-stepped fixpoint consumes.
+
+    ``own_core`` (optional, (P, cap) bool sharded): precomputed owned
+    core flags from the overlapped counts route (owned-slab pass +
+    boundary delta, summed and thresholded host-side) — the in-graph
+    counts pass is then skipped and its band columns are zero (the
+    driver folds the overlapped passes' bands host-side).
     """
     n1 = n_points + 1
+    pre_core = own_core is not None
 
-    def per_device(o, om, og, bp, bm, bg):
+    def per_device(o, om, og, bp, bm, bg, *pre):
         cap = o.shape[1]
         pts = jnp.concatenate([o[0], bp[0]], axis=0)
         msk = jnp.concatenate([om[0], bm[0]])
@@ -816,17 +1006,21 @@ def _gm_cluster_step(
             pts, eps, msk, owned=cap, metric=metric, block=block,
             precision=precision, backend=backend, pair_budget=pair_budget,
         )
-        own_core, counts_band = oc_counts_banded(
-            pts, eps, min_samples, msk, owned=cap, metric=metric,
-            block=block, precision=precision, kind=kind, pairs=pairs,
-        )
-        core_g = _replicated_core(own_core[None], og, axis, n1)
+        if pre_core:
+            own_core_l = pre[0][0]
+            counts_band = jnp.zeros(2, jnp.int32)
+        else:
+            own_core_l, counts_band = oc_counts_banded(
+                pts, eps, min_samples, msk, owned=cap, metric=metric,
+                block=block, precision=precision, kind=kind, pairs=pairs,
+            )
+        core_g = _replicated_core(own_core_l[None], og, axis, n1)
         b_core = (
             core_g[jnp.clip(bg[0], 0, n_points)]
             & (bg[0] < n_points) & bm[0]
         )
         labels, passes, prop_band = oc_propagate_banded(
-            pts, eps, msk, jnp.concatenate([own_core, b_core]),
+            pts, eps, msk, jnp.concatenate([own_core_l, b_core]),
             owned=cap, metric=metric, block=block, precision=precision,
             kind=kind, pairs=pairs,
         )
@@ -847,13 +1041,17 @@ def _gm_cluster_step(
 
     sp3 = P("p", None, None)
     sp2 = P("p", None)
+    extra = (sp2,) if pre_core else ()
+    args = (owned, omsk, ogid, bnd, bmsk, bgid)
+    if pre_core:
+        args = args + (own_core,)
     return shard_map(
         per_device,
         mesh=mesh,
-        in_specs=(sp3, sp2, sp2, sp3, sp2, sp2),
+        in_specs=(sp3, sp2, sp2, sp3, sp2, sp2) + extra,
         out_specs=(P(), P(), sp2, sp2),
         check_vma=False,
-    )(owned, omsk, ogid, bnd, bmsk, bgid)
+    )(*args)
 
 
 @functools.partial(jax.jit, static_argnames=("mesh", "axis", "n_points"))
@@ -1148,9 +1346,12 @@ def _gm_chained_dbscan(
         be = gm_backend(
             backend, metric, cap + bcap, cap, block, k, precision
         )
+        from ..utils.hints import dispatch_tag
+
         hint_key = (
-            "gm_chain", (n_ranges, cap, k), bcap, block, precision,
-            float(eps), metric,
+            "gm_chain", dispatch_tag((cap + bcap) // block),
+            (n_ranges, cap, k), bcap, block, precision, float(eps),
+            metric,
         )
         _note_first_compile(
             "global_morton_chained",
@@ -1338,6 +1539,9 @@ def _gm_chained_dbscan(
             "gm_exchange_s": round(t_exchange, 6),
             "gm_execute_s": round(t_exec_cell[0], 6),
             "gm_merge_s": round(t_merge, 6),
+            # The chained route's "exchange" is host-side tile
+            # selection — nothing rides a ring, nothing to hide.
+            "exchange_overlap_efficiency": 0.0,
         }
         _exec_stats(stats, oc_on=True, pstats=pstats, block=block,
                     k=k, precision=precision, n=n)
@@ -1484,17 +1688,158 @@ def global_morton_dbscan(
     owned, omsk, ogid = arrays
     cap = int(bstats["owned_cap"])
 
+    # ---- exchange/compute overlap (ISSUE 11 tentpole prong 2) ----
+    # Boundary tiles are consumed by the propagation/pmin-fixpoint
+    # stage; the counts pass needs them only ADDITIVELY (owned rows x
+    # boundary columns).  So the owned x owned bulk of the counts pass
+    # — the dominant compute — dispatches BEFORE the exchange, the P-1
+    # host-stepped ring rounds hide behind it, and the small boundary
+    # delta (_gm_counts_delta_step) lands after the exchange; the two
+    # sums equal the fused counts bitwise (integer adds commute).  The
+    # per-round retry/jobstate machinery is untouched: rounds still
+    # run one program at a time with their own probe + Retrier scope.
+    from ..utils.budget import pair_overflow as _pair_overflow
+    from ..utils.hints import PAIR_BUDGET_HINTS, dispatch_tag
+
+    owned_kind = resolve_backend(backend, metric, cap, block, k, precision)
+    # Overlap needs pair lists for the delta pass: gate on the OWNED
+    # slab's dispatch decision (the combined slab is never smaller, so
+    # its oc_extract resolves the compacted path whenever this does).
+    overlap = (
+        os.environ.get("PYPARDIS_GM_OVERLAP", "1") != "0"
+        and n_shards > 1
+        and (owned_kind == "pallas"
+             or pair_dispatch(metric, cap // block))
+    )
+    counts_np = ostats_np = None
+    counts_dev = cstats_dev = None
+    counts_ready = [None]
+    probe_ok = [True]
+    counts_backend = [backend]
+    pb_owned = None
+    t_counts0 = 0.0
+    if overlap:
+        okey = (
+            "gm_owned", dispatch_tag(cap // block), (n_shards, cap, k),
+            block, precision, float(eps), metric,
+        )
+        pb_env = os.environ.get("PYPARDIS_PAIR_BUDGET")
+        pb_owned = (
+            int(pb_env) if pb_env
+            else (pair_budget if pair_budget is not None
+                  else PAIR_BUDGET_HINTS.get(okey))
+        )
+
+        def _dispatch_counts(pb, b=None):
+            def go(b2):
+                counts_backend[0] = b2
+                return _gm_owned_counts_step(
+                    owned, omsk, eps=float(eps), metric=metric,
+                    block=block, mesh=mesh, axis=axis,
+                    precision=precision, backend=b2, pair_budget=pb,
+                )
+
+            if b is not None:
+                return go(b)
+            return _with_kernel_fallback(go, backend)
+
+        t_counts0 = _time.perf_counter()
+        counts_dev, cstats_dev = _dispatch_counts(pb_owned)
+
+        def _counts_hook():
+            # Round-granular completion probe for the overlapped
+            # counts: is_ready() never blocks, so the hook costs the
+            # ring loop nothing and the hidden-seconds measurement
+            # gets a timestamp instead of a post-hoc guess.
+            if probe_ok[0] and counts_ready[0] is None:
+                try:
+                    if counts_dev.is_ready():
+                        counts_ready[0] = _time.perf_counter()
+                except Exception:  # pragma: no cover — probe only
+                    probe_ok[0] = False
+    else:
+
+        def _counts_hook():  # pragma: no cover — trivially nothing
+            return None
+
     t0 = _time.perf_counter()
     (bnd, bmsk, bgid), xstats = _gm_boundary_tiles(
         arrays, eps, mesh=mesh, axis=axis, block=block, btcap=btcap,
-        base=base,
+        base=base, round_hook=_counts_hook if overlap else None,
     )
-    t_exchange = _time.perf_counter() - t0
+    t_exchange_raw = _time.perf_counter() - t0
+    ring_wall = float(xstats.get("ring_wall_s", 0.0) or 0.0)
+    xstats = {k_: v for k_, v in xstats.items() if k_ != "ring_wall_s"}
+    t_hidden = 0.0
+    overlap_eff = 0.0
     brows = int(bnd.shape[1])
     be = gm_backend(backend, metric, cap + brows, cap, block, k, precision)
+    if overlap:
+        # The combined slab may route to the other backend (Pallas
+        # tile misalignment) — the overlapped counts would then mix
+        # kernel arithmetics with the delta pass, so discard them and
+        # take the non-overlapped path (labels must be byte-identical
+        # to the unoverlapped run, not merely close).
+        owned_kind_eff = resolve_backend(
+            counts_backend[0], metric, cap, block, k, precision
+        )
+        comb_kind = resolve_backend(
+            be, metric, cap + brows, block, k, precision
+        )
+        if comb_kind != owned_kind_eff:
+            obs_event(
+                "gm_overlap_abort", owned=owned_kind_eff,
+                combined=comb_kind,
+            )
+            overlap = False
+            counts_dev = cstats_dev = None
+    if overlap:
+
+        def _fetch_counts():
+            nonlocal counts_dev, cstats_dev
+            if counts_dev is None:
+                counts_dev, cstats_dev = _dispatch_counts(pb_owned)
+            try:
+                return np.asarray(counts_dev), np.asarray(cstats_dev)
+            except Exception:
+                # A transient execution fault poisons the in-flight
+                # arrays — drop them so the retry redispatches.
+                counts_dev = cstats_dev = None
+                raise
+
+        counts_np, ostats_np = Retrier("gm.owned_counts").run(
+            _fetch_counts
+        )
+        need = _pair_overflow(ostats_np[:, :2])
+        if need:
+            # The owned-slab extraction overflowed its budget: one
+            # exact-total redispatch (not overlapped — the exchange is
+            # already done) and seed the owned-geometry hint.
+            pb_owned = int(need)
+            counts_dev = cstats_dev = None
+            counts_np, ostats_np = Retrier("gm.owned_counts").run(
+                _fetch_counts
+            )
+            if _pair_overflow(ostats_np[:, :2]):
+                raise RuntimeError(
+                    f"global-Morton owned-counts pair budget overflow "
+                    f"persisted after an exact-total retry (budget "
+                    f"{pb_owned}); pass pair_budget or "
+                    f"PYPARDIS_PAIR_BUDGET"
+                )
+            PAIR_BUDGET_HINTS.put(okey, pb_owned)
+        t_done = (
+            counts_ready[0] if counts_ready[0] is not None
+            else _time.perf_counter()
+        )
+        t_hidden = max(
+            0.0, min(t_done - t_counts0, ring_wall, t_exchange_raw)
+        )
+        overlap_eff = t_hidden / ring_wall if ring_wall > 1e-9 else 0.0
+    t_exchange = max(t_exchange_raw - t_hidden, 0.0)
     hint_key = (
-        "gm", (n_shards, cap, k), brows, block, precision, float(eps),
-        metric,
+        "gm", dispatch_tag((cap + brows) // block), (n_shards, cap, k),
+        brows, block, precision, float(eps), metric,
     )
     _note_first_compile(
         "global_morton",
@@ -1519,21 +1864,78 @@ def global_morton_dbscan(
         halo_cap=brows,
     )
 
+    omsk_np = np.asarray(omsk) if overlap else None
+
+    def _overlap_core(pb, b2):
+        """Boundary-column delta + threshold: the second half of the
+        overlapped counts pass.  Returns ``(core (P, cap) numpy, delta
+        stats (P, 4))``.  If the kernel-fallback rung handed us a
+        backend other than the one that produced the overlapped owned
+        counts, recompute them synchronously with ``b2`` — summing
+        counts from two kernel arithmetics would break byte parity
+        with the non-overlapped run."""
+        c_np = counts_np
+        if b2 != counts_backend[0]:
+            cdev, _sdev = _dispatch_counts(pb_owned, b=b2)
+            c_np = np.asarray(cdev)
+        delta_dev, dstats_dev = _gm_counts_delta_step(
+            owned, omsk, bnd, bmsk, eps=float(eps), metric=metric,
+            block=block, mesh=mesh, axis=axis, precision=precision,
+            backend=b2, pair_budget=pb,
+        )
+        dstats = np.asarray(dstats_dev)
+        total = c_np + np.asarray(delta_dev)
+        # Same self-count clamp as the fused counts pass: a valid
+        # point is always within eps of itself.
+        core_np = (np.maximum(total, 1) >= int(min_samples)) & omsk_np
+        return core_np, dstats
+
+    def _fold_overlap_stats(pstats, dstats):
+        """Fold the overlapped counts passes into the propagate
+        program's (P, 5) rows: band columns add (owned + delta ARE the
+        counts pass), one extra kernel pass is accounted, and the
+        delta rows ride along so the ladder's overflow check covers
+        the combined-slab delta extraction too (same budget family as
+        the propagate rows; the owned-slab pass has its own pre-ladder
+        exact retry, so its larger/smaller budget never muddies the
+        max-total-vs-max-budget check)."""
+        pstats = np.array(pstats, dtype=np.int64)
+        pstats = pstats.reshape(-1, pstats.shape[-1])
+        if dstats is None:
+            return pstats
+        pstats[:, 3:5] += ostats_np[:, 2:4] + dstats[:, 2:4]
+        pstats[:, 2] += 1
+        extra = np.zeros((dstats.shape[0], pstats.shape[1]), np.int64)
+        extra[:, :2] = dstats[:, :2]
+        return np.vstack([pstats, extra])
+
     if merge == "host":
 
         def run_step(pb, _mr):
             faults.maybe_fail("gm.execute")
-            out = _with_kernel_fallback(
-                lambda b2: _oc_host_tables(
+
+            def go(b2):
+                if overlap:
+                    core_np, dstats = _overlap_core(pb, b2)
+                    out = _oc_host_tables(
+                        (owned, omsk, ogid, bnd, bmsk, bgid),
+                        eps=eps, min_samples=min_samples, metric=metric,
+                        block=block, mesh=mesh, axis=axis, n_points=n,
+                        precision=precision, backend=b2, pair_budget=pb,
+                        own_core=core_np,
+                    )
+                    return out, dstats
+                out = _oc_host_tables(
                     (owned, omsk, ogid, bnd, bmsk, bgid),
                     eps=eps, min_samples=min_samples, metric=metric,
                     block=block, mesh=mesh, axis=axis, n_points=n,
                     precision=precision, backend=b2, pair_budget=pb,
-                ),
-                be,
-            )
+                )
+                return out, None
+
+            out, dstats = _with_kernel_fallback(go, be)
             # The host union-find merge is exact — no rounds ladder.
-            return out[:3], out[3], True
+            return out[:3], _fold_overlap_stats(out[3], dstats), True
 
         t0 = _time.perf_counter()
         with obs_span("gm.execute", merge="host"):
@@ -1554,16 +1956,32 @@ def global_morton_dbscan(
 
         def run_step(pb, mr):
             faults.maybe_fail("gm.execute")
-            home_label, core_g, b_glab, pstats = _with_kernel_fallback(
-                lambda b2: _gm_cluster_step(
+
+            def go(b2):
+                if overlap:
+                    core_np, dstats = _overlap_core(pb, b2)
+                    out = _gm_cluster_step(
+                        owned, omsk, ogid, bnd, bmsk, bgid,
+                        jax.device_put(core_np, sharding),
+                        eps=float(eps), min_samples=int(min_samples),
+                        metric=metric, block=block, mesh=mesh,
+                        axis=axis, n_points=n, precision=precision,
+                        backend=b2, pair_budget=pb,
+                    )
+                    return out, dstats
+                out = _gm_cluster_step(
                     owned, omsk, ogid, bnd, bmsk, bgid,
                     eps=float(eps), min_samples=int(min_samples),
                     metric=metric, block=block, mesh=mesh, axis=axis,
                     n_points=n, precision=precision, backend=b2,
                     pair_budget=pb,
-                ),
-                be,
+                )
+                return out, None
+
+            (home_label, core_g, b_glab, pstats), dstats = (
+                _with_kernel_fallback(go, be)
             )
+            pstats = _fold_overlap_stats(pstats, dstats)
             t_fix = _time.perf_counter()
             with obs_span("gm.fixpoint") as sp:
                 lab_map, rounds, converged = _gm_fixpoint(
@@ -1619,12 +2037,21 @@ def global_morton_dbscan(
         )
 
     # Build / exchange / compute / merge decomposition (the north-star
-    # artifact row's columns; surfaced as report() phases).
+    # artifact row's columns; surfaced as report() phases).  Overlap
+    # accounting: the ring seconds that ran concurrently with the
+    # owned-prefix counts pass (t_hidden) are attributed to COMPUTE —
+    # the device was making counts progress through that window — and
+    # removed from the exchange phase, so the four phases still sum to
+    # ~wall and "exchange hides behind compute" is a measured split,
+    # not a narrative.  exchange_overlap_efficiency = hidden ring
+    # seconds / total ring seconds (0.0 with overlap off, on warm
+    # cached exchanges, and on every non-GM route).
     stats.update(
         gm_build_s=round(t_build, 6),
         gm_exchange_s=round(t_exchange, 6),
-        gm_execute_s=round(max(t_execute, 0.0), 6),
+        gm_execute_s=round(max(t_execute, 0.0) + t_hidden, 6),
         gm_merge_s=round(t_merge, 6),
+        exchange_overlap_efficiency=round(float(overlap_eff), 6),
     )
     _exec_stats(stats, oc_on=True, pstats=pstats, block=block, k=k,
                 precision=precision, n=n)

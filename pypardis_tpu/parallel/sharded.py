@@ -1626,7 +1626,7 @@ def _oc_cluster_step(
 
 def _oc_host_tables(
     arrays, *, eps, min_samples, metric, block, mesh, axis, n_points,
-    precision, backend, pair_budget, overflow=None,
+    precision, backend, pair_budget, overflow=None, own_core=None,
 ):
     """The owner-computes ``merge='host'`` cluster step: two device
     programs with the host relaying the owners' core verdicts between
@@ -1640,14 +1640,28 @@ def _oc_host_tables(
     :func:`sharded_step_local` produced, plus 5-wide pair stats (the
     counts program's mixed-precision band columns fold in host-side,
     since the two owner-computes passes are separate programs here).
+
+    ``own_core`` (optional, (P, cap) bool numpy): precomputed owned
+    core flags — the global-Morton overlapped-counts route computes
+    them from an owned-slab pass plus a boundary delta; the counts
+    program here is then skipped (its band columns arrive pre-folded
+    from the caller, so they are zeros in the returned rows).
     """
     owned, owned_mask, owned_gid, halo, halo_mask, halo_gid = arrays
-    own_core_dev, counts_band = _oc_counts_step(
-        *arrays, eps=float(eps), min_samples=int(min_samples),
-        metric=metric, block=block, mesh=mesh, axis=axis,
-        precision=precision, backend=backend, pair_budget=pair_budget,
-    )
-    own_core = np.asarray(own_core_dev)
+    if own_core is None:
+        own_core_dev, counts_band = _oc_counts_step(
+            *arrays, eps=float(eps), min_samples=int(min_samples),
+            metric=metric, block=block, mesh=mesh, axis=axis,
+            precision=precision, backend=backend, pair_budget=pair_budget,
+        )
+        own_core = np.asarray(own_core_dev)
+        counts_band_np = np.asarray(counts_band).reshape(-1, 2)
+    else:
+        own_core = np.asarray(own_core)
+        own_core_dev = jax.device_put(
+            own_core, NamedSharding(mesh, P(axis))
+        )
+        counts_band_np = np.zeros((own_core.shape[0], 2), np.int64)
     if overflow is not None and int(np.asarray(overflow).sum()) != 0:
         raise _HaloOverflow()
     og_np = np.asarray(owned_gid)
@@ -1666,7 +1680,7 @@ def _oc_host_tables(
     )
     # Fold the counts program's band columns into the per-device rows
     # (host-side: the two passes are separate programs on this route).
-    cb = np.asarray(counts_band).reshape(-1, 2)
+    cb = counts_band_np
     pstats_np = np.array(pstats).reshape(cb.shape[0], -1)
     pstats_np[:, 3:5] += cb
     return own_glab, own_core_dev, halo_glab, pstats_np
@@ -1797,11 +1811,15 @@ def _sharded_hint_key(owned_shape, halo_cap, block, precision, eps, metric):
 
     The binding extraction runs per partition over (cap + hcap) points,
     so both capacities key the entry; eps/metric shape the live-pair
-    count directly.
+    count directly; the dispatch-mode tag keeps dense-grid budgets from
+    over-reserving the compacted kernels (and vice versa).
     """
+    from ..utils.hints import dispatch_tag
+
+    nt = (int(owned_shape[-2]) + int(halo_cap)) // max(int(block), 1)
     return (
-        "sharded", tuple(owned_shape), int(halo_cap), block, precision,
-        float(eps), str(metric),
+        "sharded", dispatch_tag(nt), tuple(owned_shape), int(halo_cap),
+        block, precision, float(eps), str(metric),
     )
 
 
@@ -1862,6 +1880,12 @@ def _exec_stats(stats, *, oc_on, pstats, block, k, precision, n):
                 block, max(cap + hcap, 1), int(k),
                 _norm_precision_mode(precision),
             ) or block
+        )
+        # Per-partition slab tiles: live_pair_fraction's denominator is
+        # tiles^2 (live_pairs is the worst-case partition's total over
+        # the same slab grid, so the fraction is bounded by 1).
+        stats["kernel_tiles"] = int(
+            -(-max(cap + hcap, 1) // stats["kernel_block"])
         )
     return stats
 

@@ -53,6 +53,26 @@ class BudgetHintCache:
         return key in self._d
 
 
+def dispatch_tag(nt: int | None = None) -> str:
+    """Hint-key component naming the kernel dispatch mode.
+
+    The compacted pair-list dispatch and the dense grid size their
+    budgets against different effective grids — a budget learned under
+    dense dispatch over-reserves the compacted kernels' static budget
+    (and a pair-mode budget can undershoot the dense-era pallas-parity
+    grid) — so every hint key carries the mode and entries never cross
+    it.  ``nt``: the caller's slab tile-count estimate for the
+    auto-by-size policy (a pre-segment-break estimate may disagree
+    with the kernel's post-break decision in a narrow band around the
+    threshold; the only cost is a missed hint, i.e. one extra
+    overflow rerun, never a wrong budget).  Lazy import: ops.distances
+    owns the env knob.
+    """
+    from ..ops.distances import pair_dispatch_enabled
+
+    return "pair" if pair_dispatch_enabled(nt) else "dense"
+
+
 # One shared instance: the single-shard driver (dbscan._pad_and_run) and
 # the sharded driver (parallel.sharded.sharded_dbscan) key their entries
 # differently, so they coexist without collisions.

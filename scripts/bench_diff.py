@@ -84,6 +84,20 @@ def load_bench_row(path: str) -> dict:
         s = _tail_samples(tail)
         if s:
             row["samples_s"] = s
+    if not row.get("samples_s") and str(row.get("metric", "")).startswith(
+        "northstar"
+    ):
+        # Single-rep north-star rows (the pre-sparsity NORTHSTAR_smoke
+        # committed one wall number, no samples array): the wall IS the
+        # one sample, so range comparison degenerates to the strict
+        # point comparison — exactly right for a 15-minute e2e run
+        # nobody repeats three times.
+        try:
+            v = float(row.get("value"))
+            if v == v and v > 0:
+                row["samples_s"] = [v]
+        except (TypeError, ValueError):
+            pass
     return row
 
 
@@ -142,25 +156,47 @@ def compare_rows(prior_row: dict, cur_row: dict, thr: float) -> dict:
     return {"verdict": overall, "threshold": thr, "metrics": metrics}
 
 
-def find_baseline(baseline_dir: str, metric: str):
-    """(path, row) of the highest-numbered BENCH_r*.json whose metric
-    matches and which carries usable samples, else (None, None)."""
+def _northstar_comparable(prior: dict, cur: dict) -> bool:
+    """North-star walls are only comparable at the SAME geometry —
+    the 120k CI smoke must never be range-compared against the 5M
+    committed row (both carry metric ``northstar_e2e``)."""
+    return all(
+        prior.get(k) == cur.get(k)
+        for k in ("n", "dim", "mesh_devices", "mode")
+    )
+
+
+def find_baseline(baseline_dir: str, metric: str, cur_row: dict = None):
+    """(path, row) of the highest-numbered archive whose metric matches
+    and which carries usable samples, else (None, None).
+
+    BENCH rows compare against ``BENCH_r*.json``; northstar rows
+    against ``NORTHSTAR_*.json`` at the same geometry (the same gate,
+    pointed at the committed north-star trajectory).
+    """
+    patterns = ("BENCH_r*.json",)
+    row_ok = None
+    if str(metric).startswith("northstar"):
+        patterns = ("NORTHSTAR_*.json",)
+        if cur_row is not None:
+            row_ok = lambda prior: _northstar_comparable(prior, cur_row)
     best = (None, None, -1)
-    for path in glob.glob(os.path.join(baseline_dir, "BENCH_r*.json")):
-        m = re.search(r"BENCH_r(\d+)\.json$", os.path.basename(path))
-        if not m:
-            continue
-        rnd = int(m.group(1))
-        try:
-            row = load_bench_row(path)
-        except (ValueError, OSError, json.JSONDecodeError):
-            continue  # e.g. a round that errored: no row to compare
-        if row.get("metric") != metric:
-            continue
-        if not _finite_samples(row, "samples_s"):
-            continue
-        if rnd > best[2]:
-            best = (path, row, rnd)
+    for pattern in patterns:
+        for path in glob.glob(os.path.join(baseline_dir, pattern)):
+            m = re.search(r"_r(\d+)\.json$", os.path.basename(path))
+            rnd = int(m.group(1)) if m else 0
+            try:
+                row = load_bench_row(path)
+            except (ValueError, OSError, json.JSONDecodeError):
+                continue  # e.g. a round that errored: no row to compare
+            if row.get("metric") != metric:
+                continue
+            if not _finite_samples(row, "samples_s"):
+                continue
+            if row_ok is not None and not row_ok(row):
+                continue
+            if rnd > best[2]:
+                best = (path, row, rnd)
     return best[0], best[1]
 
 
@@ -213,7 +249,9 @@ def main() -> None:
             fail("no JSON row on stdin to annotate")
         row = json.loads(lines[json_idx[-1]])
         bdir = opts["baseline_dir"] or "."
-        prior_path, prior_row = find_baseline(bdir, row.get("metric"))
+        prior_path, prior_row = find_baseline(
+            bdir, row.get("metric"), cur_row=row
+        )
         if prior_row is None:
             result = {"verdict": "no_baseline", "threshold": thr,
                       "metrics": {},

@@ -123,6 +123,16 @@ def main() -> None:
             f"telemetry.compute.band_fraction "
             f"{tel['compute']['band_fraction']!r} exceeds 1.0"
         )
+    # Dispatch-level sparsity contract (ISSUE 11): every row says what
+    # fraction of the dense T^2 tile grid the kernels actually visited
+    # and how much of the boundary-ring wall hid behind the overlapped
+    # counts pass — both fractions, both finite, on every route.
+    for key in ("live_pair_fraction", "exchange_overlap_efficiency"):
+        v = number("compute", key)
+        if not 0.0 <= v <= 1.0:
+            fail(
+                f"telemetry.compute.{key} {v!r} outside [0, 1]"
+            )
     # Resource-watermark contract (ISSUE 6): every row carries the
     # sampler's peaks, finite on every route (0 is legal — e.g. device
     # bytes on backends that don't report memory_stats — NaN never is).

@@ -1,17 +1,34 @@
-"""Timing breakdown: counts pass vs one minlab pass vs full pipeline,
-plus a precision-mode sweep of the counts pass (default / mixed / high /
-highest) — the kernel-level view of what ``precision="mixed"`` buys:
-one bf16 pass + band-restricted rescores vs bf16_3x vs native f32.
-Mixed rows also print the measured band stats (in-band pairs, rescored
-tile visits)."""
+"""Kernel-level timing breakdowns.
+
+Two sections:
+
+* **Dispatch sweep** (every backend, wired into ``make bench-smoke``
+  via ``make kernel-probe``): the XLA counts pass under DENSE dispatch
+  (scan all T^2 column tiles, ``lax.cond``-skip the pruned ones) vs
+  the COMPACTED pair-list dispatch (one scan step per live tile pair)
+  on the same Morton-sorted input — per-mode seconds, the measured
+  ``live_pair_fraction``, and a byte-parity assert.  Emits one JSON
+  row (``kernel_dispatch_sweep``) and exits nonzero on parity/sanity
+  failure, so the dense-dispatch win is a measured CI row, not a
+  claim.
+
+* **Pallas section** (TPU only): counts / minlab / full-fit timings
+  plus the precision-mode sweep (default / mixed / high / highest)
+  with mixed band stats — the kernel-level view of what
+  ``precision="mixed"`` buys.
+"""
+import json
+import os
 import sys
 import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-
-from scale_probe import make_data
 
 
 def t(fn, *args, reps=3, **kw):
@@ -24,20 +41,14 @@ def t(fn, *args, reps=3, **kw):
     return (time.perf_counter() - t0) / reps
 
 
-def main():
-    n = int(sys.argv[1])
-    d = int(sys.argv[2]) if len(sys.argv) > 2 else 16
-    eps = 2.4
-    block = int(sys.argv[3]) if len(sys.argv) > 3 else 2048
-    X = make_data(n, d)
-    from pypardis_tpu.ops.pallas_kernels import (
-        _pallas_block,
-        min_neighbor_label_pallas,
-        neighbor_counts_pallas,
-    )
+def _sorted_padded(n, d, block):
+    from benchdata import make_blob_data
+
     from pypardis_tpu.partition import spatial_order
     from pypardis_tpu.utils import round_up
 
+    X, _truth = make_blob_data(n, d)
+    X = X.astype(np.float32)
     t0 = time.perf_counter()
     X = X - X.mean(axis=0)
     order = spatial_order(X)
@@ -46,8 +57,72 @@ def main():
     cap = round_up(n, block)
     pts = np.zeros((cap, d), np.float32)
     pts[:n] = X
-    pts = jnp.asarray(pts)
-    mask = jnp.arange(cap) < n
+    return jnp.asarray(pts), jnp.arange(cap) < n, cap
+
+
+def dispatch_sweep(n, d, block, eps):
+    """Dense vs compacted XLA dispatch on the identical input; returns
+    the JSON row dict after asserting byte parity and a sane
+    live_pair_fraction."""
+    from pypardis_tpu.ops.distances import neighbor_counts, xla_pair_list
+
+    pts, mask, cap = _sorted_padded(n, d, block)
+    nt = cap // block
+    pairs, stats = xla_pair_list(pts, mask, eps, block, "nd")
+    total, budget = [int(v) for v in np.asarray(stats)]
+    if total > budget:
+        print(f"pair budget overflow ({total} > {budget}); "
+              f"re-extracting exact", file=sys.stderr)
+        pairs, stats = xla_pair_list(
+            pts, mask, eps, block, "nd", budget=total
+        )
+        total, budget = [int(v) for v in np.asarray(stats)]
+    frac = total / float(nt * nt)
+    dt_dense = t(neighbor_counts, pts, eps, mask, block=block)
+    dt_pair = t(
+        lambda: neighbor_counts(pts, eps, mask, block=block, pairs=pairs)
+    )
+    c_dense = np.asarray(neighbor_counts(pts, eps, mask, block=block))
+    c_pair = np.asarray(
+        neighbor_counts(pts, eps, mask, block=block, pairs=pairs)
+    )
+    assert np.array_equal(c_dense, c_pair), (
+        "dense vs compacted dispatch count mismatch"
+    )
+    assert 0.0 <= frac <= 1.0 and frac == frac, frac
+    speedup = dt_dense / dt_pair if dt_pair > 0 else float("inf")
+    print(f"counts[dispatch=dense ]: {dt_dense:.3f}s")
+    print(
+        f"counts[dispatch=pair  ]: {dt_pair:.3f}s  "
+        f"live_pair_fraction={frac:.4f} ({total}/{nt * nt} tile pairs) "
+        f"speedup={speedup:.2f}x"
+    )
+    return {
+        "metric": "kernel_dispatch_sweep",
+        "value": round(dt_pair, 4),
+        "unit": "s",
+        "n": n,
+        "dim": d,
+        "block": block,
+        "eps": eps,
+        "dense_s": round(dt_dense, 4),
+        "pair_s": round(dt_pair, 4),
+        "live_pairs": total,
+        "tile_pairs_total": nt * nt,
+        "live_pair_fraction": round(frac, 6),
+        "speedup_vs_dense": round(speedup, 3),
+        "parity": "byte-identical",
+    }
+
+
+def pallas_section(n, d, block, eps):
+    from pypardis_tpu.ops.pallas_kernels import (
+        _pallas_block,
+        min_neighbor_label_pallas,
+        neighbor_counts_pallas,
+    )
+
+    pts, mask, cap = _sorted_padded(n, d, block)
     print(f"pallas block: {_pallas_block(block, cap, d, 'high')}")
 
     dt_c = t(neighbor_counts_pallas, pts, eps, mask, block=block)
@@ -91,6 +166,17 @@ def main():
     print(f"full dbscan_fixed_size: {dt_f:.2f}s")
     est_rounds = (dt_f - dt_c) / dt_m
     print(f"=> est minlab passes: {est_rounds:.1f}")
+
+
+def main():
+    n = int(sys.argv[1])
+    d = int(sys.argv[2]) if len(sys.argv) > 2 else 16
+    eps = 2.4
+    block = int(sys.argv[3]) if len(sys.argv) > 3 else 2048
+    row = dispatch_sweep(n, d, block, eps)
+    print(json.dumps(row), flush=True)
+    if jax.default_backend() == "tpu":
+        pallas_section(n, d, block, eps)
 
 
 if __name__ == "__main__":

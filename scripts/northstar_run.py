@@ -182,6 +182,9 @@ def main():
         "compute_s": float(phases.get("gm_execute", 0.0)),
         "merge_s": float(phases.get("gm_merge", 0.0)),
         "gen_s": round(t_gen, 3),
+        # One-rep sample array: what bench_diff range-compares against
+        # the committed NORTHSTAR_*.json at the same geometry.
+        "samples_s": [round(wall, 3)],
         "pts_per_sec": round(n / wall, 1),
         "rss_anon_peak_gb": round(samp.peak, 3),
         "dataset_gb": round(n * dim * 4 / 1e9, 3),
