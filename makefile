@@ -4,9 +4,10 @@
 PY ?= python
 
 .PHONY: all wheel native test verify tpu-smoke bench bench-smoke \
-	partition-probe serve-probe live-probe global-morton-probe \
-	fault-probe bench-diff flight-check northstar northstar-smoke \
-	streammem-probe sort-probe kernel-probe demo clean
+	partition-probe serve-probe live-probe ingest-probe \
+	global-morton-probe fault-probe bench-diff flight-check \
+	northstar northstar-smoke streammem-probe sort-probe \
+	kernel-probe demo clean
 
 all: native test
 
@@ -46,9 +47,9 @@ bench:
 # check_bench_json --require-diff fails CI on a real regression),
 # then the CI-sized partitioner depth-scaling probe (fails when the
 # level builder's mp-doubling cost ratio exceeds 1.5x).
-bench-smoke: partition-probe serve-probe live-probe global-morton-probe \
-		fault-probe bench-diff flight-check northstar-smoke \
-		kernel-probe
+bench-smoke: partition-probe serve-probe live-probe ingest-probe \
+		global-morton-probe fault-probe bench-diff flight-check \
+		northstar-smoke kernel-probe
 	JAX_PLATFORMS=cpu BENCH_N=2000 BENCH_DIM=4 BENCH_REPS=1 \
 	BENCH_DEV_REPS=1 $(PY) bench.py \
 	| $(PY) scripts/bench_diff.py --annotate --baseline-dir . \
@@ -171,6 +172,21 @@ live-probe:
 	LIVE_N=$${LIVE_N:-4000} LIVE_SECONDS=$${LIVE_SECONDS:-1.5} \
 	$(PY) scripts/live_probe.py \
 	| $(PY) scripts/check_bench_json.py
+
+# Streaming-ingest probe (ISSUE 12): asserts one-recluster-dispatch +
+# one-index-delta per insert_batch (B=256, vs the per-point control),
+# IngestQueue coalescing with ARI == 1.0 vs full refit, predict
+# bitwise oracle-exact across a background-compaction epoch swap
+# (in-flight tickets resolve against the old generation, zero
+# dropped), then runs the mixed reader+writer Poisson harness across
+# >= 1 compaction and emits the schema'd ingest@1 row through the
+# bench_diff cross-round gate.
+ingest-probe:
+	JAX_PLATFORMS=cpu \
+	INGEST_N=$${INGEST_N:-4000} INGEST_SECONDS=$${INGEST_SECONDS:-2.0} \
+	$(PY) scripts/ingest_probe.py \
+	| $(PY) scripts/bench_diff.py --annotate --baseline-dir . \
+	| $(PY) scripts/check_bench_json.py --require-diff
 
 # KDPartitioner build-time-vs-max_partitions rows (both builders, with
 # per-level breakdowns).  Full-size run: `PROBE_N=10000000 make

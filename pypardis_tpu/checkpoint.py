@@ -143,6 +143,12 @@ def save_model(model, path: str, *, live=None, index=None) -> None:
                 "counters": {
                     k: int(v) for k, v in live["counters"].items()
                 },
+                # A compaction cycle was mid-flight at save time: the
+                # saved index is the (complete, consistent) pre-swap
+                # generation; the partial one is discarded on load.
+                "compact_pending": bool(
+                    live.get("compact_pending", False)
+                ),
             }),
         )
     if index is not None:
@@ -284,6 +290,7 @@ def load_model(path: str):
                 "tree": z["live_tree"],
                 "next_label": lmeta["next_label"],
                 "counters": lmeta["counters"],
+                "compact_pending": lmeta.get("compact_pending", False),
                 "index": idx,
             }
         # ``result`` builds lazily from the restored keys/labels (the

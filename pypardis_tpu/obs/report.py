@@ -554,6 +554,18 @@ def format_summary(report: Dict) -> str:
         )
     lv = report.get("live")
     if lv:
+        bs = lv.get("batch_sizes") or []
+        batch_bit = (
+            f", batch mean {sum(bs) / len(bs):.1f} rows "
+            f"({lv.get('reclusters_per_write', 0):.3f} reclusters/row)"
+            if bs else ""
+        )
+        compact_bit = (
+            f", compact x{lv.get('compactions', 0)} "
+            f"({lv.get('compaction_s', 0):.1f}s, "
+            f"{lv.get('epoch_swaps', 0)} swap(s))"
+            if lv.get("compactions", 0) else ""
+        )
         lines.append(
             f"  live: {lv.get('points', 0):,} pts "
             f"({lv.get('cores', 0):,} cores), "
@@ -564,6 +576,7 @@ def format_summary(report: Dict) -> str:
             f"epoch {lv.get('index_epoch', 0)} "
             f"({_fmt_bytes(lv.get('index_delta_bytes', 0))} delta), "
             f"insert p50 {lv.get('insert_p50_ms', 0):.1f}ms"
+            f"{batch_bit}{compact_bit}"
         )
     res = report.get("resources") or {}
     if res.get("samples", 0) > 0:

@@ -24,15 +24,25 @@ Surface via the model: ``DBSCAN.predict(X)`` / ``DBSCAN.query_engine()``;
 persistence via :func:`pypardis_tpu.checkpoint.save_index` /
 ``load_index`` (and ``save_model`` checkpoints carry the core points, so
 a restarted process serves without re-clustering).
+
+The write path mirrors it: :class:`LiveModel` (:mod:`.live`) maintains
+the clustering under insert/delete, and the streaming-ingest layer
+(:mod:`.ingest`) adds batched writes (:class:`IngestQueue` coalescing,
+one recluster dispatch + one index delta per batch) and LSM-style
+background compaction (:class:`Compactor`) with an atomic whole-index
+epoch swap that never drops in-flight tickets.
 """
 
 from .engine import QueryEngine, ReplicatedQueryEngine
 from .index import CorePointIndex, build_index
+from .ingest import Compactor, IngestQueue
 from .live import LiveModel
 from .load import sustained_load
 
 __all__ = [
+    "Compactor",
     "CorePointIndex",
+    "IngestQueue",
     "QueryEngine",
     "ReplicatedQueryEngine",
     "LiveModel",

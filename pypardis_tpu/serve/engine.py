@@ -390,8 +390,16 @@ class QueryEngine:
             "shed_total": int(self._shed),
             "deadline_failures": int(self._deadline_failures),
             # Live-update generation of the underlying index (bumped by
-            # every in-place serve_index_delta refresh).
+            # every in-place serve_index_delta refresh), and the
+            # whole-index generation counter (bumped by each
+            # compaction epoch swap — serve.ingest.Compactor replaces
+            # the slabs in place, so this engine serves the new
+            # generation with no rebuild; in-flight tickets are
+            # drained against the old one first).
             "index_epoch": int(getattr(self.index, "epoch", 0)),
+            "index_generation": int(
+                getattr(self.index, "generation", 0)
+            ),
             "index_delta_bytes": int(
                 staging.route_delta_nbytes("serve_index_delta")
             ),

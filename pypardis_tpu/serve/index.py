@@ -108,6 +108,15 @@ class CorePointIndex:
         # and per-slot point gids so deletions can find their columns.
         self.epoch = 0
         self.delta_bytes = 0
+        # Streaming-ingest generation state (serve.ingest): how many
+        # whole-index generation swaps this object has absorbed, how
+        # many write deltas landed since the last one, and the column
+        # width the current generation was BUILT with — appended slabs
+        # past it are the LSM write debt the compaction trigger policy
+        # watermarks (appended_slab_bytes).
+        self.generation = 0
+        self.deltas_since_compact = 0
+        self._base_cols = int(self.coords.shape[1])
         if leaf_slabs is not None:
             self.leaf_slabs = {
                 int(l): [int(s) for s in slabs]
@@ -132,7 +141,7 @@ class CorePointIndex:
     def build(
         cls, cores, labels, eps, *, leaves: Optional[int] = None,
         block: int = 256, qblock: int = 128, seed: int = 0,
-        stage: bool = True,
+        stage: bool = True, center=None,
     ):
         """Index ``(n_core, d)`` core points with their cluster labels.
 
@@ -141,6 +150,12 @@ class CorePointIndex:
         largest bucket); ``qblock``: query rows per tile.  ``stage``
         ships the slabs to device immediately so the build's
         ``staged_bytes_reused``/``staged_bytes`` telemetry is complete.
+        ``center`` overrides the recentring frame (default: the core
+        mean) — a compaction rebuild passes the PREVIOUS generation's
+        center so queries already centered and queued against the old
+        generation stay valid across the epoch swap (any center is
+        correct; the frame only sets f32 rounding, and kernels + oracle
+        share it).
         """
         validate_params(eps, 1)
         cores = np.asarray(cores)
@@ -157,7 +172,9 @@ class CorePointIndex:
         t0 = time.perf_counter()
         if n == 0:
             idx = cls(
-                eps=eps, center=np.zeros(d), tree=[],
+                eps=eps,
+                center=np.zeros(d) if center is None else center,
+                tree=[],
                 coords=np.full((d, 0), PAD_COORD, np.float32),
                 labels=np.empty(0, np.int32),
                 blo=np.empty((0, d), np.float32),
@@ -173,7 +190,10 @@ class CorePointIndex:
         # after a f64 subtract keeps GPS-scale magnitudes accurate) —
         # the center also recenters every query, so distances are
         # preserved exactly.
-        center = cores.mean(axis=0, dtype=np.float64)
+        if center is None:
+            center = cores.mean(axis=0, dtype=np.float64)
+        else:
+            center = np.asarray(center, np.float64)
         cores_c = np.ascontiguousarray(
             (cores.astype(np.float64) - center).astype(np.float32)
         )
@@ -263,6 +283,20 @@ class CorePointIndex:
     @property
     def nb(self) -> int:
         return self.leaf_cap // self.block
+
+    @property
+    def appended_slab_bytes(self) -> int:
+        """Bytes of the slabs appended past this generation's build
+        layout — the LSM write debt the compaction trigger policy
+        watermarks (``PYPARDIS_COMPACT_SLAB_BYTES``).  Zero right after
+        a build or a generation swap."""
+        extra = int(self.coords.shape[1]) - self._base_cols
+        if extra <= 0:
+            return 0
+        nb = extra // max(self.block, 1)
+        # coords (d x f32) + labels (i32) + gids (i64) per column, plus
+        # the per-block bound rows.
+        return int(extra * (4 * self.d + 4 + 8) + nb * (8 * self.d))
 
     # -- device residency -------------------------------------------------
 
@@ -590,12 +624,54 @@ class CorePointIndex:
             )
         self.epoch += 1
         self.delta_bytes += int(delta)
+        self.deltas_since_compact += 1
         self.stats["n_leaves"] = self.n_leaves
         self.stats["index_bytes"] = int(
             self.coords.nbytes + self.labels.nbytes + self.blo.nbytes
             + self.bhi.nbytes
         )
         return int(delta)
+
+    def replace_generation(self, fresh: "CorePointIndex") -> None:
+        """Whole-index generation swap, IN PLACE: adopt a freshly built
+        index's slabs/tree/bounds/gids wholesale while keeping this
+        object's identity and epoch clock.
+
+        This is the PR 8 epoch mechanism extended from per-leaf deltas
+        to whole generations: every engine holding this index object —
+        the live engine, a ReplicatedQueryEngine, anything a caller
+        built over it — sees the compacted generation at its next
+        dispatch, and the epoch bump makes replica caches keyed on it
+        re-broadcast.  The fresh build must share this generation's
+        recentring frame (``build(center=self.center)``) so queries
+        centered before the swap stay valid; the caller (the Compactor)
+        drains in-flight tickets against the OLD slabs first, so
+        readers submitted before the swap resolve against the old
+        generation and readers after see the new one.
+        """
+        if getattr(self, "_pending", None) is not None:
+            raise RuntimeError(
+                "cannot swap index generations with a delta update open; "
+                "commit_update() first"
+            )
+        from ..parallel import staging
+
+        for attr in ("center", "tree", "coords", "labels", "blo", "bhi",
+                     "block", "qblock", "n_core", "leaf_slabs", "gids"):
+            setattr(self, attr, getattr(fresh, attr))
+        self.src_index = getattr(fresh, "src_index", None)
+        self.stats = dict(fresh.stats)
+        self._gid_col = None
+        # Drop the old generation's device residency: the next
+        # device_arrays() stages the compacted slabs under their own
+        # content key (a FULL re-ship, the compaction's one bulk
+        # transfer — write deltas stay cheap between swaps).
+        self._dev = None
+        staging.device_evict("serve_index")
+        self._base_cols = int(self.coords.shape[1])
+        self.deltas_since_compact = 0
+        self.generation += 1
+        self.epoch += 1
 
     # -- query-side layout ------------------------------------------------
 

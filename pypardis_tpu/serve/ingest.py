@@ -1,0 +1,476 @@
+"""Streaming ingest: batched writes + LSM-style background compaction.
+
+The read path coalesces (``QueryEngine`` batches tickets per Clipper);
+this module is its write-side mirror, making heavy write traffic a
+first-class workload instead of an interactive convenience:
+
+* :class:`IngestQueue` — a bounded queue that coalesces individual
+  writes into batches the way the query engine coalesces reads.
+  ``submit_insert``/``submit_delete`` return :class:`WriteTicket`\\ s
+  immediately; ``flush()`` groups CONSECUTIVE same-kind writes (order
+  is preserved — an insert/delete interleaving is semantically ordered)
+  into ``max_batch_rows``-row batches and dispatches each through
+  :meth:`LiveModel.insert_batch` / :meth:`LiveModel.delete_batch`,
+  so a stream of B single-point writes costs ONE union blast radius,
+  ONE recluster kernel dispatch, and ONE index delta instead of B of
+  each.
+
+* :class:`Compactor` — the LSM maintenance schedule.  Write deltas
+  accumulate in the serving index's appended slabs (the L0 of this
+  design); when the deterministic trigger policy fires (appended-slab
+  bytes or delta count past the ``PYPARDIS_COMPACT_*`` watermarks), a
+  background full refit — checkpoint-resumable through the PR 9
+  jobstate machinery, so a killed compaction resumes instead of
+  restarting — re-clusters the current point set, re-Mortons and
+  re-balances the cores into a fresh index generation built in the
+  SAME recentring frame, and atomically **epoch-swaps** it into the
+  live index object (:meth:`CorePointIndex.replace_generation`)
+  without dropping in-flight tickets: the swap drains the engine
+  first, so readers submitted before it resolve against the old
+  generation and readers after see the new one, and every engine
+  holding the index object (replicated ones included) picks the new
+  generation up through the epoch bump.  Writes that land DURING the
+  compaction are replayed through the normal incremental algebra
+  against the new generation at swap time — the memtable-replay step
+  of any LSM store.
+
+The lineage is the LSM-tree (O'Neil, Cheng, Gauthier & O'Neil 1996 —
+see PAPERS.md): absorb writes in cheap append-structured deltas, pay
+the re-organization in a background merge, serve reads continuously
+from the freshest generation.
+
+Fault injection sites (``PYPARDIS_FAULTS``): ``ingest.batch`` fires at
+the head of every batched write — before any state mutates, so an
+injected failure leaves the model untouched and the queue fails only
+that batch's tickets; ``compact.phase`` fires at each compaction phase
+boundary (snapshot / refit / build / swap — occurrences 1..4), and the
+refit inside additionally carries every existing fit-path site.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return int(default)
+
+
+# Deterministic compaction watermarks: compact once the appended slabs
+# hold this many bytes, or this many write deltas have landed since the
+# last generation swap — whichever fires first.  Defaults are sized so
+# interactive CI workloads never auto-trigger; production knobs.
+DEFAULT_COMPACT_SLAB_BYTES = 64 << 20
+DEFAULT_COMPACT_DELTAS = 512
+
+
+class WriteTicket:
+    """One submitted write; resolved (ids assigned / error set) by the
+    next :meth:`IngestQueue.flush`."""
+
+    __slots__ = (
+        "kind", "rows", "ids", "error", "latency_ms", "visible_ms",
+        "_t_submit", "_payload",
+    )
+
+    def __init__(self, kind: str, payload):
+        self.kind = kind  # "insert" | "delete"
+        self._payload = payload
+        self.rows = int(len(payload))
+        self.ids: Optional[np.ndarray] = None
+        self.error: Optional[BaseException] = None
+        self.latency_ms: Optional[float] = None
+        # Set by harnesses that measure update-visible latency (the
+        # wall from submit until a predict of the written point answers
+        # through the refreshed index); None when nobody measured it.
+        self.visible_ms: Optional[float] = None
+        self._t_submit = time.perf_counter()
+
+    @property
+    def done(self) -> bool:
+        return self.ids is not None or self.error is not None
+
+    @property
+    def failed(self) -> bool:
+        return self.error is not None
+
+    def result(self) -> np.ndarray:
+        if self.error is not None:
+            raise self.error
+        if self.ids is None:
+            raise RuntimeError(
+                "write ticket not resolved yet; call IngestQueue.flush()"
+            )
+        return self.ids
+
+
+class IngestQueue:
+    """Bounded write coalescer over a :class:`LiveModel`.
+
+    The write-side twin of the query engine's submit/drain queue:
+    ``submit_*`` validates and enqueues (``QueueFull`` backpressure at
+    ``max_pending_rows`` — never silent truncation), ``flush()`` walks
+    the queue in order, groups consecutive same-kind writes into
+    ``max_batch_rows``-row batches, and dispatches each as ONE batched
+    update.  A batch that fails (an injected ``ingest.batch`` fault, a
+    validation error surfacing late) fails ONLY its own tickets — the
+    flush continues, and the error rides the tickets the way a blown
+    deadline rides query tickets.
+    """
+
+    def __init__(self, live, *, max_batch_rows: int = 1024,
+                 max_pending_rows: int = 1 << 16):
+        self.live = live
+        self.max_batch_rows = int(max_batch_rows)
+        self.max_pending_rows = int(max_pending_rows)
+        self._pending: deque = deque()
+        self._pending_rows = 0
+        self.batches = 0
+        self.rows = 0
+        self.shed = 0
+        self.failed_batches = 0
+        self._batch_rows: deque = deque(maxlen=256)
+
+    def _enqueue(self, t: WriteTicket) -> WriteTicket:
+        from .engine import QueueFull
+
+        if self._pending_rows + t.rows > self.max_pending_rows:
+            self.shed += 1
+            raise QueueFull(
+                f"ingest queue full ({self._pending_rows} rows pending, "
+                f"max_pending_rows={self.max_pending_rows}); flush() "
+                f"first or shed load upstream"
+            )
+        self._pending.append(t)
+        self._pending_rows += t.rows
+        return t
+
+    def submit_insert(self, X) -> WriteTicket:
+        """Enqueue an insert (validated now, applied at the next
+        flush); returns the ticket whose ``ids`` the flush fills."""
+        X = self.live._check_points(X)
+        return self._enqueue(WriteTicket("insert", X))
+
+    def submit_delete(self, ids) -> WriteTicket:
+        """Enqueue a delete by stable ids (existence is checked at
+        flush time, against the state the preceding writes produce)."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        return self._enqueue(WriteTicket("delete", ids))
+
+    def _groups(self) -> List[Tuple[str, List[WriteTicket]]]:
+        groups: List[Tuple[str, List[WriteTicket]]] = []
+        cur_kind, cur, cur_rows = None, [], 0
+        while self._pending:
+            t = self._pending.popleft()
+            if (
+                t.kind != cur_kind
+                or (cur and cur_rows + t.rows > self.max_batch_rows)
+            ):
+                if cur:
+                    groups.append((cur_kind, cur))
+                cur_kind, cur, cur_rows = t.kind, [], 0
+            cur.append(t)
+            cur_rows += t.rows
+        if cur:
+            groups.append((cur_kind, cur))
+        return groups
+
+    def flush(self) -> List[WriteTicket]:
+        """Apply every pending write, coalesced; returns the tickets
+        resolved by this flush (failed ones included)."""
+        if not self._pending:
+            return []
+        resolved: List[WriteTicket] = []
+        for kind, tickets in self._groups():
+            now = time.perf_counter
+            try:
+                if kind == "insert":
+                    X = (
+                        tickets[0]._payload if len(tickets) == 1
+                        else np.concatenate(
+                            [t._payload for t in tickets]
+                        )
+                    )
+                    ids = self.live.insert_batch(X)
+                    s = 0
+                    for t in tickets:
+                        t.ids = ids[s:s + t.rows]
+                        s += t.rows
+                else:
+                    ids = (
+                        tickets[0]._payload if len(tickets) == 1
+                        else np.concatenate(
+                            [t._payload for t in tickets]
+                        )
+                    )
+                    self.live.delete_batch(ids)
+                    for t in tickets:
+                        t.ids = t._payload
+                n_rows = sum(t.rows for t in tickets)
+                self.batches += 1
+                self.rows += n_rows
+                self._batch_rows.append(n_rows)
+            except Exception as e:  # noqa: BLE001 — per-batch failure
+                self.failed_batches += 1
+                for t in tickets:
+                    t.error = e
+            for t in tickets:
+                t.latency_ms = (now() - t._t_submit) * 1e3
+                t._payload = None
+                self._pending_rows -= t.rows
+                resolved.append(t)
+        return resolved
+
+    def stats(self) -> Dict:
+        br = list(self._batch_rows)
+        return {
+            "batches": int(self.batches),
+            "rows": int(self.rows),
+            "pending_rows": int(self._pending_rows),
+            "shed": int(self.shed),
+            "failed_batches": int(self.failed_batches),
+            "mean_batch_rows": (
+                round(sum(br) / len(br), 2) if br else 0.0
+            ),
+        }
+
+
+class Compactor:
+    """Background full-refit compaction with atomic epoch swap.
+
+    One cycle (:meth:`compact`): snapshot the live point set under the
+    lock → full refit of the snapshot (a fresh ``DBSCAN`` fit,
+    checkpoint-resumable when ``ckpt`` is given — a SIGKILLed
+    compaction resumes its fixpoint instead of restarting) → build a
+    fresh :class:`CorePointIndex` generation over the refit cores in
+    the OLD generation's recentring frame → under the lock, drain the
+    engine (in-flight readers resolve against the old generation),
+    install the compacted clustering + index generation in place, and
+    replay the writes that landed during the refit through the normal
+    incremental algebra.  The live index keeps serving throughout; the
+    only serialized sections are the snapshot and the swap.
+
+    ``lock`` serializes the snapshot/swap against writers and the
+    engine's drain — pass the serving harness's lock (or let the
+    harness adopt :attr:`lock`).  ``fit_kw`` overrides the refit's
+    DBSCAN construction (``mode``/``merge``/``mesh``/...); by default
+    the refit runs the fused single-device engine with the live
+    model's eps/min_samples/block/precision.
+    """
+
+    PHASES = ("snapshot", "refit", "build", "swap")
+
+    def __init__(
+        self, live, *, ckpt: Optional[str] = None, lock=None,
+        slab_bytes: Optional[int] = None,
+        max_deltas: Optional[int] = None,
+        fit_kw: Optional[Dict] = None,
+        phase_hook: Optional[Callable[[str], None]] = None,
+    ):
+        self.live = live
+        self.ckpt = ckpt
+        self.lock = lock if lock is not None else threading.Lock()
+        self.slab_bytes = (
+            int(slab_bytes) if slab_bytes is not None
+            else _env_int("PYPARDIS_COMPACT_SLAB_BYTES",
+                          DEFAULT_COMPACT_SLAB_BYTES)
+        )
+        self.max_deltas = (
+            int(max_deltas) if max_deltas is not None
+            else _env_int("PYPARDIS_COMPACT_DELTAS",
+                          DEFAULT_COMPACT_DELTAS)
+        )
+        self.fit_kw = dict(fit_kw or {})
+        # Test/telemetry seam: called at each phase boundary (after the
+        # fault site) — deterministic mid-compaction scheduling without
+        # threads (the save/load and concurrent-write regression tests).
+        self._phase_hook = phase_hook
+        self.stats: Dict = {
+            "compactions": 0, "compaction_s": 0.0, "resumed_rounds": 0,
+            "replayed_inserts": 0, "replayed_deletes": 0,
+        }
+        # [(perf_counter start, end)] of completed cycles — the mixed
+        # load harness classifies read latencies against these windows.
+        self.windows: List[Tuple[float, float]] = []
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._active = False
+
+    # -- trigger policy ---------------------------------------------------
+
+    def should_compact(self) -> bool:
+        """Deterministic watermark policy: appended-slab bytes or the
+        delta count since the last swap crossed its threshold."""
+        idx = self.live.index
+        return (
+            idx.appended_slab_bytes >= self.slab_bytes
+            or idx.deltas_since_compact >= self.max_deltas
+        )
+
+    def maybe_compact(self) -> bool:
+        """Fire a background cycle when the policy says so (no-op while
+        one is already running); returns whether one was started."""
+        if self.running or not self.should_compact():
+            return False
+        self.start()
+        return True
+
+    # -- the cycle --------------------------------------------------------
+
+    def _phase(self, name: str) -> None:
+        from ..utils import faults
+
+        faults.maybe_fail("compact.phase")
+        if self._phase_hook is not None:
+            self._phase_hook(name)
+
+    def compact(self) -> Dict:
+        """Run one synchronous compaction cycle; returns its stats."""
+        if self._active:
+            raise RuntimeError("a compaction cycle is already running")
+        self._active = True
+        live = self.live
+        t0 = time.perf_counter()
+        try:
+            self._phase("snapshot")
+            with self.lock:
+                snap = live.begin_compaction_snapshot()
+            try:
+                self._phase("refit")
+                labels, core, resumed = self._refit(snap)
+                self._phase("build")
+                fresh = self._build_generation(snap, labels, core)
+                self._phase("swap")
+                with self.lock:
+                    replayed = live._install_generation(
+                        snap, labels, core, fresh
+                    )
+            finally:
+                live._compact_active = False
+            if self.ckpt:
+                # A finished cycle's snapshot must never be resumed by
+                # the NEXT one (different point set -> the fingerprint
+                # guard would refuse the whole refit).
+                from ..utils.jobstate import _norm_npz
+
+                p = _norm_npz(self.ckpt)
+                if os.path.exists(p):
+                    os.unlink(p)
+            dt = time.perf_counter() - t0
+            self.windows.append((t0, time.perf_counter()))
+            self.stats["compactions"] += 1
+            self.stats["compaction_s"] = round(
+                self.stats["compaction_s"] + dt, 6
+            )
+            self.stats["resumed_rounds"] += int(resumed)
+            self.stats["replayed_inserts"] += int(replayed[0])
+            self.stats["replayed_deletes"] += int(replayed[1])
+            live._note_compaction(dt)
+            return dict(self.stats)
+        finally:
+            self._active = False
+
+    def _refit(self, snap):
+        """Full refit of the snapshot set — checkpoint-resumable: a
+        jobstate file from a KILLED cycle over the SAME snapshot
+        resumes; one from a different snapshot is discarded (the
+        partial generation it described is obsolete)."""
+        from ..dbscan import DBSCAN
+
+        live = self.live
+        kw = {
+            "eps": live.eps,
+            "min_samples": live.min_samples,
+            "block": int(live.model.block),
+            "precision": live.model.precision,
+            "kernel_backend": live.model.kernel_backend,
+        }
+        kw.update(self.fit_kw)
+        if "mesh" not in kw and "mode" not in kw:
+            from ..parallel.mesh import default_mesh
+
+            kw["mesh"] = default_mesh(1)
+        model = DBSCAN(**kw)
+        if self.ckpt:
+            from ..utils.jobstate import discard_stale, fit_meta
+
+            discard_stale(self.ckpt, fit_meta(
+                snap["points"], eps=model.eps,
+                min_samples=model.min_samples,
+                metric=model.metric if isinstance(model.metric, str)
+                else getattr(model.metric, "__name__", "callable"),
+                block=model.block, mode=model.mode,
+            ))
+        model.train(snap["points"], resume=self.ckpt)
+        resumed = 0
+        js = getattr(model, "_jobstate", None)
+        if js is not None:
+            resumed = int(js.restored_rounds) + int(
+                js.restored_partitions
+            )
+        return (
+            np.asarray(model.labels_, np.int32),
+            np.asarray(model.core_sample_mask_, bool),
+            resumed,
+        )
+
+    def _build_generation(self, snap, labels, core):
+        """The fresh generation: refit cores re-Morton-sorted and
+        re-balanced into a build-layout index (no appended slabs), in
+        the OLD generation's recentring frame, gid-tagged with the
+        snapshot's stable ids."""
+        from .index import CorePointIndex
+
+        live = self.live
+        idx = live.index
+        fresh = CorePointIndex.build(
+            snap["points"][core], labels[core], live.eps,
+            block=idx.block, qblock=idx.qblock, stage=False,
+            center=idx.center,
+        )
+        fresh.attach_gids(snap["ids"][core])
+        return fresh
+
+    # -- background execution ---------------------------------------------
+
+    @property
+    def running(self) -> bool:
+        return self._active or (
+            self._thread is not None and self._thread.is_alive()
+        )
+
+    def start(self) -> threading.Thread:
+        """Run one cycle on a background thread (the live index keeps
+        serving; only snapshot and swap take the lock)."""
+        if self.running:
+            raise RuntimeError("a compaction cycle is already running")
+        self._error = None
+
+        def run():
+            try:
+                self.compact()
+            except BaseException as e:  # noqa: BLE001 — join re-raises
+                self._error = e
+
+        self._thread = threading.Thread(
+            target=run, name="pypardis-compactor", daemon=True
+        )
+        self._thread.start()
+        return self._thread
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        """Wait for the background cycle; re-raises its error."""
+        if self._thread is not None:
+            self._thread.join(timeout)
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err

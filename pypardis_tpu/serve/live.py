@@ -186,9 +186,24 @@ class LiveModel:
         self._counters = {
             "inserts": 0, "deletes": 0, "updates": 0,
             "recluster_events": 0, "recluster_points": 0,
+            "recluster_dispatches": 0,
             "label_remaps": 0,
+            "compactions": 0, "epoch_swaps": 0,
         }
         self._last_fraction = 0.0
+        # Streaming-ingest state (serve.ingest): sizes of the write
+        # batches applied (singles are size-1 batches — the
+        # amortization gauge reclusters_per_write reads off them),
+        # cumulative background-compaction seconds, whether a Compactor
+        # cycle is mid-flight (persisted by save() so a restore knows a
+        # partial generation was discarded), and the replay flag that
+        # keeps compaction-replay traffic out of the user-facing write
+        # counters/latencies while its kernel work stays counted.
+        self._batch_sizes: deque = deque(maxlen=64)
+        self._compaction_s = 0.0
+        self._compact_active = False
+        self.compact_pending = False
+        self._replay = False
         # Lazy model-surface sync (satellite, CHANGES PR 8 note):
         # updates only mark the model's labels_/core_sample_mask_/data
         # dirty; the O(N) copies happen at most once per READ of those
@@ -246,9 +261,46 @@ class LiveModel:
         core point joins (nearest core's cluster); a newcomer or
         neighbor crossing the core threshold triggers the local
         re-cluster + union-find merge described in the module docs.
+        A multi-row ``X`` is ONE batch: one union blast radius, one
+        recluster dispatch, one index delta (see :meth:`insert_batch`).
         """
+        return self._do_insert(self._check_points(X))
+
+    def insert_batch(self, X) -> np.ndarray:
+        """Batched insert — the streaming-ingest write primitive.
+
+        Semantically identical to :meth:`insert` (which already
+        amortizes per batch); this is the explicit ingest surface: it
+        carries the ``ingest.batch`` fault-injection site (fired BEFORE
+        any state mutates, so an injected failure leaves the model
+        untouched) and is what :class:`~pypardis_tpu.serve.ingest.
+        IngestQueue` coalesces single-point write streams into.
+        Inserting B points here costs exactly one recluster kernel
+        dispatch and one index delta (``recluster_dispatches`` /
+        ``index_epoch`` in the stats pin it; ``make ingest-probe``
+        asserts it at B=256).
+        """
+        from ..utils import faults
+
+        faults.maybe_fail("ingest.batch")
+        return self._do_insert(self._check_points(X))
+
+    def delete_batch(self, ids) -> int:
+        """Batched delete by stable ids — one union blast radius over
+        the affected clusters, one recluster dispatch, one index delta
+        (the :meth:`insert_batch` contract, delete-side).  Carries the
+        ``ingest.batch`` fault site, fired before any state mutates."""
+        from ..utils import faults
+
+        faults.maybe_fail("ingest.batch")
+        return self.delete(ids)
+
+    def _do_insert(self, X, ids=None) -> np.ndarray:
+        """The insert algebra.  ``ids=None`` appends fresh stable ids;
+        a compaction replay passes the ids it is re-applying (rows
+        already present in ``_coords``/``_leaf_of``, currently marked
+        dead by the generation install)."""
         t0 = time.perf_counter()
-        X = self._check_points(X)
         m = len(X)
         if m == 0:
             return np.empty(0, np.int64)
@@ -278,7 +330,13 @@ class LiveModel:
         )
         new_core = new_counts_p >= ms
 
-        ids = self._append(X)
+        if ids is None:
+            ids = self._append(X)
+        else:
+            # Replay revival: rows/leaf membership already in place.
+            ids = np.asarray(ids, np.int64)
+            self._alive[ids] = True
+            self._labels[ids] = -1
         self._core[ids] = new_core
         self._core[flips] = True
 
@@ -392,6 +450,8 @@ class LiveModel:
         leaves = self._leaves_reaching(changed_pts)
         S = self._members(leaves)
         s_core = S[self._core[S]]
+        if len(s_core) >= 2:
+            self._counters["recluster_dispatches"] += 1
         comp = core_components(
             self._coords[s_core], self.eps,
             block=min(int(self.model.block), 256),
@@ -437,6 +497,8 @@ class LiveModel:
         in_affected = np.isin(self._labels[:self._n], affected) & alive
         S = np.flatnonzero(in_affected).astype(np.int64)
         s_core = S[self._core[S]]
+        if len(s_core) >= 2:
+            self._counters["recluster_dispatches"] += 1
         comp = core_components(
             self._coords[s_core], self.eps,
             block=min(int(self.model.block), 256),
@@ -583,9 +645,14 @@ class LiveModel:
         )
 
     def _finish_update(self, kind, m, t0, lat) -> None:
-        lat.append((time.perf_counter() - t0) * 1e3)
-        self._counters[kind] += int(m)
-        self._counters["updates"] += 1
+        # Compaction replay re-applies writes the user already counted;
+        # its kernel work stays in the recluster counters, but the
+        # user-facing write volumes/latencies/batch sizes don't move.
+        if not self._replay:
+            lat.append((time.perf_counter() - t0) * 1e3)
+            self._counters[kind] += int(m)
+            self._counters["updates"] += 1
+            self._batch_sizes.append(int(m))
         self._mark_dirty()
         self._publish()
 
@@ -659,7 +726,102 @@ class LiveModel:
             "warm_compile_ms": round(float(self._warm_ms), 3),
             "model_syncs": int(self._syncs),
             "model_sync_bytes": int(self._sync_bytes),
+            # Streaming-ingest block (serve.ingest): write-batch sizes
+            # applied (singles are 1-row batches), the amortization
+            # gauge (recluster events per written row — 1/B for a
+            # B-row batch that reclustered once), and the LSM
+            # maintenance economy (compaction cycles, their seconds,
+            # whole-index generation swaps, and the appended-slab
+            # write debt the trigger policy watermarks).
+            "batch_sizes": [int(b) for b in self._batch_sizes],
+            "reclusters_per_write": round(
+                c["recluster_events"]
+                / max(c["inserts"] + c["deletes"], 1), 6
+            ),
+            "recluster_dispatches": c["recluster_dispatches"],
+            "compactions": c["compactions"],
+            "compaction_s": round(float(self._compaction_s), 3),
+            "epoch_swaps": c["epoch_swaps"],
+            "index_generation": int(
+                getattr(self.index, "generation", 0)
+            ),
+            "appended_slab_bytes": int(
+                getattr(self.index, "appended_slab_bytes", 0)
+            ),
         })
+
+    # -- compaction (serve.ingest.Compactor drives these) -----------------
+
+    def begin_compaction_snapshot(self) -> Dict:
+        """Freeze the compaction input under the caller's lock: the
+        alive ids and a copy of their coordinates (the full-refit
+        input).  Ids are append-only and never reused, so the writes
+        that land while the refit runs are recoverable at swap time by
+        pure id arithmetic — no write-ahead log needed."""
+        ids = np.flatnonzero(self._alive[:self._n]).astype(np.int64)
+        self._compact_active = True
+        return {
+            "n": int(self._n),
+            "ids": ids,
+            "points": self._coords[ids].copy(),
+        }
+
+    def _install_generation(self, snap, labels, core, fresh):
+        """Atomic epoch swap of a compacted generation, under the
+        caller's lock.  Four steps:
+
+        1. drain the engine — readers submitted BEFORE the swap resolve
+           against the old generation (zero dropped tickets);
+        2. adopt the refit's clustering for the snapshot set (canonical
+           labels re-densify here — the LSM re-organization);
+        3. swap the fresh index generation in IN PLACE
+           (:meth:`CorePointIndex.replace_generation` — every engine
+           holding the index object sees it, epoch-keyed replica
+           caches re-broadcast);
+        4. replay the writes that landed during the refit through the
+           normal incremental algebra against the new generation (the
+           memtable replay; excluded from user-facing write counters).
+
+        Returns ``(replayed_insert_rows, replayed_delete_rows)``.
+        """
+        self.engine.drain()
+        ids = snap["ids"]
+        later = np.arange(snap["n"], self._n, dtype=np.int64)
+        later = later[self._alive[later]]
+        deleted = ids[~self._alive[ids]]
+        labels = np.asarray(labels, np.int32)
+        core = np.asarray(core, bool)
+        # Step 2: the compacted clustering of the snapshot set (ids
+        # deleted or inserted during the refit go through the replay).
+        self._labels[:self._n] = -1
+        self._core[:self._n] = False
+        self._alive[:self._n] = False
+        self._alive[ids] = True
+        self._labels[ids] = labels
+        self._core[ids] = core
+        self._next_label = (
+            int(labels.max()) + 1 if (labels >= 0).any() else 0
+        )
+        # Step 3: whole-index generation swap (epoch clock continues).
+        self.index.replace_generation(fresh)
+        self._counters["epoch_swaps"] += 1
+        # Step 4: memtable replay.
+        self._replay = True
+        try:
+            if len(deleted):
+                self.delete(deleted)
+            if len(later):
+                self._do_insert(self._coords[later].copy(), ids=later)
+        finally:
+            self._replay = False
+        self._mark_dirty()
+        self._publish()
+        return int(len(later)), int(len(deleted))
+
+    def _note_compaction(self, seconds: float) -> None:
+        self._counters["compactions"] += 1
+        self._compaction_s += float(seconds)
+        self._publish()
 
     # -- persistence ------------------------------------------------------
 
@@ -668,7 +830,15 @@ class LiveModel:
         routing tree, counters, and the mutated index slabs — a
         restarted server resumes serving the updated model
         byte-identically (:func:`pypardis_tpu.checkpoint.save_model`
-        grows the live payload)."""
+        grows the live payload).
+
+        Saving MID-COMPACTION is safe and well-defined: the serving
+        state (the old generation, every write delta included) is what
+        persists; the in-flight partial generation is NOT half-saved —
+        a restore either re-runs the compaction (cheaply, via its
+        jobstate snapshot) or keeps serving the old generation.  The
+        ``compact_pending`` flag rides the checkpoint so the restored
+        model knows a cycle was in flight."""
         from ..checkpoint import save_model
 
         self._sync_model()
@@ -682,6 +852,7 @@ class LiveModel:
                 "next_label": int(self._next_label),
                 "tree": np.asarray(self._tree, np.float64).reshape(-1, 5),
                 "counters": dict(self._counters),
+                "compact_pending": bool(self._compact_active),
             },
             index=self.index,
         )
@@ -689,7 +860,13 @@ class LiveModel:
     @classmethod
     def load(cls, path: str, **engine_kw) -> "LiveModel":
         """Restore a live checkpoint; point ids re-densify to
-        ``0..n_alive-1`` (in the saved id order)."""
+        ``0..n_alive-1`` (in the saved id order).
+
+        A checkpoint written mid-compaction restores the SERVING state
+        (the pre-swap generation, byte-exact) — the partial generation
+        is cleanly discarded, never half-swapped; ``compact_pending``
+        is True on the restored model so a server can re-run the
+        compaction (its jobstate snapshot makes the re-run cheap)."""
         from ..checkpoint import load_model
 
         model = load_model(path)
@@ -699,6 +876,7 @@ class LiveModel:
                 f"{path} is a plain model checkpoint without live "
                 f"state; build a fresh LiveModel(model) instead"
             )
+        compact_pending = bool(ck.pop("compact_pending", False))
         index = ck.pop("index")
         old_gids = np.asarray(ck.pop("gids"), np.int64)
         # Saved gids were sparse (deletions); positions restart dense.
@@ -715,5 +893,6 @@ class LiveModel:
         for k, v in counters.items():
             if k in live._counters:
                 live._counters[k] = int(v)
+        live.compact_pending = compact_pending
         live._publish()
         return live

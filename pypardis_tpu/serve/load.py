@@ -20,6 +20,16 @@ and — for writes — **update-visible latency**: the wall time from a
 write entering :meth:`LiveModel.insert` until a ``predict`` of the
 written point returns its post-update label through the refreshed
 index.
+
+The streaming-ingest mode (``writers > 0``) adds a dedicated Poisson
+**writer population** whose batched writes coalesce through an
+:class:`~pypardis_tpu.serve.ingest.IngestQueue`, and an optional
+background :class:`~pypardis_tpu.serve.ingest.Compactor` whose epoch
+swap happens under the harness lock mid-run — the returned stats then
+carry write throughput/coalescing, update-visible latency through the
+batched path, the zero-dropped-tickets contract, and read-p99
+inside-vs-outside the compaction windows (the ``ingest@1`` row's
+payload, ``make ingest-probe``).
 """
 
 from __future__ import annotations
@@ -43,6 +53,14 @@ def sustained_load(
     query_sampler: Optional[Callable] = None,
     seed: int = 0,
     submit_timeout_s: Optional[float] = None,
+    writers: int = 0,
+    write_rate_hz: float = 60.0,
+    write_batch_rows: int = 8,
+    delete_fraction: float = 0.2,
+    write_sampler: Optional[Callable] = None,
+    ingest=None,
+    compactor=None,
+    compact_at_s: Optional[float] = None,
 ) -> Dict:
     """Run the harness; returns the schema'd stats dict.
 
@@ -52,6 +70,27 @@ def sustained_load(
     when > 0); the rest submit ``batch_rows``-row query batches.
     ``query_sampler(rng, n) -> (n, k)`` supplies query coordinates
     (default: uniform over the index's core bounding box ± eps).
+
+    **Writer population (the streaming-ingest mixed-traffic mode)**:
+    ``writers`` dedicated Poisson write clients run alongside the
+    readers, each submitting ``write_batch_rows``-row writes (a
+    ``delete_fraction`` share deletes its own previously-acknowledged
+    inserts) into an :class:`~pypardis_tpu.serve.ingest.IngestQueue`
+    (one is built over ``live`` when not passed) — the pump thread
+    flushes it next to every drain, so writes coalesce into batches
+    exactly the way reads do.  **Update-visible latency** is measured
+    per write ticket: submit → coalesced flush → a ``predict`` of the
+    written point answering through the refreshed index.  When a
+    ``compactor`` is given, its lock serializes the harness (writers,
+    drains, and the epoch swap all agree on one lock); the pump starts
+    a background cycle at ``compact_at_s`` seconds (and whenever the
+    watermark policy fires), and read latencies are classified against
+    the compactor's cycle windows — ``read_p99_during_compaction_ms``
+    vs ``read_p99_outside_ms`` is the compaction-overlap degradation
+    the ``ingest@1`` row reports.  The zero-dropped-tickets contract is
+    explicit: ``dropped_tickets`` counts read tickets left unresolved
+    after the final drain (always 0 — the swap drains in-flight
+    tickets against the old generation rather than dropping them).
 
     Fault mode: ``submit_timeout_s`` attaches a per-ticket deadline, a
     full queue is counted as a shed (the client backs off — never
@@ -65,6 +104,15 @@ def sustained_load(
         raise ValueError(
             "write_fraction > 0 needs a LiveModel (live=...)"
         )
+    if writers > 0 and live is None and ingest is None:
+        raise ValueError(
+            "writers > 0 needs a LiveModel (live=...) or an "
+            "IngestQueue (ingest=...)"
+        )
+    if writers > 0 and ingest is None:
+        from .ingest import IngestQueue
+
+        ingest = IngestQueue(live)
     from .engine import QueueFull
     index = engine.index
     if query_sampler is None:
@@ -82,8 +130,12 @@ def sustained_load(
             # Raw-frame queries (prepare_queries re-centers).
             return rng.uniform(lo, hi, size=(n, index.d)) + center
 
-    lock = threading.Lock()
+    # One lock serializes the engine, the ingest queue, AND the epoch
+    # swap: when a compactor rides along, its lock IS the harness lock,
+    # so the swap's drain-then-replace is atomic against every client.
+    lock = compactor.lock if compactor is not None else threading.Lock()
     tickets: list = []
+    wtickets: list = []
     visible_ms: list = []
     errors: list = []
     stop = threading.Event()
@@ -130,11 +182,91 @@ def sustained_load(
                 stop.set()
                 return
 
+    if write_sampler is None:
+        write_sampler = query_sampler
+
+    def writer(wid: int) -> None:
+        """A dedicated Poisson write client: batches into the ingest
+        queue, deletes a share of its own acknowledged inserts."""
+        rng = np.random.default_rng(seed * 1000 + 500 + wid)
+        mine: list = []  # resolved insert tickets not yet consumed
+        own_ids: list = []
+        while time.perf_counter() < deadline and not stop.is_set():
+            time.sleep(float(rng.exponential(1.0 / write_rate_hz)))
+            if time.perf_counter() >= deadline:
+                break
+            # Harvest acknowledged ids from earlier tickets.
+            still = []
+            for t in mine:
+                if t.done:
+                    if not t.failed and t.ids is not None:
+                        own_ids.extend(int(i) for i in t.ids)
+                else:
+                    still.append(t)
+            mine = still
+            try:
+                if own_ids and rng.random() < delete_fraction:
+                    take = min(len(own_ids), int(write_batch_rows))
+                    ids = [own_ids.pop() for _ in range(take)]
+                    with lock:
+                        wtickets.append(ingest.submit_delete(ids))
+                else:
+                    q = np.asarray(
+                        write_sampler(rng, int(write_batch_rows))
+                    )
+                    with lock:
+                        t = ingest.submit_insert(q)
+                    wtickets.append(t)
+                    mine.append(t)
+                n_writes[0] += 1
+            except QueueFull:
+                n_shed[0] += 1
+            except Exception as e:  # noqa: BLE001 — harness must drain
+                errors.append(e)
+                stop.set()
+                return
+
+    compact_started = [False]
+
+    def pump_once() -> None:
+        """One serialized pump round: drain reads, flush writes,
+        measure update visibility, and fire the compactor."""
+        with lock:
+            engine.drain()
+            if ingest is not None:
+                resolved = ingest.flush()
+                now = time.perf_counter()
+                probed = False
+                for t in resolved:
+                    if t.failed or t.kind != "insert":
+                        continue
+                    if not probed and t.ids is not None and len(t.ids) \
+                            and live is not None:
+                        # One predict per flush: the written point
+                        # answers through the refreshed index — the
+                        # update-visible round trip.
+                        engine.predict(
+                            live._coords[t.ids[:1]].copy()
+                        )
+                        probed = True
+                    t.visible_ms = (now - t._t_submit) * 1e3
+                    visible_ms.append(t.visible_ms)
+        if compactor is not None:
+            elapsed = time.perf_counter() - t_start
+            due = (
+                compact_at_s is not None and elapsed >= compact_at_s
+                and not compact_started[0]
+            )
+            if due and not compactor.running:
+                compactor.start()
+                compact_started[0] = True
+            elif compactor.maybe_compact():
+                compact_started[0] = True
+
     def drainer() -> None:
         while not stop.is_set():
             try:
-                with lock:
-                    engine.drain()
+                pump_once()
             except Exception as e:  # noqa: BLE001
                 errors.append(e)
                 stop.set()
@@ -146,6 +278,9 @@ def sustained_load(
     threads = [
         threading.Thread(target=client, args=(c,), daemon=True)
         for c in range(int(clients))
+    ] + [
+        threading.Thread(target=writer, args=(w,), daemon=True)
+        for w in range(int(writers))
     ]
     pump = threading.Thread(target=drainer, daemon=True)
     for t in threads:
@@ -155,8 +290,12 @@ def sustained_load(
         t.join()
     stop.set()
     pump.join()
+    if compactor is not None and compactor._thread is not None:
+        compactor.join()  # the swap lands; its error (if any) raises
     with lock:
         engine.drain()  # resolve any straggler tickets
+        if ingest is not None:
+            ingest.flush()
     wall = time.perf_counter() - t_start
     if errors:
         raise errors[0]
@@ -167,10 +306,26 @@ def sustained_load(
     )
     queries = int(sum(t.n for t in tickets if t.done and not t.failed))
     failed = int(sum(1 for t in tickets if t.failed))
+    dropped = int(sum(1 for t in tickets if not t.done))
     vis = np.asarray(visible_ms, np.float64)
 
     def _pct(a, q):
         return round(float(np.percentile(a, q)), 3) if len(a) else 0.0
+
+    # Compaction-overlap classification: a read whose completion fell
+    # inside a compactor cycle window degraded (or not) under the
+    # background refit — the never-stop-the-world gauge.
+    windows = list(getattr(compactor, "windows", ()) or ())
+    lat_in, lat_out = [], []
+    for t in tickets:
+        if t.latency_ms is None:
+            continue
+        done_at = t._t_submit + t.latency_ms / 1e3
+        inside = any(a <= done_at <= b for a, b in windows)
+        (lat_in if inside else lat_out).append(t.latency_ms)
+    lat_in = np.asarray(lat_in, np.float64)
+    lat_out = np.asarray(lat_out, np.float64)
+    p99_in, p99_out = _pct(lat_in, 99), _pct(lat_out, 99)
 
     stats = engine.serving_stats()
     return {
@@ -196,5 +351,41 @@ def sustained_load(
         "deadline_failures": failed,
         "submit_timeout_s": (
             float(submit_timeout_s) if submit_timeout_s else 0.0
+        ),
+        # Streaming-ingest block (writers + background compaction):
+        # write volumes/coalescing, zero-dropped-tickets contract, and
+        # the compaction-overlap degradation (read p99 with a cycle in
+        # flight vs without — 0.0 when no cycle overlapped the run).
+        "writers": int(writers),
+        "write_rows": int(getattr(ingest, "rows", 0)),
+        "write_batches": int(getattr(ingest, "batches", 0)),
+        "mean_write_batch": (
+            ingest.stats()["mean_batch_rows"] if ingest is not None
+            else 0.0
+        ),
+        "write_qps": (
+            round(getattr(ingest, "rows", 0) / wall, 1)
+            if wall > 0 else 0.0
+        ),
+        "write_failures": int(
+            getattr(ingest, "failed_batches", 0)
+        ),
+        "dropped_tickets": dropped,
+        "compactions": int(
+            getattr(compactor, "stats", {}).get("compactions", 0)
+            if compactor is not None else 0
+        ),
+        "epoch_swaps": int(
+            live.stats.get("epoch_swaps", 0) if live is not None else 0
+        ),
+        "compaction_s": (
+            round(float(compactor.stats.get("compaction_s", 0.0)), 3)
+            if compactor is not None else 0.0
+        ),
+        "read_p99_during_compaction_ms": p99_in,
+        "read_p99_outside_ms": p99_out,
+        "compaction_overlap_degradation": (
+            round(p99_in / p99_out, 3)
+            if p99_in > 0 and p99_out > 0 else 0.0
         ),
     }

@@ -52,6 +52,13 @@ very machinery a real fault would exercise):
 ``gm.ring_round``      each boundary-tile ppermute ring round
 ``gm.fixpoint_round``  each cross-device pmin fixpoint round
 ``serve.drain``        :meth:`QueryEngine.drain`
+``ingest.batch``       batched writes (``LiveModel.insert_batch`` /
+                       ``delete_batch`` — fired BEFORE any state
+                       mutates, so a failed batch leaves the model
+                       untouched and fails only its queue tickets)
+``compact.phase``      each streaming-ingest compaction phase boundary
+                       (snapshot / refit / build / swap, occurrences
+                       1..4 per cycle — ``serve.ingest.Compactor``)
 ===================== ====================================================
 
 Zero-cost when unset: ``maybe_fail`` is one module-global ``is None``

@@ -76,6 +76,33 @@ def fit_meta(points, *, eps, min_samples, metric, block, mode) -> Dict:
     }
 
 
+def discard_stale(path: str, meta: Dict) -> bool:
+    """Remove a snapshot written by a DIFFERENT fit; True if removed.
+
+    The resume guard (:meth:`JobState._load`) *raises* on a fingerprint
+    mismatch — the right behavior for an operator retyping a resume
+    path.  A background compaction (:class:`pypardis_tpu.serve.ingest.
+    Compactor`) has the opposite contract: its snapshot moves with the
+    write stream, so a jobstate file left by a killed cycle over an
+    OLDER point set describes an obsolete partial generation — discard
+    it and refit fresh, never refuse.  An unreadable file (a torn write
+    from a kill that raced the atomic replace's tmp file) is discarded
+    the same way."""
+    p = _norm_npz(path)
+    if not os.path.exists(p):
+        return False
+    try:
+        with np.load(p, allow_pickle=False) as z:
+            saved = json.loads(str(z["meta"]))
+    except Exception:  # noqa: BLE001 — torn/foreign file: discard
+        os.unlink(p)
+        return True
+    if saved != dict(meta):
+        os.unlink(p)
+        return True
+    return False
+
+
 class JobState:
     """One resumable fit's snapshot file.
 

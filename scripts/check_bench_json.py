@@ -274,6 +274,39 @@ def main() -> None:
                     f"telemetry.live.{key} is {v!r}, expected a "
                     f"non-negative int"
                 )
+        # Streaming-ingest contract (ISSUE 12): every live block says
+        # how writes batched (sizes of the applied write batches), the
+        # amortization it bought (recluster events per written row),
+        # and the LSM maintenance economy (compaction cycles, their
+        # seconds, whole-index epoch swaps) — always present, finite.
+        bs = live.get("batch_sizes")
+        if not isinstance(bs, list):
+            fail(
+                f"telemetry.live.batch_sizes is {bs!r}, expected a list"
+            )
+        for i, v in enumerate(bs):
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                fail(
+                    f"telemetry.live.batch_sizes[{i}] is {v!r}, "
+                    f"expected a non-negative int"
+                )
+        for key in ("reclusters_per_write", "compaction_s"):
+            v = live.get(key)
+            if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                    or v != v or v in (float("inf"), float("-inf")) \
+                    or v < 0:
+                fail(
+                    f"telemetry.live.{key} is {v!r}, expected a finite "
+                    f"number >= 0"
+                )
+        for key in ("compactions", "epoch_swaps",
+                    "recluster_dispatches"):
+            v = live.get(key)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                fail(
+                    f"telemetry.live.{key} is {v!r}, expected a "
+                    f"non-negative int"
+                )
     if str(row["metric"]) == "live_load_qps":
         load = row.get("load")
         if not isinstance(load, dict):
@@ -306,6 +339,57 @@ def main() -> None:
                 f"per_device_index_bytes is "
                 f"{rep.get('per_device_index_bytes')!r}"
             )
+
+    # Streaming-ingest contract (ISSUE 12): the mixed read/write row is
+    # the "millions of users, and they write too" artifact — it must
+    # say what ran (readers AND writers), prove the never-stop-the-
+    # world claim (>= 1 background compaction + epoch swap completed
+    # with ZERO dropped tickets), and carry finite throughput /
+    # latency / update-visibility / overlap-degradation gauges.
+    if str(row["metric"]) == "ingest_mixed_load":
+        if row.get("schema") != "pypardis_tpu/ingest@1":
+            fail(f"ingest row schema is {row.get('schema')!r}")
+        load = row.get("load")
+        if not isinstance(load, dict):
+            fail("ingest_mixed_load row without the load payload")
+        if load.get("arrival") != "poisson":
+            fail(f"load.arrival is {load.get('arrival')!r}")
+        if int(load.get("clients", 0)) < 2:
+            fail(
+                f"ingest load ran {load.get('clients')!r} reader(s), "
+                f"need >= 2"
+            )
+        if int(load.get("writers", 0)) < 1:
+            fail(
+                f"ingest load ran {load.get('writers')!r} writer(s), "
+                f"need >= 1"
+            )
+        if int(load.get("compactions", 0)) < 1:
+            fail("ingest load completed no background compaction")
+        if int(load.get("epoch_swaps", 0)) < 1:
+            fail("ingest load saw no epoch swap")
+        if int(load.get("dropped_tickets", -1)) != 0:
+            fail(
+                f"ingest load dropped "
+                f"{load.get('dropped_tickets')!r} ticket(s); the epoch "
+                f"swap must drain, never drop"
+            )
+        if int(load.get("write_failures", 0)) != 0:
+            fail(
+                f"ingest load had {load.get('write_failures')!r} "
+                f"failed write batch(es)"
+            )
+        for key in ("qps", "write_qps", "p50_ms", "p99_ms",
+                    "update_visible_p50_ms", "update_visible_p99_ms",
+                    "read_p99_during_compaction_ms",
+                    "read_p99_outside_ms", "mean_write_batch",
+                    "compaction_overlap_degradation", "compaction_s"):
+            v = load.get(key)
+            if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                    or v != v or v in (float("inf"), float("-inf")):
+                fail(f"load.{key} is {v!r}, expected a finite number")
+        if "live" not in tel:
+            fail("ingest_mixed_load row without telemetry.live block")
 
     # North-star contract (ISSUE 10 / ROADMAP item 1): a northstar row
     # is the measured 100M-trajectory artifact — it must decompose the

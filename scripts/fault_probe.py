@@ -27,6 +27,14 @@ CPU mesh:
    the external sort's spill files (PYPARDIS_SPILL_DIR-scoped) are
    verified cleaned up after every fit, including the injected-fault
    ones.
+6. **kill/resume mid-COMPACTION (ISSUE 12)** — a child process serving
+   a LiveModel starts a background-compaction cycle (global-Morton
+   refit, jobstate snapshots on, per-round hangs widening the kill
+   window) and is SIGKILLed mid-refit; a fresh child resumes the
+   compaction (``Compactor(ckpt=...)`` replays the jobstate rounds)
+   and completes the epoch swap — the swapped-in index's slabs,
+   labels, gids, and epoch are BYTE-IDENTICAL to an uninterrupted
+   compaction's.
 
 Emits ONE bench-style JSON row (``metric="fault_probe_scenarios"``)
 whose telemetry block is the FAULTY global-Morton fit's report — so the
@@ -113,6 +121,43 @@ def child_fit(out_path: str, ckpt: str, resume: bool) -> None:
     )
 
 
+def child_compact(out_path: str, ckpt: str) -> None:
+    """Scenario-6 child: fit -> live -> compaction (GM refit, jobstate
+    snapshots on).  FAULT_HANG widens the kill window via per-round
+    fixpoint hangs installed AFTER the initial fit, so the jobstate
+    file's appearance marks the compaction refit precisely."""
+    _force_cpu_mesh()
+    import numpy as np
+
+    from pypardis_tpu import DBSCAN
+    from pypardis_tpu.serve import Compactor
+    from pypardis_tpu.utils import faults
+
+    n = int(os.environ.get("FAULT_N", 3000))
+    X = chain_data(n)
+    model = DBSCAN(mode="global_morton", merge="device", **KW)
+    model.fit(X)
+    live = model.live(leaves=8)
+    hang = float(os.environ.get("FAULT_HANG", "0"))
+    if hang > 0:
+        faults.install(f"gm.fixpoint_round:*=hang({hang})")
+    comp = Compactor(
+        live, ckpt=ckpt,
+        fit_kw={"mode": "global_morton", "merge": "device"},
+    )
+    comp.compact()
+    faults.clear()
+    np.savez(
+        out_path,
+        coords=live.index.coords,
+        labels=live.index.labels,
+        gids=live.index.gids,
+        epoch=np.int64(live.index.epoch),
+        live_labels=live.labels(),
+        restored_rounds=np.int64(comp.stats["resumed_rounds"]),
+    )
+
+
 def check(msg: str, ok: bool) -> int:
     print(f"fault-probe: {msg}: {'ok' if ok else 'FAILED'}",
           file=sys.stderr)
@@ -133,7 +178,10 @@ def _run_child(env_extra, out, ckpt, resume=False):
 
 def main() -> None:
     if len(sys.argv) > 1 and sys.argv[1] == "--child":
-        child_fit(sys.argv[2], sys.argv[3], "--resume" in sys.argv)
+        if os.environ.get("FAULT_COMPACT"):
+            child_compact(sys.argv[2], sys.argv[3])
+        else:
+            child_fit(sys.argv[2], sys.argv[3], "--resume" in sys.argv)
         return
 
     _force_cpu_mesh()
@@ -304,6 +352,78 @@ def main() -> None:
     finally:
         del os.environ["PYPARDIS_SPILL_DIR"]
 
+    # -- 6: kill/resume mid-COMPACTION (ISSUE 12) -------------------------
+    # Uninterrupted reference, in-process: same data, same route — the
+    # compacted generation is deterministic, so the resumed child must
+    # reproduce it byte-for-byte.
+    staging.clear()
+    from pypardis_tpu.serve import Compactor
+
+    ref_model = DBSCAN(mode="global_morton", merge="device", **KW)
+    ref_model.fit(X)
+    ref_live = ref_model.live(leaves=8)
+    Compactor(
+        ref_live, fit_kw={"mode": "global_morton", "merge": "device"}
+    ).compact()
+
+    tmp6 = tempfile.mkdtemp(prefix="fault_probe_compact_")
+    ckpt6 = os.path.join(tmp6, "compact.ckpt.npz")
+    out6 = os.path.join(tmp6, "compacted.npz")
+    killed = False
+    deadline6 = time.time() + float(os.environ.get(
+        "FAULT_TIMEOUT_S", 300
+    ))
+    for attempt in range(4):
+        if os.path.exists(ckpt6):
+            os.unlink(ckpt6)
+        hang = 0.4 * (attempt + 1)
+        proc = _run_child(
+            {
+                "FAULT_COMPACT": "1",
+                "FAULT_HANG": str(hang),
+                "PYPARDIS_CKPT_EVERY_S": "0",
+            },
+            out6, ckpt6,
+        )
+        try:
+            while time.time() < deadline6:
+                if proc.poll() is not None:
+                    break  # finished before we could kill — retry
+                if os.path.exists(ckpt6):
+                    time.sleep(hang * 0.5)  # land INSIDE a round
+                    break
+                time.sleep(0.02)
+        finally:
+            alive = proc.poll() is None
+            proc.send_signal(signal.SIGKILL)
+            proc.wait()
+        if alive and os.path.exists(ckpt6):
+            killed = True
+            break
+        print(
+            f"fault-probe: compact attempt {attempt}: kill landed too "
+            f"late (alive={alive}); widening the hang", file=sys.stderr,
+        )
+    check("SIGKILL landed mid-compaction with a jobstate snapshot on "
+          "disk", killed)
+    rc = _run_child({"FAULT_COMPACT": "1"}, out6, ckpt6).wait()
+    check("resumed compaction child completed", rc == 0)
+    with np.load(out6) as z:
+        restored_compact = int(z["restored_rounds"])
+        parity = (
+            np.array_equal(z["coords"], ref_live.index.coords)
+            and np.array_equal(z["labels"], ref_live.index.labels)
+            and np.array_equal(z["gids"], ref_live.index.gids)
+            and int(z["epoch"]) == ref_live.index.epoch
+            and np.array_equal(z["live_labels"], ref_live.labels())
+        )
+    passed += check(
+        f"kill/resume mid-compaction: swapped-in index byte-identical "
+        f"to an uninterrupted compaction "
+        f"(restored_rounds={restored_compact})",
+        parity and restored_compact >= 1,
+    )
+
     row = {
         "metric": "fault_probe_scenarios",
         "value": passed,
@@ -317,6 +437,10 @@ def main() -> None:
         "kill_resume_stream": {
             "restored_rounds": restored_stream,
             "labels_match": True,
+        },
+        "kill_resume_compaction": {
+            "restored_rounds": restored_compact,
+            "index_byte_identical": True,
         },
         "telemetry": rep,
     }

@@ -1,0 +1,255 @@
+#!/usr/bin/env python
+"""Streaming-ingest probe (``make ingest-probe``, wired into
+``bench-smoke``): batched-write amortization, epoch-swap exactness,
+and the mixed read/write load row.
+
+Asserted end to end (exits nonzero on any violation):
+
+1. **one-dispatch-per-batch** — inserting B=256 points through
+   ``LiveModel.insert_batch`` performs EXACTLY 1 recluster kernel
+   dispatch (the ``recluster_dispatches`` counter) and 1 index delta
+   (one epoch bump), where the same 256 points applied one call at a
+   time pay one dispatch/delta per core-flipping write; incremental
+   labels stay ARI == 1.0 vs a full refit either way.
+2. **batched mixed sequence** — an ``IngestQueue``-coalesced
+   insert/delete stream ends ARI == 1.0 vs refit, predict bitwise
+   oracle-exact.
+3. **epoch swap** — a full compaction cycle (background refit →
+   fresh generation → in-place swap): predict is bitwise oracle-exact
+   BEFORE and AFTER the swap, in-flight tickets submitted pre-swap
+   resolve against the old generation, appended slabs are gone after.
+4. **mixed traffic** — the sustained-load harness with a reader AND a
+   Poisson writer population across >= 1 background compaction + epoch
+   swap, zero dropped/failed tickets — emitted as the schema'd
+   ``ingest@1`` row (``ingest_mixed_load``), piped through
+   ``bench_diff --annotate`` into ``check_bench_json`` by the make
+   target.
+
+Env knobs: INGEST_N (default 4000), INGEST_DIM (4), INGEST_B (256),
+INGEST_READERS (4), INGEST_WRITERS (2), INGEST_SECONDS (2.0).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def fail(msg: str) -> None:
+    print(f"ingest probe FAILED: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main():
+    import numpy as np
+    from sklearn.metrics import adjusted_rand_score
+
+    from benchdata import make_separated_blob_data
+    from pypardis_tpu import DBSCAN
+    from pypardis_tpu.parallel.mesh import default_mesh
+    from pypardis_tpu.serve import Compactor, IngestQueue, sustained_load
+
+    n = int(os.environ.get("INGEST_N", 4000))
+    dim = int(os.environ.get("INGEST_DIM", 4))
+    B = int(os.environ.get("INGEST_B", 256))
+    readers = int(os.environ.get("INGEST_READERS", 4))
+    writers = int(os.environ.get("INGEST_WRITERS", 2))
+    seconds = float(os.environ.get("INGEST_SECONDS", 2.0))
+    eps, min_samples = 1.1 * (dim / 4) ** 0.5, 8
+    X, _truth, centers = make_separated_blob_data(
+        n, dim, n_centers=8, std=0.4,
+        min_sep=2 * eps + 6 * 0.4 + 1.0, spread=12.0, seed=0,
+    )
+    rng = np.random.default_rng(7)
+
+    def fit_model(pts):
+        return DBSCAN(
+            eps=eps, min_samples=min_samples, block=512,
+            mesh=default_mesh(1),
+        ).fit(pts)
+
+    def refit_ari(live):
+        refit = fit_model(live.points()).labels_
+        return float(adjusted_rand_score(refit, live.labels()))
+
+    # Interior rows dense enough that the batch flips cores — the
+    # recluster path MUST run for the one-dispatch assert to bite.
+    batch = (
+        centers[rng.integers(0, len(centers), B)]
+        + rng.normal(scale=0.25, size=(B, dim))
+    )
+
+    # -- 1a: the batched path — exactly 1 dispatch, 1 delta ---------------
+    model = fit_model(X)
+    live = model.live(leaves=16)
+    d0 = live.stats["recluster_dispatches"]
+    e0 = live.index.epoch
+    t0 = time.perf_counter()
+    ids = live.insert_batch(batch)
+    batch_s = time.perf_counter() - t0
+    d_batched = live.stats["recluster_dispatches"] - d0
+    deltas_batched = live.index.epoch - e0
+    if d_batched != 1:
+        fail(
+            f"insert_batch(B={B}) ran {d_batched} recluster dispatches, "
+            f"contract is exactly 1"
+        )
+    if deltas_batched != 1:
+        fail(
+            f"insert_batch(B={B}) shipped {deltas_batched} index "
+            f"deltas, contract is exactly 1"
+        )
+    ari = refit_ari(live)
+    if ari != 1.0:
+        fail(f"batched insert diverges from full refit (ARI={ari})")
+
+    # -- 1b: the same rows, one write at a time (the amortized cost) ------
+    model_pp = fit_model(X)
+    live_pp = model_pp.live(leaves=16)
+    d0 = live_pp.stats["recluster_dispatches"]
+    e0 = live_pp.index.epoch
+    t0 = time.perf_counter()
+    for row in batch:
+        live_pp.insert(row[None])
+    per_point_s = time.perf_counter() - t0
+    d_per_point = live_pp.stats["recluster_dispatches"] - d0
+    deltas_per_point = live_pp.index.epoch - e0
+    if d_per_point <= 1:
+        fail(
+            f"per-point control ran only {d_per_point} dispatches — "
+            f"the amortization comparison is vacuous"
+        )
+    ari = refit_ari(live_pp)
+    if ari != 1.0:
+        fail(f"per-point inserts diverge from full refit (ARI={ari})")
+    print(
+        f"ingest probe: B={B} batched 1 dispatch/1 delta in "
+        f"{batch_s * 1e3:.0f}ms vs per-point {d_per_point} dispatches/"
+        f"{deltas_per_point} deltas in {per_point_s * 1e3:.0f}ms "
+        f"({per_point_s / max(batch_s, 1e-9):.1f}x wall)",
+        file=sys.stderr,
+    )
+
+    # -- 2: IngestQueue-coalesced mixed sequence --------------------------
+    queue = IngestQueue(live, max_batch_rows=512)
+    tickets = []
+    for i in range(6):
+        c = centers[(2 * i) % len(centers)]
+        tickets.append(queue.submit_insert(
+            c + rng.normal(scale=0.3, size=(5, dim))
+        ))
+    tickets.append(queue.submit_delete(ids[:40]))
+    tickets.append(queue.submit_insert(
+        rng.uniform(-30, 30, size=(2, dim))
+    ))
+    resolved = queue.flush()
+    if len(resolved) != len(tickets) or any(t.failed for t in resolved):
+        fail(f"ingest queue left tickets unresolved/failed: "
+             f"{[str(t.error) for t in resolved if t.failed]}")
+    qs = queue.stats()
+    if qs["batches"] >= len(tickets):
+        fail(
+            f"ingest queue did not coalesce: {qs['batches']} batches "
+            f"for {len(tickets)} submits"
+        )
+    ari = refit_ari(live)
+    if ari != 1.0:
+        fail(f"queued mixed sequence diverges from refit (ARI={ari})")
+
+    # -- 3: epoch swap exactness ------------------------------------------
+    Q = np.concatenate([
+        live.points()[:512],
+        rng.uniform(-15, 15, size=(512, dim)),
+    ])
+    pre_labs, pre_d2 = live.index.oracle_predict(Q)
+    inflight = live.engine.submit(Q)  # submitted BEFORE the swap
+    gen0 = live.index.generation
+    comp = Compactor(live)
+    comp.compact()
+    if live.index.generation != gen0 + 1:
+        fail(f"compaction did not swap a generation "
+             f"(generation={live.index.generation})")
+    if not inflight.done:
+        fail("in-flight ticket was dropped across the epoch swap")
+    if not (np.array_equal(inflight.labels, pre_labs)
+            and np.array_equal(inflight.d2, pre_d2)):
+        fail("pre-swap ticket did not resolve against the old "
+             "generation")
+    post = live.engine.submit(Q)
+    live.engine.drain()
+    olabs, od2 = live.index.oracle_predict(Q)
+    if not (np.array_equal(post.labels, olabs)
+            and np.array_equal(post.d2, od2)):
+        fail("predict diverges from the oracle AFTER the epoch swap")
+    if live.index.appended_slab_bytes != 0:
+        fail(
+            f"compaction left {live.index.appended_slab_bytes} "
+            f"appended-slab bytes"
+        )
+    ari = refit_ari(live)
+    if ari != 1.0:
+        fail(f"compacted clustering diverges from refit (ARI={ari})")
+
+    row = {
+        "metric": "ingest_batch_amortization",
+        "value": float(B),
+        "unit": "rows/dispatch",
+        "schema": "pypardis_tpu/ingest@1",
+        "batch_rows": B,
+        "dispatches_batched": int(d_batched),
+        "deltas_batched": int(deltas_batched),
+        "dispatches_per_point": int(d_per_point),
+        "deltas_per_point": int(deltas_per_point),
+        "batch_s": round(batch_s, 6),
+        "per_point_s": round(per_point_s, 6),
+        "ari_vs_refit": 1.0,
+        "oracle_exact": True,
+        "telemetry": model.report(),
+    }
+    print(json.dumps(row), flush=True)
+
+    # -- 4: mixed read/write traffic across a background compaction ------
+    def write_sampler(w_rng, m):
+        c = centers[w_rng.integers(0, len(centers))]
+        return c + w_rng.normal(scale=0.25, size=(m, dim))
+
+    comp2 = Compactor(live)
+    res = sustained_load(
+        live.engine, clients=readers, duration_s=seconds,
+        rate_hz=120.0, batch_rows=32,
+        writers=writers, write_rate_hz=40.0, write_batch_rows=8,
+        write_sampler=write_sampler, live=live,
+        compactor=comp2, compact_at_s=seconds * 0.25, seed=11,
+    )
+    if res["compactions"] < 1 or res["epoch_swaps"] < 1:
+        fail(
+            f"mixed load completed {res['compactions']} compactions / "
+            f"{res['epoch_swaps']} swaps, need >= 1 of each"
+        )
+    for key in ("dropped_tickets", "write_failures",
+                "deadline_failures"):
+        if res[key] != 0:
+            fail(f"mixed load {key} = {res[key]}, contract is 0")
+    t = live.engine.submit(Q)
+    live.engine.drain()
+    olabs, od2 = live.index.oracle_predict(Q)
+    if not (np.array_equal(t.labels, olabs)
+            and np.array_equal(t.d2, od2)):
+        fail("predict diverges from the oracle after mixed load")
+    row = {
+        "metric": "ingest_mixed_load",
+        "value": res["qps"],
+        "unit": "queries/sec",
+        "schema": "pypardis_tpu/ingest@1",
+        "load": res,
+        "telemetry": model.report(),
+    }
+    print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()

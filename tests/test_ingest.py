@@ -1,0 +1,366 @@
+"""Streaming ingest subsystem (ISSUE 12): batched writes, the
+IngestQueue coalescer, and LSM-style background compaction with an
+atomic whole-index epoch swap.
+
+Contracts pinned here:
+
+* ``insert_batch(B rows)`` runs EXACTLY one recluster kernel dispatch
+  and ships EXACTLY one index delta — the amortization that makes
+  heavy write traffic affordable — with labels ARI == 1.0 vs a full
+  refit (``delete_batch`` same);
+* the ``IngestQueue`` coalesces consecutive same-kind writes in order,
+  resolves every ticket, and fails a faulted batch's tickets without
+  poisoning the queue or the model;
+* a compaction cycle swaps a re-Mortoned, re-balanced generation in
+  WITHOUT stopping the world: in-flight tickets drain against the old
+  generation, post-swap predict is bitwise oracle-exact, appended
+  slabs are gone, writes that landed DURING the refit are replayed
+  (the memtable replay), and the deterministic ``PYPARDIS_COMPACT_*``
+  watermark policy drives ``should_compact``;
+* ``LiveModel.save``/``load`` mid-compaction round-trips the serving
+  (pre-swap) state byte-exactly and cleanly discards the partial
+  generation — never a half-swapped index.
+"""
+
+import numpy as np
+import pytest
+from sklearn.metrics import adjusted_rand_score
+
+from benchdata import make_separated_blob_data
+from pypardis_tpu import DBSCAN
+from pypardis_tpu.parallel.mesh import default_mesh
+from pypardis_tpu.serve import Compactor, IngestQueue, LiveModel
+from pypardis_tpu.utils import faults
+from pypardis_tpu.utils.faults import FaultInjected
+
+EPS, MS = 1.1, 6
+
+
+def _fit(n=600, dim=3, seed=0):
+    X, _truth, centers = make_separated_blob_data(
+        n, dim, n_centers=5, std=0.35,
+        min_sep=2 * EPS + 6 * 0.35 + 1.0, spread=10.0, seed=seed,
+    )
+    m = DBSCAN(eps=EPS, min_samples=MS, mesh=default_mesh(1),
+               block=128).fit(X)
+    return m, X, centers
+
+
+def _assert_refit_equivalent(live):
+    refit = DBSCAN(
+        eps=live.eps, min_samples=live.min_samples,
+        mesh=default_mesh(1), block=128,
+    ).fit(live.points()).labels_
+    ari = adjusted_rand_score(refit, live.labels())
+    assert ari == 1.0, f"ARI {ari} vs full refit"
+
+
+def _assert_oracle_exact(live, Q):
+    t = live.engine.submit(Q)
+    live.engine.drain()
+    olabs, od2 = live.index.oracle_predict(Q)
+    np.testing.assert_array_equal(t.labels, olabs)
+    np.testing.assert_array_equal(t.d2, od2)
+
+
+def test_insert_batch_one_dispatch_one_delta():
+    m, X, centers = _fit()
+    live = m.live(leaves=8)
+    rng = np.random.default_rng(1)
+    B = 64
+    batch = (
+        centers[rng.integers(0, len(centers), B)]
+        + rng.normal(scale=0.25, size=(B, X.shape[1]))
+    )
+    d0 = live.stats["recluster_dispatches"]
+    e0 = live.index.epoch
+    ids = live.insert_batch(batch)
+    assert len(ids) == B
+    assert live.stats["recluster_dispatches"] - d0 == 1
+    assert live.index.epoch - e0 == 1, "one index delta per batch"
+    assert live.stats["batch_sizes"][-1] == B
+    assert live.stats["reclusters_per_write"] < 0.05
+    _assert_refit_equivalent(live)
+
+    # delete_batch: same one-dispatch/one-delta contract.
+    d0 = live.stats["recluster_dispatches"]
+    e0 = live.index.epoch
+    core_ids = ids[live._core[ids]]
+    assert len(core_ids) > 2
+    live.delete_batch(core_ids[:16])
+    assert live.stats["recluster_dispatches"] - d0 == 1
+    assert live.index.epoch - e0 == 1
+    _assert_refit_equivalent(live)
+
+
+def test_ingest_queue_coalesces_in_order():
+    m, X, centers = _fit()
+    live = m.live(leaves=8)
+    rng = np.random.default_rng(2)
+    q = IngestQueue(live, max_batch_rows=256)
+    t1 = q.submit_insert(
+        centers[0] + rng.normal(scale=0.2, size=(3, X.shape[1]))
+    )
+    t2 = q.submit_insert(
+        centers[1] + rng.normal(scale=0.2, size=(4, X.shape[1]))
+    )
+    t3 = q.submit_delete(live.ids()[:2])
+    t4 = q.submit_insert(
+        centers[2] + rng.normal(scale=0.2, size=(2, X.shape[1]))
+    )
+    resolved = q.flush()
+    # 4 submits coalesce to 3 batches: [3+4 insert], [2 delete],
+    # [2 insert] — consecutive same-kind runs merge, order preserved.
+    assert q.stats()["batches"] == 3
+    assert [t.done for t in (t1, t2, t3, t4)] == [True] * 4
+    assert len(resolved) == 4 and not any(t.failed for t in resolved)
+    np.testing.assert_array_equal(t3.result(), t3.ids)
+    assert len(t1.result()) == 3 and len(t2.result()) == 4
+    # the two coalesced inserts got DISTINCT contiguous ids
+    assert set(t1.ids).isdisjoint(t2.ids)
+    _assert_refit_equivalent(live)
+    assert q.flush() == []  # empty queue: no-op
+
+
+def test_ingest_queue_backpressure_and_fault_isolation():
+    from pypardis_tpu.serve.engine import QueueFull
+
+    m, X, centers = _fit(n=400, seed=1)
+    live = m.live(leaves=4)
+    q = IngestQueue(live, max_pending_rows=8)
+    q.submit_insert(np.full((6, X.shape[1]), 20.0))
+    with pytest.raises(QueueFull):
+        q.submit_insert(np.full((6, X.shape[1]), 21.0))
+    assert q.stats()["shed"] == 1
+    q.flush()
+
+    # An injected ingest.batch fault fails ONLY that batch's tickets —
+    # fired before any mutation, so the model is untouched and the
+    # next flush works.
+    pts0 = live.points()
+    with faults.plan("ingest.batch:1=error"):
+        bad = q.submit_insert(
+            centers[0] + np.full((2, X.shape[1]), 0.1)
+        )
+        ok = q.flush()
+    assert bad.failed and isinstance(bad.error, FaultInjected)
+    assert q.stats()["failed_batches"] == 1
+    np.testing.assert_array_equal(live.points(), pts0)
+    good = q.submit_insert(centers[0] + np.full((2, X.shape[1]), 0.1))
+    q.flush()
+    assert good.done and not good.failed
+    _assert_refit_equivalent(live)
+
+
+def test_compaction_swap_correctness():
+    m, X, centers = _fit(n=700)
+    live = m.live(leaves=8, block=32, qblock=32)
+    rng = np.random.default_rng(3)
+    # Pour writes into one region until the leaf overflows: appended
+    # slabs are the write debt compaction must clear.
+    live.insert_batch(
+        centers[1] + rng.normal(scale=0.3, size=(250, X.shape[1]))
+    )
+    live.delete_batch(live.ids()[10:30])
+    assert live.index.appended_slab_bytes > 0
+    assert live.index.deltas_since_compact >= 2
+
+    Q = np.concatenate([
+        live.points()[:150],
+        rng.uniform(-15, 15, size=(60, X.shape[1])),
+    ])
+    pre_labs, pre_d2 = live.index.oracle_predict(Q)
+    inflight = live.engine.submit(Q)
+    epoch0, gen0 = live.index.epoch, live.index.generation
+
+    comp = Compactor(live)
+    stats = comp.compact()
+    assert stats["compactions"] == 1
+
+    # Readers submitted before the swap drained against the OLD
+    # generation; readers after see the new one — both bitwise.
+    assert inflight.done and not inflight.failed
+    np.testing.assert_array_equal(inflight.labels, pre_labs)
+    np.testing.assert_array_equal(inflight.d2, pre_d2)
+    _assert_oracle_exact(live, Q)
+
+    assert live.index.generation == gen0 + 1
+    assert live.index.epoch == epoch0 + 1
+    assert live.index.appended_slab_bytes == 0
+    assert live.index.deltas_since_compact == 0
+    # the fresh generation is build-layout: every leaf owns one slab
+    assert all(
+        len(s) == 1 for s in live.index.leaf_slabs.values()
+    )
+    assert live.stats["epoch_swaps"] == 1
+    assert live.stats["compactions"] == 1
+    assert live.stats["compaction_s"] > 0
+    _assert_refit_equivalent(live)
+    # writes keep working on the swapped-in generation
+    live.insert_batch(
+        centers[0] + rng.normal(scale=0.2, size=(5, X.shape[1]))
+    )
+    _assert_refit_equivalent(live)
+
+
+def test_writes_during_compaction_are_replayed():
+    """The memtable replay: writes landing between the snapshot and
+    the swap survive into the new generation (deterministically
+    scheduled via the phase hook — no thread races in CI)."""
+    m, X, centers = _fit(n=600, seed=2)
+    live = m.live(leaves=8)
+    rng = np.random.default_rng(4)
+    mid = {}
+
+    def hook(phase):
+        if phase == "build":
+            spot = np.full(X.shape[1], 25.0)
+            mid["ids"] = live.insert(
+                spot + rng.normal(scale=0.2, size=(MS + 2, X.shape[1]))
+            )
+            live.delete(live.ids()[5:12])
+
+    comp = Compactor(live, phase_hook=hook)
+    stats = comp.compact()
+    assert stats["replayed_inserts"] == MS + 2
+    assert stats["replayed_deletes"] == 7
+    # the mid-compaction clump is alive, clustered, and refit-exact
+    labs = live._labels[mid["ids"]]
+    assert (labs >= 0).all() and len(np.unique(labs)) == 1
+    _assert_refit_equivalent(live)
+    _assert_oracle_exact(live, live.points())
+
+
+def test_compaction_trigger_watermarks(monkeypatch):
+    m, X, centers = _fit(n=500, seed=3)
+    live = m.live(leaves=8)
+    rng = np.random.default_rng(5)
+    comp = Compactor(live, max_deltas=2, slab_bytes=1 << 40)
+    assert not comp.should_compact()
+    for i in range(2):
+        live.insert_batch(
+            centers[i] + rng.normal(scale=0.25, size=(8, X.shape[1]))
+        )
+    assert live.index.deltas_since_compact >= 2
+    assert comp.should_compact()
+    comp.compact()
+    assert not comp.should_compact(), "swap resets the watermarks"
+
+    # env-knob defaults flow into fresh Compactors
+    monkeypatch.setenv("PYPARDIS_COMPACT_DELTAS", "7")
+    monkeypatch.setenv("PYPARDIS_COMPACT_SLAB_BYTES", "12345")
+    c2 = Compactor(live)
+    assert c2.max_deltas == 7 and c2.slab_bytes == 12345
+
+
+def test_compact_phase_fault_leaves_old_generation_serving():
+    m, X, centers = _fit(n=400, seed=4)
+    live = m.live(leaves=4)
+    live.insert_batch(
+        centers[0] + np.full((4, X.shape[1]), 0.1)
+    )
+    Q = live.points()[:100]
+    pre = live.engine.predict(Q)
+    gen0, epoch0 = live.index.generation, live.index.epoch
+    with faults.plan("compact.phase:2=error"):  # dies in the refit
+        with pytest.raises(FaultInjected):
+            Compactor(live).compact()
+    assert live.index.generation == gen0
+    assert live.index.epoch == epoch0
+    assert not live._compact_active
+    np.testing.assert_array_equal(live.engine.predict(Q), pre)
+    # and a clean retry completes
+    Compactor(live).compact()
+    assert live.index.generation == gen0 + 1
+    _assert_refit_equivalent(live)
+
+
+def test_mid_compaction_save_load_discards_partial(tmp_path):
+    """Satellite (ISSUE 12): a checkpoint written mid-compaction
+    restores the pre-swap generation byte-exactly — never a
+    half-swapped index — flags compact_pending, and a fresh compaction
+    on the restored model completes."""
+    m, X, centers = _fit(n=500, seed=5)
+    live = m.live(leaves=8)
+    rng = np.random.default_rng(6)
+    live.insert_batch(
+        centers[0] + rng.normal(scale=0.25, size=(30, X.shape[1]))
+    )
+    path = str(tmp_path / "mid.npz")
+    pre_epoch = live.index.epoch
+    pre_coords = live.index.coords.copy()
+    pre_labels = live.index.labels.copy()
+
+    def hook(phase):
+        if phase == "build":  # refit done, partial generation pending
+            live.save(path)
+
+    Compactor(live, phase_hook=hook).compact()
+    assert live.index.epoch == pre_epoch + 1  # original DID swap
+
+    restored = LiveModel.load(path)
+    assert restored.compact_pending is True
+    assert restored.index.epoch == pre_epoch
+    assert restored.index.generation == 0
+    np.testing.assert_array_equal(restored.index.coords, pre_coords)
+    np.testing.assert_array_equal(restored.index.labels, pre_labels)
+    _assert_oracle_exact(restored, restored.points()[:100])
+    Compactor(restored).compact()
+    assert restored.compact_pending is True  # cleared by the operator
+    _assert_refit_equivalent(restored)
+    # a normal (no compaction in flight) save doesn't set the flag
+    path2 = str(tmp_path / "clean.npz")
+    restored.save(path2)
+    assert LiveModel.load(path2).compact_pending is False
+
+
+def test_mixed_load_with_background_compaction():
+    """Acceptance: sustained mixed read/write load across a background
+    compaction + epoch swap — zero dropped/failed tickets, oracle
+    exact after, >= 1 swap observed."""
+    from pypardis_tpu.serve import sustained_load
+
+    m, X, centers = _fit(n=600, seed=6)
+    live = m.live(leaves=8)
+
+    def wsamp(rng, k):
+        c = centers[rng.integers(0, len(centers))]
+        return c + rng.normal(scale=0.25, size=(k, X.shape[1]))
+
+    comp = Compactor(live)
+    res = sustained_load(
+        live.engine, clients=2, duration_s=1.2, rate_hz=80.0,
+        batch_rows=16, writers=1, write_rate_hz=30.0,
+        write_batch_rows=4, write_sampler=wsamp, live=live,
+        compactor=comp, compact_at_s=0.2, seed=9,
+    )
+    assert res["compactions"] >= 1
+    assert res["epoch_swaps"] >= 1
+    assert res["dropped_tickets"] == 0
+    assert res["write_failures"] == 0
+    assert res["deadline_failures"] == 0
+    for key in ("qps", "write_qps", "p99_ms",
+                "read_p99_during_compaction_ms",
+                "read_p99_outside_ms",
+                "compaction_overlap_degradation"):
+        assert np.isfinite(res[key]), (key, res[key])
+    _assert_oracle_exact(live, live.points()[:150])
+
+
+def test_report_ingest_fields_and_summary():
+    m, X, centers = _fit(n=400, seed=7)
+    live = m.live(leaves=4)
+    live.insert_batch(
+        centers[0] + np.full((4, X.shape[1]), 0.1)
+    )
+    Compactor(live).compact()
+    lv = m.report()["live"]
+    assert isinstance(lv["batch_sizes"], list) and lv["batch_sizes"]
+    for key in ("reclusters_per_write", "compaction_s"):
+        assert np.isfinite(lv[key]) and lv[key] >= 0
+    for key in ("compactions", "epoch_swaps", "recluster_dispatches",
+                "index_generation"):
+        assert isinstance(lv[key], int) and lv[key] >= 0
+    assert lv["compactions"] == 1 and lv["epoch_swaps"] == 1
+    s = m.summary()
+    assert "compact x1" in s and "batch mean" in s

@@ -363,6 +363,78 @@ def test_inflight_tickets_survive_epoch_bump():
         == live.index.epoch >= epoch0
 
 
+def test_epoch_swap_readers_old_then_new_generation():
+    """ISSUE 12: readers submitted BEFORE a compaction epoch swap drain
+    against the old generation; readers after see the new one — both
+    bitwise against their generation's oracle, zero dropped."""
+    from pypardis_tpu.serve import Compactor
+
+    m, X, centers = _fit(n=500, seed=5)
+    live = m.live(leaves=8)
+    rng = np.random.default_rng(11)
+    # Updates first, so the canonical live labels and a re-densified
+    # refit numbering genuinely differ across the swap.
+    spot = np.full(X.shape[1], 22.0)
+    live.insert(spot + rng.normal(scale=0.2, size=(MS + 2, X.shape[1])))
+    live.delete(live.ids()[3:9])
+    Q = np.concatenate([
+        live.points()[:150],
+        spot + rng.normal(scale=0.2, size=(20, X.shape[1])),
+    ])
+    pre_labs, pre_d2 = live.index.oracle_predict(Q)
+    before = live.engine.submit(Q)
+
+    comp = Compactor(live)
+    comp.compact()
+
+    assert before.done and not before.failed
+    np.testing.assert_array_equal(before.labels, pre_labs)
+    np.testing.assert_array_equal(before.d2, pre_d2)
+    after = live.engine.submit(Q)
+    live.engine.drain()
+    olabs, od2 = live.index.oracle_predict(Q)
+    np.testing.assert_array_equal(after.labels, olabs)
+    np.testing.assert_array_equal(after.d2, od2)
+    assert live.engine.serving_stats()["index_generation"] == 1
+    _assert_refit_equivalent(live)
+
+
+def test_replicated_engine_consistent_across_epoch_swap():
+    """ISSUE 12: a ReplicatedQueryEngine built over the live index
+    stays consistent across a whole-index generation swap — the
+    in-place replace + epoch bump re-broadcasts the replicas, answers
+    bitwise vs the new generation's oracle and vs the single-device
+    engine."""
+    from pypardis_tpu.serve import Compactor
+
+    m, X, centers = _fit(n=500, seed=6)
+    live = m.live(leaves=8)
+    rng = np.random.default_rng(12)
+    rep = ReplicatedQueryEngine(live.index, backend="xla")
+    Q = np.concatenate([
+        X[:150], rng.uniform(-15, 15, size=(60, X.shape[1]))
+    ])
+    t0 = rep.submit(Q)
+    rep.drain()
+    o0 = live.index.oracle_predict(Q)
+    np.testing.assert_array_equal(t0.labels, o0[0])
+
+    live.insert(centers[0] + rng.normal(scale=0.25,
+                                        size=(20, X.shape[1])))
+    Compactor(live).compact()
+
+    t1 = rep.submit(Q)
+    rep.drain()
+    o1, od1 = live.index.oracle_predict(Q)
+    np.testing.assert_array_equal(t1.labels, o1)
+    np.testing.assert_array_equal(t1.d2, od1)
+    t2 = live.engine.submit(Q)
+    live.engine.drain()
+    np.testing.assert_array_equal(t1.labels, t2.labels)
+    np.testing.assert_array_equal(t1.d2, t2.d2)
+    assert rep.serving_stats()["index_generation"] == 1
+
+
 def test_insert_validation_and_delete_unknown_id():
     m, X, _centers = _fit(n=300, seed=2)
     live = m.live()

@@ -237,6 +237,49 @@ def test_query_validation(fitted):
         engine.predict(bad)
 
 
+def test_replace_generation_keeps_engine_serving(fitted):
+    """ISSUE 12: an in-place whole-index generation swap — a fresh
+    build adopted by the SAME index object in the same recentring
+    frame — is invisible to an engine holding the object: the next
+    predict answers bitwise against the new generation's oracle, and
+    the epoch/generation clocks advance for replica-cache keys."""
+    m, X = fitted
+    idx = build_index(m, leaves=4, block=32)
+    engine = QueryEngine(idx, backend="xla")
+    Q = X[:120]
+    engine.predict(Q)  # stage the old generation on device
+    epoch0 = idx.epoch
+
+    # A different generation: half the cores, same frame.
+    mask = np.asarray(m.core_sample_mask_, bool)
+    cores = np.asarray(m.data)[mask]
+    labels = np.asarray(m.labels_, np.int32)[mask]
+    half = len(cores) // 2
+    fresh = CorePointIndex.build(
+        cores[:half], labels[:half], m.eps, block=32, qblock=32,
+        stage=False, center=idx.center,
+    )
+    np.testing.assert_array_equal(fresh.center, idx.center)
+    idx.replace_generation(fresh)
+
+    assert idx.generation == 1
+    assert idx.epoch == epoch0 + 1
+    assert idx.n_core == half
+    assert idx.appended_slab_bytes == 0
+    labs, _ = engine.predict(Q, return_distance=True), None
+    olabs, od2 = idx.oracle_predict(Q)
+    t = engine.submit(Q)
+    engine.drain()
+    np.testing.assert_array_equal(t.labels, olabs)
+    np.testing.assert_array_equal(t.d2, od2)
+    assert engine.serving_stats()["index_generation"] == 1
+    # an open delta update refuses to race a generation swap
+    idx.begin_update()
+    with pytest.raises(RuntimeError, match="delta update open"):
+        idx.replace_generation(fresh)
+    idx.commit_update()
+
+
 def test_oracle_property_randomized():
     """Hypothesis-style seeded sweep: random geometry, dtype, backend,
     leaf count — predict() equals the brute-force oracle exactly,
