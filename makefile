@@ -7,7 +7,7 @@ PY ?= python
 	partition-probe serve-probe live-probe ingest-probe \
 	global-morton-probe fault-probe bench-diff flight-check \
 	northstar northstar-smoke streammem-probe sort-probe \
-	kernel-probe demo clean
+	kernel-probe sweep-probe demo clean
 
 all: native test
 
@@ -49,7 +49,7 @@ bench:
 # level builder's mp-doubling cost ratio exceeds 1.5x).
 bench-smoke: partition-probe serve-probe live-probe ingest-probe \
 		global-morton-probe fault-probe bench-diff flight-check \
-		northstar-smoke kernel-probe
+		northstar-smoke kernel-probe sweep-probe
 	JAX_PLATFORMS=cpu BENCH_N=2000 BENCH_DIM=4 BENCH_REPS=1 \
 	BENCH_DEV_REPS=1 $(PY) bench.py \
 	| $(PY) scripts/bench_diff.py --annotate --baseline-dir . \
@@ -66,6 +66,17 @@ bench-smoke: partition-probe serve-probe live-probe ingest-probe \
 kernel-probe:
 	JAX_PLATFORMS=cpu $(PY) scripts/kernel_probe.py \
 	$${KP_N:-40000} $${KP_DIM:-16} $${KP_BLOCK:-256}
+
+# Amortized hyperparameter sweep (ISSUE 13): ONE distance pass at
+# eps_max + a cached neighbor-pair graph vs k independent fits on the
+# 8-device CPU mesh — gates distance_passes == 1, sweep wall <= 0.5x
+# the k solo fits, and per-config byte parity + ARI == 1.0; the
+# schema'd sweep@1 row rides the bench_diff cross-round gate.
+# Acceptance-scale run: `SWEEP_N=100000 make sweep-probe`.
+sweep-probe:
+	$(PY) scripts/sweep_probe.py \
+	| $(PY) scripts/bench_diff.py --annotate --baseline-dir . \
+	| $(PY) scripts/check_bench_json.py --require-diff
 
 # Cross-round bench regression gate on the committed archives: the
 # r4->r5 4.7% delta must come back as the PR 2 manual diagnosis did —

@@ -61,7 +61,13 @@ from .partition import (
     mean_var_split,
     min_var_split,
 )
-from .dbscan import DBSCAN, dbscan_partition, map_cluster_id
+from .dbscan import (
+    DBSCAN,
+    SweepResult,
+    dbscan_partition,
+    map_cluster_id,
+    sweep_dbscan,
+)
 from .config import DBSCANConfig
 from .checkpoint import (
     load_index,
@@ -84,6 +90,8 @@ __all__ = [
     "min_var_split",
     "DBSCAN",
     "DBSCANConfig",
+    "SweepResult",
+    "sweep_dbscan",
     "dbscan_partition",
     "map_cluster_id",
     "save_model",

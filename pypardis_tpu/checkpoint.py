@@ -314,6 +314,9 @@ def save_index(index, path: str) -> None:
             "n_core": index.n_core,
             "leaf_cap": int(index.stats.get("leaf_cap", 0)),
             "n_leaves": int(index.stats.get("n_leaves", 0)),
+            # Cosine-frame flag: a restored index must keep projecting
+            # queries onto the unit sphere (metric metadata, ISSUE 13).
+            "unit_norm": bool(getattr(index, "unit_norm", False)),
         }),
         center=index.center,
         tree=np.asarray(index.tree, np.float64).reshape(-1, 5),
@@ -356,4 +359,5 @@ def load_index(path: str):
                 "staged_bytes": 0,
             },
         )
+        idx.unit_norm = bool(params.get("unit_norm", False))
     return idx

@@ -95,6 +95,40 @@ def _as_float(data) -> np.ndarray:
     return pts
 
 
+def _unit_rows(points) -> np.ndarray:
+    """Rows scaled to unit L2 norm — the cosine metric's kernel frame.
+
+    On the unit sphere the squared Euclidean distance is ``2 - 2
+    cos(theta)``, monotone in angular distance, so after this
+    projection the existing L2 kernels serve cosine thresholds
+    exactly (``eps_cos -> sqrt(2 * eps_cos)``).  Norms accumulate in
+    float64 (the centering-accuracy discipline), chunked so no
+    dataset-sized f64 temp exists at any N; float32 inputs stay
+    float32.  Zero rows have no direction and reject loudly — the
+    sklearn input contract, not a silent all-noise fit.
+    """
+    pts = _as_float(points)
+    out = np.empty(
+        pts.shape, np.float64 if pts.dtype == np.float64 else np.float32
+    )
+    chunk = 1 << 20
+    for s in range(0, len(pts), chunk):
+        e = min(s + chunk, len(pts))
+        sub = np.asarray(pts[s:e], np.float64)
+        nrm = np.sqrt(np.einsum("ij,ij->i", sub, sub))
+        if not np.isfinite(nrm).all():
+            raise ValueError(
+                "input contains NaN or infinite coordinates"
+            )
+        if not nrm.all():
+            raise ValueError(
+                "metric='cosine' is undefined for zero vectors: row(s) "
+                "with zero norm in the input"
+            )
+        out[s:e] = (sub / nrm[:, None]).astype(out.dtype)
+    return out
+
+
 def _check_finite(points) -> None:
     """Raise ValueError on NaN/inf coordinates.
 
@@ -480,6 +514,69 @@ def map_cluster_id(x, mapping: Dict[str, int]):
     return key, -1
 
 
+class SweepResult:
+    """Result of an amortized hyperparameter sweep (:meth:`DBSCAN.sweep`).
+
+    ``configs`` is the requested ``(eps, min_samples)`` grid in request
+    order; per-config dense labels and core masks are byte-identical to
+    an independent ``train()`` at that config on the same mode (the
+    sweep's correctness contract, pinned in tests).  ``stats`` is the
+    ``report()["sweep"]`` telemetry block; ``per_config`` one dict per
+    config (relabel seconds, cluster count, staging reuse).
+    """
+
+    def __init__(self, configs, labels, core, per_config, stats):
+        self.configs = list(configs)
+        self._labels = labels
+        self._core = core
+        self.per_config = per_config
+        self.stats = stats
+
+    def _key(self, eps, min_samples=None):
+        if min_samples is None:
+            matches = [c for c in self.configs if c[0] == float(eps)]
+            if len(matches) != 1:
+                raise KeyError(
+                    f"eps={eps} matches {len(matches)} configs; pass "
+                    f"min_samples too"
+                )
+            return matches[0]
+        key = (float(eps), int(min_samples))
+        if key not in self._labels:
+            raise KeyError(f"config {key} was not in this sweep")
+        return key
+
+    def labels(self, eps, min_samples=None) -> np.ndarray:
+        """Dense labels for one config (noise = -1)."""
+        return self._labels[self._key(eps, min_samples)]
+
+    def core(self, eps, min_samples=None) -> np.ndarray:
+        """Core-sample mask for one config."""
+        return self._core[self._key(eps, min_samples)]
+
+    def __len__(self) -> int:
+        return len(self.configs)
+
+    def __iter__(self):
+        for c in self.configs:
+            yield c, self._labels[c]
+
+
+def sweep_dbscan(points, eps_list, min_samples_list=None, **kw):
+    """Functional amortized sweep: ONE distance pass, k clusterings.
+
+    ``kw`` are :class:`DBSCAN` constructor arguments; returns the
+    :class:`SweepResult`.  Equivalent to
+    ``DBSCAN(**kw).sweep(points, eps_list, min_samples_list)`` — the
+    model (with its ``report()`` carrying the ``sweep`` block) is
+    reachable as ``result.model``.
+    """
+    model = DBSCAN(**kw)
+    result = model.sweep(points, eps_list, min_samples_list)
+    result.model = model
+    return result
+
+
 class DBSCAN:
     """Distributed density-based clustering on a TPU mesh.
 
@@ -517,12 +614,19 @@ class DBSCAN:
         # deep-stack error.  check_precision also canonicalizes
         # jax.lax.Precision spellings to the mode strings, so report()
         # params and cache keys are stable.
-        from .utils.validate import check_kernel_backend, check_precision
+        from .utils.validate import (
+            check_kernel_backend, check_metric, check_precision,
+        )
 
         validate_params(eps, min_samples)
         self.eps = float(eps)
         self.min_samples = int(min_samples)
         self.metric = metric
+        # Canonical metric name ("euclidean"/"cityblock"/"cosine") —
+        # cosine is a DRIVER metric (unit-normalize + eps remap onto
+        # the L2 kernels, see _kernel_frame); validated here so a bad
+        # spec fails at construction, not deep inside a fit.
+        self._metric_norm = check_metric(metric, eps)
         self.max_partitions = max_partitions
         self.split_method = split_method
         self.block = int(block)
@@ -568,6 +672,9 @@ class DBSCAN:
         # export_trace().
         self._recorder = None
         self._fit_info: Dict[str, int] = {}
+        # Amortized-sweep telemetry of the most recent sweep() — the
+        # ``sweep`` block of report().
+        self._sweep_stats: Optional[Dict] = None
         # Serving state (pypardis_tpu.serve): the cached query engine
         # and, for checkpoint-loaded models, the persisted core-point
         # coordinates the index builds from.
@@ -584,10 +691,68 @@ class DBSCAN:
         # train() when resume=/PYPARDIS_CKPT asks for it.
         self._jobstate = None
 
+    # -- the cosine kernel frame ------------------------------------------
+
+    @property
+    def kernel_eps(self) -> float:
+        """eps in the KERNEL frame: for ``metric='cosine'`` the L2
+        threshold ``sqrt(2 * eps)`` on the unit sphere (``d^2 = 2 - 2
+        cos``, monotone in angular distance), else eps unchanged.  The
+        serving index builds against this value
+        (:func:`pypardis_tpu.serve.index.build_index`)."""
+        if self._metric_norm == "cosine":
+            return float(np.sqrt(2.0 * self.eps))
+        return float(self.eps)
+
+    def _kernel_frame(self):
+        """Context manager swapping ``(eps, metric)`` to the kernel
+        frame for the duration of a fit/sweep body.
+
+        For cosine, every internal consumer of ``self.eps`` /
+        ``self.metric`` — halo expansion, staging keys, jobstate
+        metadata, the kernels themselves — must see the remapped L2
+        values, and there are a dozen such sites; one swap at the
+        boundary keeps them all consistent.  User-facing values are
+        restored on exit (``report()`` params and checkpoints carry
+        the cosine spec).  A no-op for the kernel metrics.
+        """
+        import contextlib
+
+        if self._metric_norm != "cosine":
+            return contextlib.nullcontext()
+
+        @contextlib.contextmanager
+        def swap():
+            saved = (self.eps, self.metric)
+            self.eps, self.metric = self.kernel_eps, "euclidean"
+            try:
+                yield
+            finally:
+                self.eps, self.metric = saved
+
+        return swap()
+
     # -- training ---------------------------------------------------------
 
     def train(self, data, resume: Optional[str] = None) -> "DBSCAN":
         """Cluster a (key, vector) dataset (reference dbscan.py:104-126).
+
+        ``metric='cosine'`` fits run in the unit-sphere kernel frame:
+        rows are unit-normalized (``model.data`` holds the normalized
+        points — the frame every downstream surface, serving included,
+        shares) and eps remaps to ``sqrt(2 * eps)`` for the L2 kernels;
+        labels are exactly the cosine-threshold clustering.
+        """
+        if self._metric_norm == "cosine":
+            keys, points = _as_keys_points(data)
+            with self._kernel_frame():
+                self._train_impl((keys, _unit_rows(points)), resume)
+            return self
+
+        return self._train_impl(data, resume)
+
+    def _train_impl(self, data, resume: Optional[str] = None) -> "DBSCAN":
+        """The metric-agnostic fit body (kernel-frame eps/metric).
 
         With ``profile_dir`` set, the whole run executes under a
         ``jax.profiler`` trace (TensorBoard/Perfetto-viewable), and
@@ -771,6 +936,637 @@ class DBSCAN:
     def fit_predict(self, X) -> np.ndarray:
         return self.fit(X).labels_
 
+    # -- amortized hyperparameter sweeps ----------------------------------
+
+    def sweep(self, data, eps_list, min_samples_list=None) -> SweepResult:
+        """Fit every ``(eps, min_samples)`` config with ONE distance pass.
+
+        Hyperparameter search is the workload real users run, and a
+        k-config sweep used to pay the full MXU distance pass k times.
+        This runs the distance kernels ONCE at ``eps_max =
+        max(eps_list)`` and materializes a compacted neighbor-pair
+        graph — per live tile pair, the surviving ``(i, j, d2)``
+        triples into a budgeted device slab (the OPTICS one-pass/
+        many-eps idea, Ankerst et al. SIGMOD 1999, on the Clipper-style
+        amortization this repo already serves reads with).  Each config
+        then re-thresholds the cached ``d2`` for counts and
+        min-propagates labels to a fixpoint over the cached pair list —
+        no distance recomputation, no re-staging of owned slabs
+        (eps-free staging keys), halo/boundary context built once at
+        eps_max so every smaller eps is covered by construction.
+
+        Per-config labels are BYTE-IDENTICAL to an independent
+        ``train()`` at that config on the same mode (fused / KD
+        owner-computes / global-Morton), pinned in tests.  One known
+        caveat, shared with the engine family's own cross-route
+        parity: a NON-CORE border point within eps of core points of
+        two clusters that stay distinct attaches per the relabel
+        engine's canonical-min rule, while each train() route makes
+        its own slab-order-dependent choice there (train(kd) vs
+        train(fused) already disagree on such points) — the clustering
+        partition is identical either way, only that border's cluster
+        id differs, and parity geometries without cluster-contact
+        borders are exact.  Graph
+        overflow past ``PYPARDIS_SWEEP_MAX_PAIRS`` — or any degradable
+        build failure — falls back label-safely to per-config refits
+        (k distance passes, never wrong labels;
+        ``report()["sweep"]["degraded"]`` says so).
+
+        ``min_samples_list=None`` sweeps eps at this model's
+        ``min_samples``; otherwise the full eps × min_samples grid
+        runs.  Sorted and unsorted ``eps_list`` give identical
+        per-config results (the graph depends only on eps_max).  The
+        model surface (``labels_`` etc.) is left at the LAST config;
+        ``report()["sweep"]`` carries ``distance_passes``,
+        ``graph_pairs``, ``graph_bytes``, per-config relabel seconds
+        and the amortization estimate.  ``metric='cosine'`` sweeps
+        ride the same cached graph (thresholds remap monotonically).
+        """
+        import time as _time
+
+        from . import obs
+        from .utils.profiling import PhaseTimer
+        from .utils.validate import check_metric
+
+        eps_arr = np.atleast_1d(np.asarray(eps_list, np.float64))
+        if eps_arr.ndim != 1 or len(eps_arr) == 0:
+            raise ValueError("eps_list must be a non-empty 1-D sequence")
+        eps_vals = [float(e) for e in eps_arr]
+        if min_samples_list is None:
+            ms_vals = [int(self.min_samples)]
+        else:
+            ms_arr = np.atleast_1d(np.asarray(min_samples_list))
+            if ms_arr.ndim != 1 or len(ms_arr) == 0:
+                raise ValueError(
+                    "min_samples_list must be None or a non-empty 1-D "
+                    "sequence"
+                )
+            ms_vals = [int(m) for m in ms_arr]
+        for e in eps_vals:
+            validate_params(e, 1)
+            check_metric(self.metric, e)
+        for m in ms_vals:
+            validate_params(eps_vals[0], m)
+        configs = [(e, m) for e in eps_vals for m in ms_vals]
+
+        keys, points = _as_keys_points(data)
+        if self._metric_norm == "cosine":
+            points = _unit_rows(points)
+        if len(points) == 0:
+            raise ValueError("sweep needs a non-empty dataset")
+
+        t0 = _time.perf_counter()
+        rec = obs.RunRecorder()
+        self._recorder = rec
+        self.metrics_ = {}
+        self._serve_engine = None
+        self._serve_core_points = None
+        self._live_model = None
+        self._live_stats = None
+        self._fit_generation += 1
+        self._keys = keys
+        self.data = points
+        self.partitioner_ = None
+        self.bounding_boxes = self.expanded_boxes = None
+        self.neighbors = None
+        self.cluster_dict = None
+        self._sweep_stats = None
+        timer = PhaseTimer()
+        sampler = obs.ResourceSampler(rec).start()
+        try:
+            with obs.use_recorder(rec):
+                _check_finite(points)
+                with self._kernel_frame():
+                    labels, core, per_cfg, sweep = self._sweep_run(
+                        points, configs, timer
+                    )
+        finally:
+            sampler.stop()
+        self._result_cache = None
+        # Model surface from the LAST config (a sweep leaves a fitted
+        # model, like a fit at that config would).
+        last = configs[-1]
+        self.labels_ = labels[last]
+        self.core_sample_mask_ = core[last]
+        self.metrics_.update(timer.as_dict())
+        self.metrics_["total_s"] = _time.perf_counter() - t0
+        self.metrics_["points_per_sec"] = (
+            len(configs) * len(points) / max(self.metrics_["total_s"], 1e-9)
+        )
+        from .parallel import staging as _dev_staging
+
+        reused, shipped = _dev_staging.fit_stats()
+        self.metrics_.setdefault("staged_bytes_reused", int(reused))
+        self.metrics_.setdefault("staged_bytes", int(shipped))
+        self.metrics_.setdefault("live_pairs", int(sweep["graph_pairs"]))
+        wall = self.metrics_["total_s"]
+        sweep["sweep_wall_s"] = round(wall, 6)
+        # Amortization ESTIMATE from the sweep's own walls: a solo fit
+        # ~ one distance/graph pass + one propagation.  The probe
+        # (scripts/sweep_probe.py) measures the real ratio against
+        # actual solo fits and gates on it.
+        solo_est = sweep.get("graph_build_s", 0.0) + (
+            sweep["relabel_s"][0] if sweep.get("relabel_s") else 0.0
+        )
+        sweep["sweep_amortization"] = round(
+            len(configs) * solo_est / max(wall, 1e-9), 4
+        )
+        self._sweep_stats = sweep
+        self._fit_info = {
+            "n_dims": int(points.shape[1]),
+            "n_devices": int(sweep.get("n_devices", 1)),
+        }
+        log_phase(
+            "sweep", n=len(points), k=len(configs),
+            distance_passes=sweep["distance_passes"],
+            graph_pairs=sweep["graph_pairs"],
+            seconds=round(wall, 4),
+        )
+        return SweepResult(configs, labels, core, per_cfg, sweep)
+
+    def _sweep_run(self, points, configs, timer):
+        """Routing + graph ladder + per-config relabel (kernel frame).
+
+        Mirrors ``train``'s routing exactly: the sharded gate first
+        (``n_devices > 1 and n >= 2 * n_devices``; device-resident
+        input always takes the fused path), then ``mode``.
+        """
+        from .parallel import staging as _staging
+        from .parallel.sharded import SweepGraphOverflow
+        from .utils.hints import dispatch_tag
+
+        if self._metric_norm == "cosine":
+            eps_k = [float(np.sqrt(2.0 * e)) for e, _ in configs]
+        else:
+            eps_k = [float(e) for e, _ in configs]
+        eps_max = max(eps_k)
+        n = len(points)
+        n_devices = self._n_devices()
+        sharded = (
+            not _is_device_array(points)
+            and n_devices > 1
+            and n >= 2 * n_devices
+        )
+        _staging.begin_fit()
+        try:
+            if sharded and self.mode == "global_morton":
+                run_mode = "global_morton"
+                relabel = self._sweep_graph_global(
+                    points, eps_max, timer, run_mode, n_devices
+                )
+            elif sharded:
+                run_mode = "kd"
+                relabel = self._sweep_graph_kd(
+                    points, eps_max, timer, n_devices
+                )
+            else:
+                run_mode = "fused"
+                n_devices = 1
+                relabel = self._sweep_graph_fused(points, eps_max, timer)
+        except Exception as e:  # noqa: BLE001 — rethrown unless degradable
+            from .utils.retry import is_degradable_error, note_degraded
+
+            if not (
+                isinstance(e, SweepGraphOverflow) or is_degradable_error(e)
+            ):
+                raise
+            note_degraded("sweep_refit", error=str(e)[:160])
+            get_logger().warning(
+                "sweep graph unavailable (%s); degrading to per-config "
+                "refits — labels stay exact, one distance pass per "
+                "config", e,
+            )
+            return self._sweep_refit(points, configs, timer)
+
+        relabel_fn, gstats = relabel
+        import time as _time
+
+        labels_out, core_out, per_cfg = {}, {}, []
+        relabel_s = []
+        passes_total = 0
+        reused_before = _staging.fit_stats()[0]
+        for i, (cfg, e_k) in enumerate(zip(configs, eps_k)):
+            t_c = _time.perf_counter()
+            if i:
+                # Configs 2..k re-threshold the device-resident graph
+                # the first config staged — count the reuse like any
+                # warm staging hit.
+                _staging.touch_route(_staging.SWEEP_GRAPH_ROUTE)
+            with timer.phase("relabel"):
+                lab, cor, passes = relabel_fn(e_k, cfg[1])
+            reused_now = _staging.fit_stats()[0]
+            with timer.phase("densify"):
+                dense = densify_labels(lab)
+            labels_out[cfg] = dense
+            core_out[cfg] = cor
+            passes_total += int(passes)
+            dt = _time.perf_counter() - t_c
+            relabel_s.append(round(dt, 6))
+            per_cfg.append(
+                {
+                    "eps": cfg[0],
+                    "min_samples": cfg[1],
+                    "relabel_s": round(dt, 6),
+                    "n_clusters": int(dense.max()) + 1,
+                    "passes": int(passes),
+                    "staged_bytes_reused": int(
+                        reused_now - reused_before
+                    ),
+                }
+            )
+            reused_before = reused_now
+        self.metrics_["kernel_passes"] = passes_total + 1
+        sweep = {
+            "k": len(configs),
+            "configs": [[e, m] for e, m in configs],
+            "distance_passes": 1,
+            "graph_pairs": int(gstats["graph_pairs"]),
+            "graph_bytes": int(gstats["graph_bytes"]),
+            "graph_build_s": round(float(gstats.get("build_s", 0.0)), 6),
+            "relabel_s": relabel_s,
+            "mode": run_mode,
+            "owner_computes": run_mode != "fused",
+            "dispatch": dispatch_tag(
+                int(gstats.get("owned_cap", n)) // max(self.block, 1)
+            ),
+            "degraded": None,
+            "n_devices": int(n_devices),
+        }
+        self.metrics_["n_partitions"] = int(
+            gstats.get("n_partitions", 1)
+        )
+        for k_ in ("boundary_tiles", "boundary_tile_bytes",
+                   "halo_factor", "halo_bytes", "partition_sizes"):
+            if k_ in gstats:
+                self.metrics_[k_] = gstats[k_]
+        return labels_out, core_out, per_cfg, sweep
+
+    def _sweep_graph_fused(self, points, eps_max, timer):
+        """Fused-route graph: layout once (shared ``pipeline_layout``
+        staging route), pair emission in KERNEL-slot space, per-config
+        relabel packed through the fused wire format — labels
+        byte-identical to ``train()``'s Morton-first numbering."""
+        import time as _time
+
+        import jax.numpy as jnp
+
+        from .ops.distances import _norm_metric, sweep_max_edges
+        from .ops.pipeline import (
+            sweep_config_pack,
+            sweep_graph_pipeline,
+            unpack_pipeline_result,
+        )
+        from .parallel import staging as _staging
+        from .parallel.sharded import SweepGraphOverflow
+
+        t_b = _time.perf_counter()
+        metric_k = self.metric
+        n, k = (
+            (points.shape[0], points.shape[1])
+            if not _is_device_array(points)
+            else points.shape
+        )
+        block = clamp_block(self.block, n)
+        cap = round_up(n, block)
+        sort = n > 2 * block
+        route_key = None
+        cached = None
+        if not _is_device_array(points) and _layout_cacheable(cap, k):
+            fp = _staging.points_fingerprint(points)
+            layout_key = (
+                fp, block, cap, bool(sort), self.precision,
+                float(eps_max),
+            )
+            route_key = (
+                "fused", fp, block, cap, bool(sort), self.precision,
+                str(self.metric),
+            )
+            cached = _staging.device_get_cover(
+                _staging.SWEEP_GRAPH_ROUTE, route_key, eps_max
+            )
+        else:
+            layout_key = None
+
+        if cached is not None:
+            (gi, gj, dv, mask_k, owner), aux = cached
+            stats = np.asarray(aux["stats"])
+            cap = int(aux["cap"])
+        else:
+            with timer.phase("graph"):
+                if _is_device_array(points):
+                    from .ops.pipeline import device_prep
+
+                    def make_dev():
+                        return device_prep(points, cap=cap)
+                else:
+                    pts_host = _as_float(points)
+
+                    def make_dev():
+                        # Fresh staging fill (not the borrowed pool
+                        # buffer — the sweep ships once and the graph
+                        # outlives it, so pool rotation buys nothing
+                        # and returning an aliased buffer would be the
+                        # give_back_after_put hazard).
+                        center = pts_host.mean(axis=0, dtype=np.float64)
+                        buf = np.zeros((k, cap), np.float32)
+                        chunk = 1 << 20
+                        for s in range(0, n, chunk):
+                            e = min(s + chunk, n)
+                            np.subtract(
+                                pts_host[s:e].T, center[:, None],
+                                out=buf[:, s:e], casting="unsafe",
+                            )
+                        import jax.numpy as _jnp
+
+                        return _jnp.asarray(buf)
+
+                eb = None
+                pb = None
+                cap_edges = sweep_max_edges()
+                for attempt in (0, 1):
+                    graph, mask_k, owner, cap, stats = (
+                        sweep_graph_pipeline(
+                            make_dev, eps_max, n, metric=metric_k,
+                            block=block, precision=self.precision,
+                            backend=self.kernel_backend, sort=sort,
+                            layout_key=layout_key, edge_budget=eb,
+                            pair_budget=pb,
+                        )
+                    )
+                    need_e, got_e = int(stats[0]), int(stats[1])
+                    need_p, got_p = int(stats[2]), int(stats[3])
+                    if need_e > cap_edges:
+                        # Checked before the no-overflow break: the
+                        # host-compaction route never overflows a
+                        # budget (lists grow to the exact total), but
+                        # the slab cap still binds.
+                        raise SweepGraphOverflow(
+                            f"neighbor-pair graph needs {need_e} edges "
+                            f"but the sweep cap is {cap_edges} "
+                            f"(PYPARDIS_SWEEP_MAX_PAIRS)"
+                        )
+                    if need_e <= got_e and need_p <= got_p:
+                        break
+                    if attempt == 1:
+                        raise SweepGraphOverflow(
+                            f"graph emission overflow persisted after "
+                            f"an exact-total retry ({need_e}/{got_e}, "
+                            f"{need_p}/{got_p})"
+                        )
+                    from .obs import event as obs_event
+
+                    obs_event(
+                        "pair_overflow", total=need_e, budget=got_e,
+                        route="sweep_graph",
+                    )
+                    eb = round_up(max(need_e, 1), 4096)
+                    if need_p > got_p:
+                        pb = round_up(max(need_p, 1), 4096)
+                gi, gj, dv = graph
+            if route_key is not None:
+                _staging.device_put_cached(
+                    _staging.SWEEP_GRAPH_ROUTE, route_key,
+                    (gi, gj, dv, mask_k, owner),
+                    aux={
+                        "eps_max": float(eps_max), "cap": cap,
+                        "stats": np.asarray(stats),
+                    },
+                )
+        build_s = _time.perf_counter() - t_b
+        edge_stats = jnp.asarray(stats[:2], jnp.int32)
+        metric_norm = _norm_metric(metric_k)
+
+        if jax_backend_name() == "cpu":
+            # Host relabel in kernel-slot space + the numpy twin of
+            # _pipeline_pack's owner unscatter — byte-identical wire
+            # semantics, segmented reductions instead of XLA scatters.
+            from .ops.labels import (
+                graph_dbscan_host,
+                graph_dbscan_host_prepare,
+            )
+
+            state = graph_dbscan_host_prepare(
+                np.asarray(gi), np.asarray(gj), np.asarray(dv)
+            )
+            mask_np = np.asarray(mask_k)
+            owner_np = np.asarray(owner)
+            capk = len(mask_np)
+
+            def relabel(eps_c, ms_c):
+                roots_s, core_s, passes = graph_dbscan_host(
+                    state, mask_np, eps_c, ms_c, metric=metric_norm
+                )
+                valid = roots_s >= 0
+                tgt = np.clip(roots_s, 0, capk - 1)
+                roots_gl = np.where(valid, owner_np[tgt], -1)
+                out = np.full(cap, -1, np.int32)
+                core_out = np.zeros(cap, bool)
+                sel = owner_np < cap
+                out[owner_np[sel]] = roots_gl[sel]
+                core_out[owner_np[sel]] = core_s[sel]
+                return out[:n], core_out[:n], passes
+        else:
+
+            def relabel(eps_c, ms_c):
+                packed = np.asarray(
+                    sweep_config_pack(
+                        gi, gj, dv, mask_k, owner, eps_c, ms_c,
+                        edge_stats, cap=cap, metric=metric_norm,
+                    )
+                )
+                roots, core, _t, _b2, passes, _bp, _rs = (
+                    unpack_pipeline_result(packed)
+                )
+                return roots[:n], core[:n], passes
+
+        gstats = {
+            "graph_pairs": int(min(int(stats[0]), int(stats[1]))),
+            "graph_bytes": int(min(int(stats[0]), int(stats[1]))) * 12,
+            "build_s": build_s,
+            "n_partitions": 1,
+            "owned_cap": cap,
+        }
+        return relabel, gstats
+
+    def _sweep_graph_kd(self, points, eps_max, timer, n_devices):
+        """KD-route graph: partition + owner-computes slabs at eps_max
+        (staging-cached, owned slabs eps-free) → global-id graph."""
+        from .parallel.sharded import sweep_graph_sharded
+
+        with timer.phase("partition"):
+            max_parts = (
+                n_devices if self.max_partitions is None
+                else int(self.max_partitions)
+            )
+            part = KDPartitioner(
+                points,
+                max_partitions=max_parts,
+                split_method=self.split_method,
+            )
+            self.partitioner_ = part
+            self.metrics_["partition_levels_s"] = [
+                round(float(t), 6) for t in part.level_times_s
+            ]
+            self.metrics_["partition_builder"] = part.builder
+            self.bounding_boxes = part.bounding_boxes
+            self.expanded_boxes = {
+                l: b.expand(2 * self.eps)
+                for l, b in part.bounding_boxes.items()
+            }
+        with timer.phase("graph"):
+            graph, gstats = sweep_graph_sharded(
+                points, part, eps_max, block=self.block, mesh=self.mesh,
+                precision=self.precision, backend=self.kernel_backend,
+                metric=self.metric,
+            )
+        return self._global_relabel(graph, len(points), gstats, timer)
+
+    def _sweep_graph_global(self, points, eps_max, timer, run_mode,
+                            n_devices):
+        """Global-Morton-route graph: morton ranges + boundary tiles at
+        eps_max (the ring exchange), zero duplicated rows."""
+        from .parallel.global_morton import sweep_graph_global_morton
+
+        if _is_device_array(points):
+            raise ValueError(
+                "mode='global_morton' needs host-resident input (same "
+                "restriction as train)"
+            )
+        with timer.phase("graph"):
+            graph, gstats = sweep_graph_global_morton(
+                points, eps_max, block=self.block, mesh=self.mesh,
+                precision=self.precision, backend=self.kernel_backend,
+                metric=self.metric,
+            )
+        self.metrics_["partition_builder"] = "morton_range"
+        self.metrics_["partition_levels_s"] = []
+        return self._global_relabel(graph, len(points), gstats, timer)
+
+    def _global_relabel(self, graph, n, gstats, timer):
+        """Per-config relabel closure over a global-id-space graph —
+        converges to min-core-gid roots, the sharded routes' canonical
+        label convention."""
+        import time as _time
+
+        import jax.numpy as jnp
+
+        from .ops.labels import graph_dbscan
+        from .parallel import staging as _staging
+
+        t_b = _time.perf_counter()
+        gi, gj, dv = graph
+        gi_d = jnp.asarray(gi)
+        gj_d = jnp.asarray(gj)
+        dv_d = jnp.asarray(dv)
+        mask = jnp.ones(n, bool)
+        route_key = (
+            gstats.get("mode", "kd"), n, int(self.block), self.precision,
+            str(self.metric),
+        )
+        _staging.device_put_cached(
+            _staging.SWEEP_GRAPH_ROUTE, route_key, (gi_d, gj_d, dv_d),
+            aux={"eps_max": 0.0},
+        )
+        from .ops.distances import _norm_metric
+
+        metric_norm = _norm_metric(self.metric)
+
+        if jax_backend_name() == "cpu":
+            # Host relabel fast path: same unique fixpoint, segmented
+            # numpy reductions instead of the single-threaded XLA
+            # scatters (see ops.labels.graph_dbscan_host).
+            from .ops.labels import (
+                graph_dbscan_host,
+                graph_dbscan_host_prepare,
+            )
+
+            state = graph_dbscan_host_prepare(gi, gj, dv)
+            mask_np = np.ones(n, bool)
+
+            def relabel(eps_c, ms_c):
+                lab, cor, passes = graph_dbscan_host(
+                    state, mask_np, eps_c, ms_c, metric=metric_norm
+                )
+                return lab, cor, passes
+        else:
+
+            def relabel(eps_c, ms_c):
+                lab, cor, passes = graph_dbscan(
+                    gi_d, gj_d, dv_d, mask, eps_c, ms_c,
+                    metric=metric_norm,
+                )
+                return np.asarray(lab), np.asarray(cor), int(passes)
+
+        gstats = dict(gstats, build_s=_time.perf_counter() - t_b
+                      + gstats.get("build_s", 0.0))
+        return relabel, gstats
+
+    def _sweep_refit(self, points, configs, timer):
+        """Label-safe degradation rung: k independent fits (the
+        pre-sweep cost — one distance pass per config, never wrong
+        labels).  Runs in the kernel frame on the already-normalized
+        points, so cosine configs refit correctly too."""
+        import time as _time
+
+        labels_out, core_out, per_cfg = {}, {}, []
+        relabel_s = []
+        kernel = self._metric_norm == "cosine"
+        for cfg in configs:
+            e_u, ms = cfg
+            e_k = float(np.sqrt(2.0 * e_u)) if kernel else float(e_u)
+            t_c = _time.perf_counter()
+            m = DBSCAN(
+                eps=e_k,
+                min_samples=ms,
+                metric=self.metric,
+                max_partitions=self.max_partitions,
+                split_method=self.split_method,
+                block=self.block,
+                mesh=self.mesh,
+                precision=self.precision,
+                kernel_backend=self.kernel_backend,
+                merge=self.merge,
+                owner_computes=self.owner_computes,
+                overlap=self.overlap,
+                mode=self.mode,
+            )
+            with timer.phase("refit"):
+                m.train(points)
+            labels_out[cfg] = np.asarray(m.labels_)
+            core_out[cfg] = np.asarray(m.core_sample_mask_)
+            dt = _time.perf_counter() - t_c
+            relabel_s.append(round(dt, 6))
+            per_cfg.append(
+                {
+                    "eps": e_u,
+                    "min_samples": ms,
+                    "relabel_s": round(dt, 6),
+                    "n_clusters": int(labels_out[cfg].max()) + 1,
+                    "passes": 0,
+                    "staged_bytes_reused": int(
+                        m.metrics_.get("staged_bytes_reused", 0)
+                    ),
+                }
+            )
+        from .utils.hints import dispatch_tag
+
+        sweep = {
+            "k": len(configs),
+            "configs": [[e, m_] for e, m_ in configs],
+            "distance_passes": len(configs),
+            "graph_pairs": 0,
+            "graph_bytes": 0,
+            "graph_build_s": 0.0,
+            "relabel_s": relabel_s,
+            "mode": self.mode,
+            "owner_computes": False,
+            "dispatch": dispatch_tag(None),
+            "degraded": "per_config_refit",
+            "n_devices": int(self._n_devices()),
+        }
+        self.metrics_["n_partitions"] = 1
+        return labels_out, core_out, per_cfg, sweep
+
     # ``labels_`` / ``core_sample_mask_`` / ``data`` are properties so
     # the live-update path can sync them LAZILY: LiveModel used to copy
     # all three O(N) arrays on EVERY update (the CHANGES PR 8 note) —
@@ -905,6 +1701,13 @@ class DBSCAN:
         this fitted model — the incremental write surface (built on
         first use; kwargs force a rebuild).  Invalidated by a refit."""
         self._require_fitted()
+        if self._metric_norm == "cosine":
+            raise NotImplementedError(
+                "live updates with metric='cosine' are not supported "
+                "yet: the incremental algebra reads model.eps in the "
+                "unit-sphere kernel frame; fit/predict/sweep all "
+                "support cosine"
+            )
         if self._live_model is None or kw:
             from .serve import LiveModel
 
@@ -944,7 +1747,7 @@ class DBSCAN:
             else None
         )
         live = dict(self._live_stats) if self._live_stats else None
-        return build_run_report(
+        rep = build_run_report(
             self._recorder,
             params={
                 "eps": self.eps,
@@ -969,6 +1772,11 @@ class DBSCAN:
             serving=serving,
             live=live,
         )
+        # Amortized-sweep block (ISSUE 13): present only after sweep();
+        # scripts/check_bench_json.py validates it on sweep@1 rows.
+        if self._sweep_stats:
+            rep["sweep"] = dict(self._sweep_stats)
+        return rep
 
     def summary(self) -> str:
         """One-screen human rendering of :meth:`report`."""

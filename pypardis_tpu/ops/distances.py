@@ -89,6 +89,18 @@ def _norm_metric(metric) -> str:
         )
     if metric in ("cityblock", "manhattan", "l1"):
         return "cityblock"
+    if metric in ("cosine", "angular"):
+        # Cosine is a DRIVER-level metric: DBSCAN unit-normalizes the
+        # rows and remaps eps onto the L2 kernels (on the unit sphere
+        # d^2 = 2 - 2*cos(theta), monotone in angular distance, so the
+        # existing kernels serve it exactly).  The kernels themselves
+        # are L2/L1-only and must never see it.
+        raise ValueError(
+            "metric 'cosine' is served at the driver level (unit-"
+            "normalization + eps remap — use DBSCAN(metric='cosine')); "
+            "the tiled kernels are euclidean/cityblock only (internal "
+            "dispatch error if a driver passed it through)"
+        )
     raise ValueError(
         f"unsupported metric {metric!r}: TPU path supports euclidean and "
         "cityblock (the reference documents the same restriction, "
@@ -552,7 +564,12 @@ def live_tile_pairs(
     thi_rg = thi_r.reshape(ng + 1, G, d)
     tlo_cg = tlo_c.reshape(ng + 1, G, d)
     thi_cg = thi_c.reshape(ng + 1, G, d)
-    chunk_p = max(1, (1 << 26) // (G * G * d))
+    # Clamp to the group-pair budget: the memory bound alone admits a
+    # ~500k chunk, and at small grids (the sweep emission runs this at
+    # nt in the tens) padding budget_g=8k up to one such chunk made the
+    # level-2 expansion compute 64x dead box tests per call — 0.9s of
+    # pure padding waste per emission at the probe geometry.
+    chunk_p = max(1, min(budget_g, (1 << 26) // (G * G * d)))
     nc_p = -(-budget_g // chunk_p)
     pad_p = nc_p * chunk_p - budget_g
     rows_gp = jnp.concatenate([rows_g, jnp.full(pad_p, ng, jnp.int32)])
@@ -1045,3 +1062,351 @@ def min_neighbor_label(
     if not mixed:
         return best
     return best, jnp.stack([jnp.sum(bps), jnp.sum(rss)])
+
+
+# ---------------------------------------------------------------------------
+# Neighbor-pair graph emission — the amortized-sweep distance pass.
+#
+# A hyperparameter sweep re-runs the SAME distance arithmetic k times
+# with only the threshold changing.  One emission pass at eps_max
+# materializes every surviving (i, j, dval) triple into a budgeted
+# CSR-style slab; each (eps <= eps_max, min_samples) config then
+# re-thresholds the cached dval and label-propagates over the cached
+# pair list — no distance recomputation (ops.labels.graph_dbscan).
+# dval is computed by exactly the arithmetic the tiled kernels run
+# (the |x|^2+|y|^2-2xy expansion at the same dot precision), so a
+# per-config re-threshold reproduces the kernels' adjacency BITWISE —
+# the sweep's byte-parity contract.
+# ---------------------------------------------------------------------------
+
+_F32_INF = np.float32(np.inf)
+
+
+def sweep_max_edges() -> int:
+    """Hard cap on the sweep's neighbor-pair graph slab, in edges
+    (``PYPARDIS_SWEEP_MAX_PAIRS``; default 2^26 ~ 768MB at 12
+    bytes/edge).  Past it the sweep degrades label-safely to
+    per-config refits instead of allocating an unbounded slab — the
+    graph is an amortization, never a correctness requirement."""
+    return int(os.environ.get("PYPARDIS_SWEEP_MAX_PAIRS", str(1 << 26)))
+
+
+def default_edge_budget(n: int) -> int:
+    """Default neighbor-pair graph capacity: 96 directed edges per row.
+
+    Self-pairs ride in the graph (the kernels count them too), and the
+    blob/manifold probe geometries measure ~20-60 within-eps neighbors
+    per point at mid-gap eps; 96 gives slack without inflating the
+    slab (budget * 12 bytes).  Overflow is signalled exactly (the
+    returned total is the true count), so one retry always suffices.
+    """
+    return max(1 << 16, 96 * n)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "metric", "block", "precision", "layout", "row_tiles", "budget",
+        "pair_budget",
+    ),
+)
+def neighbor_pair_graph(
+    points: jnp.ndarray,
+    mask: jnp.ndarray,
+    eps,
+    metric: str = "euclidean",
+    block: int = 1024,
+    precision: str = "high",
+    layout: str = "nd",
+    row_tiles: int | None = None,
+    budget: int | None = None,
+    pair_budget: int | None = None,
+):
+    """Emit every surviving ``(i, j, dval)`` neighbor triple at ``eps``.
+
+    ``dval`` is the kernels' threshold quantity — squared Euclidean
+    distance (``metric="euclidean"``) or the L1 distance
+    (``"cityblock"``) — computed with the SAME tile arithmetic the
+    counts/minlab kernels use, so ``dval <= eps_c^2`` (resp. ``<=
+    eps_c``) at any config ``eps_c <= eps`` reproduces that config's
+    kernel adjacency bitwise.  Driven over the compacted live
+    tile-pair list (:func:`live_tile_pairs` — the PR 11 machinery), so
+    the MXU never visits a pair the boxes already ruled out.
+
+    ``row_tiles`` restricts EMITTING rows to the first ``row_tiles *
+    block`` slots (the owner-computes discipline: owned rows emit, halo
+    /boundary slots serve as column evidence only — each directed edge
+    is emitted exactly once, by its row's owner).  Self-pairs are
+    included when they pass the threshold, exactly as the kernels'
+    adjacency does.
+
+    Returns ``(gi, gj, dval, stats)``: budget-sized int32/int32/f32
+    slabs (inert padding: ``dval == +inf``, never live at any config)
+    and a (4,) int32 ``[edge_total, edge_budget, tile_pair_total,
+    tile_pair_budget]``.  Either ``total > budget`` means entries were
+    dropped — the graph is INVALID and the caller must retry with the
+    exact totals (both are exact counts, one retry suffices).
+
+    ``precision="mixed"`` runs the rescore arithmetic (bitwise the
+    ``high`` pass — the mode's exactness contract) for every emitted
+    pair: the cached dval must be exact at EVERY config threshold, not
+    just inside the band around ``eps`` that the one-pass banded
+    verdicts certify.
+    """
+    from .precision import norm_precision_mode
+
+    metric = _norm_metric(metric)
+    layout = _norm_layout(layout)
+    prec = norm_precision_mode(precision)
+    if prec == "mixed":
+        prec = "high"
+    nt, pts, msk = _tiles_t(points, mask, block, layout)
+    lo, hi = tile_bounds(pts, msk)
+    rt = nt if row_tiles is None else min(int(row_tiles), nt)
+    if pair_budget is None:
+        pair_budget = default_pair_budget(nt)
+    pair_budget = min(int(pair_budget), nt * nt)
+    rows, cols, tile_total = live_tile_pairs(
+        lo, hi, eps, budget=pair_budget
+    )
+    if budget is None:
+        budget = default_edge_budget(rt * block)
+    budget = int(budget)
+    # The owner-computes row restriction folds into the pair ids:
+    # restricted rows become dump-row padding before the scan.
+    rows = jnp.where(rows < rt, rows, nt)
+    eps_f = jnp.asarray(eps, jnp.float32)
+    iota = jnp.arange(block, dtype=jnp.int32)
+    # Pairs per scan step: one step per pair made the emission
+    # dispatch-bound (measured ~10ms of loop overhead per step on CPU
+    # — 10x the counts pass over the same pairs); batching C pairs
+    # turns the distance work into ONE batched matmul and the
+    # compaction into one cumsum + one scatter per step.  The (C,
+    # block, block) temp is capped ~16MB.
+    chunk = max(1, min(int(rows.shape[0]), (1 << 22) // (block * block)))
+    n_pairs = int(rows.shape[0])
+    nch = -(-n_pairs // chunk)
+    pad = nch * chunk - n_pairs
+    rows = jnp.concatenate([rows, jnp.full(pad, nt, jnp.int32)])
+    cols = jnp.concatenate([cols, jnp.zeros(pad, jnp.int32)])
+    rows = rows.reshape(nch, chunk)
+    cols = cols.reshape(nch, chunk)
+
+    def body(carry, rc):
+        gi_o, gj_o, dv_o, total = carry
+        r, c = rc
+        rr = jnp.minimum(r, nt - 1)
+        cc = jnp.minimum(c, nt - 1)
+        xi, mi = pts[rr], msk[rr]  # (C, d, b), (C, b)
+        yj, mj = pts[cc], msk[cc]
+        if metric == "euclidean":
+            xx = jnp.sum(xi * xi, axis=1)
+            yy = jnp.sum(yj * yj, axis=1)
+            dval = xx[:, :, None] + yy[:, None, :] - 2.0 * (
+                jax.lax.dot_general(
+                    xi, yj, (((1,), (1,)), ((0,), (0,))),
+                    precision=_norm_precision(prec),
+                    preferred_element_type=jnp.float32,
+                )
+            )
+            live = dval <= eps_f * eps_f
+        else:
+            dval = jnp.sum(
+                jnp.abs(xi[:, :, :, None] - yj[:, :, None, :]), axis=1
+            )
+            live = dval <= eps_f
+        # Padding/row-restricted pairs (r == nt) are masked out rather
+        # than branched around — at chunk granularity a cond would
+        # compute everything anyway.
+        live = (
+            live
+            & mi[:, :, None]
+            & mj[:, None, :]
+            & (r < nt)[:, None, None]
+        )
+        ii = (rr * block)[:, None, None] + iota[None, :, None]
+        jj = (cc * block)[:, None, None] + iota[None, None, :]
+        livef = live.reshape(-1)
+        inc = jnp.cumsum(livef.astype(jnp.int32))
+        pos = total + inc - livef
+        # Live entries take fresh slots in scan order; everything else
+        # (non-live, and live entries past the budget) lands on the
+        # dump slot ``budget`` — dropped, signalled via total > budget.
+        tgt = jnp.where(livef, jnp.minimum(pos, budget), budget)
+        gi_o = gi_o.at[tgt].set(
+            jnp.broadcast_to(ii, live.shape).reshape(-1)
+        )
+        gj_o = gj_o.at[tgt].set(
+            jnp.broadcast_to(jj, live.shape).reshape(-1)
+        )
+        dv_o = dv_o.at[tgt].set(
+            jnp.where(livef, dval.reshape(-1), _F32_INF)
+        )
+        return (gi_o, gj_o, dv_o, total + inc[-1]), None
+
+    init = (
+        jnp.zeros(budget + 1, jnp.int32),
+        jnp.zeros(budget + 1, jnp.int32),
+        jnp.full(budget + 1, _F32_INF, jnp.float32),
+        jnp.int32(0),
+    )
+    (gi_o, gj_o, dv_o, total), _ = jax.lax.scan(body, init, (rows, cols))
+    stats = jnp.stack(
+        [
+            total,
+            jnp.int32(budget),
+            tile_total,
+            jnp.int32(pair_budget),
+        ]
+    )
+    return gi_o[:budget], gj_o[:budget], dv_o[:budget], stats
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("block", "layout", "row_tiles", "pair_budget"),
+)
+def _graph_live_pairs(
+    points, mask, eps, *, block, layout, row_tiles, pair_budget,
+):
+    """Shared pair-list half of the emission: the live tile pairs with
+    the owner-computes row restriction folded in (restricted/padding
+    rows == nt)."""
+    nt, pts, msk = _tiles_t(points, mask, block, layout)
+    lo, hi = tile_bounds(pts, msk)
+    rt = nt if row_tiles is None else min(int(row_tiles), nt)
+    pb = (
+        default_pair_budget(nt) if pair_budget is None
+        else int(pair_budget)
+    )
+    pb = min(pb, nt * nt)
+    rows, cols, total = live_tile_pairs(lo, hi, eps, budget=pb)
+    return jnp.where(rows < rt, rows, nt), cols, total, jnp.int32(pb)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("metric", "block", "precision", "layout"),
+)
+def _graph_chunk(
+    points, mask, eps, rows_c, cols_c, *, metric, block, precision,
+    layout,
+):
+    """One chunk of pairs' ``(live, dval)`` tiles — the compute half of
+    the emission, shared by the device-scatter and host-compaction
+    routes (same batched arithmetic, so the stored d2 is identical)."""
+    nt, pts, msk = _tiles_t(points, mask, block, layout)
+    eps_f = jnp.asarray(eps, jnp.float32)
+    rr = jnp.minimum(rows_c, nt - 1)
+    cc = jnp.minimum(cols_c, nt - 1)
+    xi, mi = pts[rr], msk[rr]
+    yj, mj = pts[cc], msk[cc]
+    if metric == "euclidean":
+        xx = jnp.sum(xi * xi, axis=1)
+        yy = jnp.sum(yj * yj, axis=1)
+        dval = xx[:, :, None] + yy[:, None, :] - 2.0 * (
+            jax.lax.dot_general(
+                xi, yj, (((1,), (1,)), ((0,), (0,))),
+                precision=_norm_precision(precision),
+                preferred_element_type=jnp.float32,
+            )
+        )
+        live = dval <= eps_f * eps_f
+    else:
+        dval = jnp.sum(
+            jnp.abs(xi[:, :, :, None] - yj[:, :, None, :]), axis=1
+        )
+        live = dval <= eps_f
+    live = (
+        live
+        & mi[:, :, None]
+        & mj[:, None, :]
+        & (rows_c < nt)[:, None, None]
+    )
+    return live, dval
+
+
+def neighbor_pair_graph_host(
+    points,
+    mask,
+    eps,
+    metric: str = "euclidean",
+    block: int = 1024,
+    precision: str = "high",
+    layout: str = "nd",
+    row_tiles: int | None = None,
+    pair_budget: int | None = None,
+):
+    """Host-compaction twin of :func:`neighbor_pair_graph`.
+
+    Same tile pruning, same batched distance arithmetic (the stored
+    dval is bitwise the device route's), but the stream compaction
+    runs in numpy: each chunk's ``(live, dval)`` tiles come back to
+    the host and ``np.flatnonzero`` extracts the survivors.  On CPU
+    the XLA scatter behind the device route runs single-threaded at
+    ~10x the matmul cost (measured 65x a fit's counts pass at the
+    probe geometry); here the fetch is a zero-copy view and the
+    compaction runs at memory speed.  No edge budget exists — host
+    lists grow to the exact total — so the only overflow contract left
+    is the tile-pair one.  Returns numpy ``(gi, gj, dval, stats)``
+    with the stats row shaped like the device route's (edge budget ==
+    total: never overflows).
+    """
+    from .precision import norm_precision_mode
+
+    metric = _norm_metric(metric)
+    layout = _norm_layout(layout)
+    prec = norm_precision_mode(precision)
+    if prec == "mixed":
+        prec = "high"
+    n = points.shape[0] if layout == "nd" else points.shape[1]
+    nt = n // block
+    rows, cols, tile_total, pb = _graph_live_pairs(
+        points, mask, eps, block=block, layout=layout,
+        row_tiles=row_tiles, pair_budget=pair_budget,
+    )
+    tile_total = int(tile_total)
+    pb = int(pb)
+    if tile_total > pb:
+        # Same exact-retry contract as the device route, handled here
+        # (the caller's ladder never sees a truncated host graph).
+        rows, cols, tile_total2, pb = _graph_live_pairs(
+            points, mask, eps, block=block, layout=layout,
+            row_tiles=row_tiles,
+            pair_budget=int(-(-tile_total // 4096)) * 4096,
+        )
+        tile_total, pb = int(tile_total2), int(pb)
+    rows = np.asarray(rows)
+    cols = np.asarray(cols)
+    keep = rows < nt  # drop padding/row-restricted pairs host-side
+    rows, cols = rows[keep], cols[keep]
+    chunk = max(1, min(max(len(rows), 1), (1 << 22) // (block * block)))
+    out_i, out_j, out_d = [], [], []
+    for s in range(0, len(rows), chunk):
+        rc = rows[s:s + chunk]
+        cc = cols[s:s + chunk]
+        if len(rc) < chunk:  # pad to the compiled chunk shape
+            pad = chunk - len(rc)
+            rc = np.concatenate([rc, np.full(pad, nt, np.int32)])
+            cc = np.concatenate([cc, np.zeros(pad, np.int32)])
+        live, dval = _graph_chunk(
+            points, mask, eps, jnp.asarray(rc), jnp.asarray(cc),
+            metric=metric, block=block, precision=prec, layout=layout,
+        )
+        live = np.asarray(live)
+        dval = np.asarray(dval)
+        p, i, j = np.nonzero(live)
+        out_i.append((rc[p] * block + i).astype(np.int32))
+        out_j.append((cc[p] * block + j).astype(np.int32))
+        out_d.append(dval[p, i, j])
+    gi = (
+        np.concatenate(out_i) if out_i else np.empty(0, np.int32)
+    )
+    gj = (
+        np.concatenate(out_j) if out_j else np.empty(0, np.int32)
+    )
+    dv = (
+        np.concatenate(out_d) if out_d else np.empty(0, np.float32)
+    )
+    stats = np.array([len(gi), len(gi), tile_total, pb], np.int32)
+    return gi, gj, dv, stats

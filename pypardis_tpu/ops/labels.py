@@ -955,6 +955,173 @@ def finish_labels(f, border, core, mask):
     ).astype(jnp.int32)
 
 
+# ---------------------------------------------------------------------------
+# Graph-relabel engine — the per-config half of the amortized sweep.
+#
+# One pair-emission pass (ops.distances.neighbor_pair_graph) caches
+# every (i, j, dval) triple at eps_max; each (eps, min_samples) config
+# then re-thresholds dval for counts and min-propagates labels to a
+# fixpoint over the cached pair list.  The loop mirrors
+# dbscan_fixed_size round for round — same g each round (min over the
+# same adjacency set; integer min/add commute), same pointer jumping,
+# same border attach — so the labels are byte-identical to a full
+# kernel fit at that config.
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("metric", "max_rounds"))
+def graph_dbscan(
+    gi: jnp.ndarray,
+    gj: jnp.ndarray,
+    dval: jnp.ndarray,
+    mask: jnp.ndarray,
+    eps,
+    min_samples,
+    metric: str = "euclidean",
+    max_rounds: int = 64,
+):
+    """DBSCAN relabel over a cached neighbor-pair graph.
+
+    ``gi``/``gj``: (E,) int32 directed edges (each true pair appears
+    once per direction — the emission covers both orders exactly as
+    the tiled column scans do); ``dval``: (E,) f32 threshold values
+    (squared L2 or L1 per ``metric``; ``+inf`` padding is inert at any
+    eps); ``mask``: (n,) validity of the id space the edges index
+    (kernel slots for the fused route, all-true global gids for the
+    sharded routes).  ``eps``/``min_samples`` are traced — one
+    compiled program serves every config of a sweep.
+
+    Returns ``(labels, core, passes)``: per-id component root (min
+    core id, -1 noise — the same root convention as
+    :func:`dbscan_fixed_size` in the same id space), the core mask,
+    and the executed pass count (counts pass + propagation rounds +
+    border recompute, the FLOP-model term).
+    """
+    n = mask.shape[0]
+    eps_f = jnp.asarray(eps, jnp.float32)
+    thr = eps_f * eps_f if metric == "euclidean" else eps_f
+    adj = dval <= thr
+    # Dump row n for row scatters; column reads clip to a valid id
+    # (inert entries carry adj == False, so the value never matters).
+    gi_c = jnp.clip(gi, 0, n)
+    gj_c = jnp.clip(gj, 0, n - 1)
+    counts = jnp.zeros(n + 1, jnp.int32).at[gi_c].add(
+        adj.astype(jnp.int32)
+    )[:n]
+    # Same self-count clamp as dbscan_fixed_size: a valid point is
+    # always within eps of itself, whatever the f32 expansion says.
+    core = (
+        jnp.maximum(counts, 1) >= jnp.asarray(min_samples, jnp.int32)
+    ) & mask
+
+    idx = jnp.arange(n, dtype=jnp.int32)
+    f0 = jnp.where(core, idx, _INT_INF)
+
+    def minlab(f):
+        cand = jnp.where(adj & core[gj_c], f[gj_c], _INT_INF)
+        return jnp.full(n + 1, _INT_INF, jnp.int32).at[gi_c].min(cand)[:n]
+
+    def cond(state):
+        f, g, changed, rounds = state
+        return changed & (rounds < max_rounds)
+
+    def body(state):
+        f, _, _, rounds = state
+        g = minlab(f)
+        f_new = jnp.where(core, jnp.minimum(f, g), f)
+        f_new = _pointer_jump(f_new, core)
+        return f_new, g, jnp.any(f_new != f), rounds + 1
+
+    f, g, changed, rounds = jax.lax.while_loop(
+        cond, body, (f0, f0, jnp.bool_(True), 0)
+    )
+    # Border attach: the carried g IS the pass at convergence;
+    # recompute only on a max_rounds exit (same rule as the kernels).
+    border = jax.lax.cond(changed, lambda: minlab(f), lambda: g)
+    labels = jnp.where(
+        core, f, jnp.where(mask & (border != _INT_INF), border, -1)
+    ).astype(jnp.int32)
+    passes = 1 + rounds + changed.astype(jnp.int32)
+    return labels, core, passes
+
+
+def graph_dbscan_host_prepare(gi, gj, dval):
+    """Sort-once state for repeated host relabels over one graph.
+
+    Row-sorting the edge slab lets every config's per-row reductions
+    (counts, border minima) run as ``np.*.reduceat`` over precomputed
+    segment starts — C-speed streaming passes instead of the
+    single-threaded XLA scatters that dominated the jitted relabel on
+    CPU (measured ~0.75s/config at 3M edges; this path runs the same
+    configs in ~0.1s).
+    """
+    gi = np.asarray(gi, np.int64)
+    order = np.argsort(gi, kind="stable")
+    gi_s = gi[order]
+    gj_s = np.asarray(gj, np.int64)[order]
+    dv_s = np.asarray(dval, np.float32)[order]
+    if len(gi_s):
+        starts = np.concatenate(
+            [[0], np.flatnonzero(np.diff(gi_s)) + 1]
+        ).astype(np.int64)
+        uniq = gi_s[starts]
+    else:
+        starts = np.empty(0, np.int64)
+        uniq = np.empty(0, np.int64)
+    return gi_s, gj_s, dv_s, starts, uniq
+
+
+def graph_dbscan_host(state, mask, eps, min_samples,
+                      metric: str = "euclidean"):
+    """Host twin of :func:`graph_dbscan` (CPU relabel fast path).
+
+    The fixpoint :func:`graph_dbscan` converges to is unique — core
+    status from exact integer counts, each core's label the min core
+    id of its component, borders attached to the min adjacent core
+    root — so computing it directly (scipy connected components over
+    the core-core subgraph + segmented reductions) returns labels
+    BYTE-IDENTICAL to the jitted propagation loop.  Thresholds compare
+    in float32 exactly as the kernels do.  Returns ``(labels, core,
+    passes)`` with ``passes == 1`` (one logical pass over the cached
+    graph).
+    """
+    from scipy.sparse import csr_matrix
+    from scipy.sparse.csgraph import connected_components
+
+    gi_s, gj_s, dv_s, starts, uniq = state
+    mask = np.asarray(mask, bool)
+    n = len(mask)
+    eps_f = np.float32(eps)
+    thr = eps_f * eps_f if metric == "euclidean" else eps_f
+    adj = dv_s <= thr
+    counts = np.zeros(n, np.int64)
+    if len(starts):
+        counts[uniq] = np.add.reduceat(adj, starts)
+    core = (np.maximum(counts, 1) >= int(min_samples)) & mask
+
+    sel = adj & core[gi_s] & core[gj_s]
+    r, c = gi_s[sel], gj_s[sel]
+    graph = csr_matrix(
+        (np.ones(len(r), np.int8), (r, c)), shape=(n, n)
+    )
+    ncomp, comp = connected_components(graph, directed=False)
+    root_of_comp = np.full(max(ncomp, 1), n, np.int64)
+    core_ids = np.flatnonzero(core)
+    np.minimum.at(root_of_comp, comp[core_ids], core_ids)
+    f = np.where(core, root_of_comp[comp], np.int64(_INT_INF))
+
+    border = np.full(n, np.int64(_INT_INF))
+    if len(starts):
+        cand = np.where(
+            adj & core[gj_s], f[gj_s], np.int64(_INT_INF)
+        )
+        border[uniq] = np.minimum.reduceat(cand, starts)
+    labels = np.where(
+        core, f, np.where(mask & (border != _INT_INF), border, -1)
+    ).astype(np.int32)
+    return labels, core, 1
+
+
 def densify_labels(root_labels: np.ndarray) -> np.ndarray:
     """Host-side: map root-index labels to dense 0..C-1 ids, noise -> -1.
 

@@ -471,6 +471,28 @@ def effective_tile(block: int, n: int, d: int, mode: str = "high"):
     return None
 
 
+def graph_emission_tile(
+    block: int, n: int, d: int, precision: str = "high"
+) -> int:
+    """The tile the sweep's pair-emission pass should run on.
+
+    The emission pass (:func:`pypardis_tpu.ops.distances
+    .neighbor_pair_graph`) enumerates the SAME live tile pairs the
+    kernels dispatch over; running it on the Pallas kernels' effective
+    tile keeps the two grids — and therefore their pair budgets /
+    hints — aligned on TPU, exactly the discipline the dense-dispatch
+    ``count_live_tile_pairs`` follows.  Tile choice never changes which
+    (i, j) pairs survive (the eps threshold is per pair; tiles only set
+    pruning granularity), so off-TPU callers may pass any divisor —
+    this helper just picks the grid-consistent one when a Mosaic tile
+    exists.
+    """
+    return (
+        effective_tile(block, n, d, _norm_precision_mode(precision))
+        or min(block, n)
+    )
+
+
 def _shape_nd(points, layout):
     if layout not in ("nd", "dn"):
         raise ValueError(f"layout must be 'nd' or 'dn', got {layout!r}")

@@ -808,3 +808,139 @@ def dbscan_device_pipeline(
 
     with obs_span("pipeline.cluster", mode="fused"):
         return _transient_retry("cluster", run_cluster)
+
+
+# ---------------------------------------------------------------------------
+# Amortized-sweep pipeline: ONE layout + ONE pair-emission pass at
+# eps_max, then one packed relabel program per (eps, min_samples)
+# config over the cached kernel-space graph.  The graph lives in
+# KERNEL-slot space so each config's roots map back through the same
+# ``owner`` permutation the fused fit uses (_pipeline_pack) — labels
+# byte-identical to an independent dbscan_device_pipeline run at that
+# config, Morton-first cluster numbering included.
+# ---------------------------------------------------------------------------
+
+
+def sweep_graph_pipeline(
+    points_t,
+    eps,
+    n,
+    metric: str = "euclidean",
+    block: int = 1024,
+    precision: str = "high",
+    backend: str = "auto",
+    sort: bool = True,
+    layout_key=None,
+    edge_budget: int | None = None,
+    pair_budget: int | None = None,
+):
+    """Layout + neighbor-pair graph extraction for a parameter sweep.
+
+    ``points_t``/``n``/``sort``/``layout_key`` as in
+    :func:`dbscan_device_pipeline` (the layout products are shared
+    through the same ``pipeline_layout`` staging route, so a sweep
+    after a fit at the same eps ceiling re-stages nothing); ``eps`` is
+    the sweep's eps_max.  Returns ``((gi, gj, dval), mask_k, owner,
+    cap, stats)`` with the graph as device-resident kernel-space
+    slabs and ``stats`` the host (4,) int32 ``[edge_total,
+    edge_budget, tile_total, tile_budget]`` — the caller owns the
+    exact-total retry ladder (either overflow invalidates the graph).
+    """
+    from ..obs import span as obs_span
+    from .distances import neighbor_pair_graph
+    from .labels import resolve_backend
+    from .pallas_kernels import graph_emission_tile
+
+    cached = None
+    if layout_key is not None:
+        from ..parallel import staging as _staging
+
+        cached = _staging.device_get("pipeline_layout", layout_key)
+    if cached is not None:
+        (xs, mask_k, owner), aux = cached
+        cap = int(aux["cap"])
+    else:
+        if callable(points_t):
+            points_t = points_t()
+        cap = points_t.shape[1]
+
+        def run_layout():
+            return _pipeline_layout(
+                points_t, eps, n, block=block, sort=sort,
+                precision=precision,
+            )
+
+        with obs_span("sweep.layout", sort=bool(sort)):
+            xs, mask_k, owner = _transient_retry("layout", run_layout)
+        if layout_key is not None:
+            from ..parallel import staging as _staging
+
+            _staging.device_put_cached(
+                "pipeline_layout", layout_key, (xs, mask_k, owner),
+                aux={"cap": cap},
+            )
+    capk = xs.shape[1]
+    d = xs.shape[0]
+    # Emission on the kernels' own grid: the Pallas effective tile on
+    # TPU (keeps tile-pair budgets/hints aligned with the Mosaic
+    # kernels), the XLA kernels' block elsewhere.  Tile choice never
+    # changes which pairs survive — only pruning granularity.
+    kind = resolve_backend(backend, metric, capk, block, d, precision)
+    tile = (
+        graph_emission_tile(block, capk, d, precision)
+        if kind == "pallas"
+        else min(block, capk)
+    )
+
+    def run_extract():
+        if jax.default_backend() == "cpu":
+            # Host-compaction emission (see distances
+            # .neighbor_pair_graph_host): same device arithmetic,
+            # numpy stream compaction — the CPU XLA scatter behind the
+            # device route is single-threaded and dominated the sweep.
+            from .distances import neighbor_pair_graph_host
+
+            gi, gj, dval, st = neighbor_pair_graph_host(
+                xs, mask_k, eps, metric=metric, block=tile,
+                precision=precision, layout="dn",
+                pair_budget=pair_budget,
+            )
+            return (
+                (jnp.asarray(gi), jnp.asarray(gj), jnp.asarray(dval)),
+                np.asarray(st),
+            )
+        gi, gj, dval, st = neighbor_pair_graph(
+            xs, mask_k, eps, metric=metric, block=tile,
+            precision=precision, layout="dn", budget=edge_budget,
+            pair_budget=pair_budget,
+        )
+        # The tiny stats fetch is the execution sync inside the retry
+        # scope; the bulk graph stays device-resident for the configs.
+        return (gi, gj, dval), np.asarray(st)
+
+    with obs_span("sweep.extract"):
+        graph, stats = _transient_retry("sweep_extract", run_extract)
+    return graph, mask_k, owner, cap, stats
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cap", "metric", "max_rounds")
+)
+def sweep_config_pack(
+    gi, gj, dval, mask_k, owner, eps, min_samples, edge_stats, *,
+    cap, metric: str = "euclidean", max_rounds: int = 64,
+):
+    """One sweep config's relabel over the cached kernel-space graph,
+    packed in the pipeline's single-transfer wire format (decode via
+    :func:`unpack_pipeline_result`).  ``eps``/``min_samples`` are
+    traced, so every config of a sweep shares one compiled program."""
+    from .labels import graph_dbscan
+
+    labels, core, passes = graph_dbscan(
+        gi, gj, dval, mask_k, eps, min_samples, metric=metric,
+        max_rounds=max_rounds,
+    )
+    pair_stats = jnp.concatenate(
+        [edge_stats[:2], passes[None], jnp.zeros(2, jnp.int32)]
+    )
+    return _pipeline_pack(labels, core, pair_stats, owner, cap=cap)

@@ -2011,7 +2011,7 @@ def global_morton_dbscan(
                     "merge_host", mode="global_morton",
                     error=str(e)[:160],
                 )
-                staging.give_back(host_bufs)
+                staging.give_back_after_put(host_bufs)
                 return global_morton_dbscan(
                     points, eps=eps, min_samples=min_samples,
                     metric=metric, block=block, mesh=mesh,
@@ -2061,5 +2061,136 @@ def global_morton_dbscan(
     # here ranges are equal and padding is already pad_waste).
     stats["duplicated_work_factor"] = 1.0
     stats["owner_computes"] = True
-    staging.give_back(host_bufs)
+    staging.give_back_after_put(host_bufs)
     return _canonicalize_roots(labels, core), core, stats
+
+
+def sweep_graph_global_morton(
+    points,
+    eps,
+    *,
+    block: int = 1024,
+    mesh: Optional[Mesh] = None,
+    precision: str = "high",
+    backend: str = "auto",
+    metric: str = "euclidean",
+    btcap: Optional[int] = None,
+    edge_budget: Optional[int] = None,
+    pair_budget: Optional[int] = None,
+    cap_edges: Optional[int] = None,
+):
+    """ONE distance pass at ``eps`` (the sweep's eps_max) over the
+    global-Morton shards → the GLOBAL neighbor-pair graph.
+
+    Rides the real GM machinery: the range build reuses the eps-free
+    ``gm_owned`` staging route (a sweep after a fit re-stages nothing)
+    and the boundary tiles ride the morton ring at eps_max
+    (:func:`_gm_boundary_tiles`, route ``gm_boundary``) — selected at
+    the sweep ceiling, so every smaller config's reach set is covered
+    by construction (a tile within eps_c of a shard's rows is within
+    eps_max of them).  Owned rows emit, boundary slots are column
+    evidence only: zero duplicated rows, each directed edge emitted
+    exactly once by its owner.
+
+    Returns ``((gi, gj, dval) numpy arrays in global-id space,
+    stats)`` with the GM telemetry contract fields
+    (``halo_exchange="morton_ring"``, boundary-tile gauges,
+    ``duplicated_work_factor == 1.0``).
+    """
+    from ..ops.distances import sweep_max_edges
+    from .sharded import _sweep_slab_graph
+
+    points = np.asarray(points)
+    n, k = points.shape
+    if mesh is None:
+        from .mesh import default_mesh
+
+        mesh = default_mesh()
+    n_shards = mesh.devices.size
+    axis = mesh.axis_names[0]
+    sharding = NamedSharding(mesh, P(axis))
+    block = clamp_block(block, -(-n // max(n_shards, 1)))
+    if cap_edges is None:
+        cap_edges = sweep_max_edges()
+    with obs_span("sweep.build", mode="global_morton"):
+        arrays, bstats, host_bufs, base = build_morton_shards(
+            points, n_shards, block, sharding, eps=eps
+        )
+    owned, omsk, ogid = arrays
+    cap = int(bstats["owned_cap"])
+    if n_shards > 1:
+        with obs_span("sweep.exchange", mode="global_morton"):
+            (bnd, bmsk, bgid), xstats = _gm_boundary_tiles(
+                arrays, eps, mesh=mesh, axis=axis, block=block,
+                btcap=btcap, base=base,
+            )
+        brows = int(bnd.shape[1])
+        if brows % block:
+            raise AssertionError(
+                f"boundary rows {brows} not a multiple of block {block}"
+            )
+    else:
+        bnd = bmsk = bgid = None
+        brows = 0
+        xstats = {
+            "boundary_tiles": 0, "boundary_rows": 0,
+            "boundary_tile_bytes": 0, "ring_rounds": 0,
+        }
+    out_i, out_j, out_d = [], [], []
+    eb, pb = edge_budget, pair_budget
+    # One host gather per slab family — per-shard indexing of the
+    # mesh-sharded arrays would dispatch a collective program per
+    # slice (see sweep_graph_sharded).
+    owned_h, omsk_h, ogid_h = (np.asarray(a) for a in arrays)
+    if brows:
+        bnd_h, bmsk_h, bgid_h = (
+            np.asarray(bnd), np.asarray(bmsk), np.asarray(bgid)
+        )
+    with obs_span("sweep.extract", mode="global_morton",
+                  shards=int(n_shards)):
+        for s in range(n_shards):
+            if brows:
+                pts = np.concatenate([owned_h[s], bnd_h[s]], axis=0)
+                msk = np.concatenate([omsk_h[s], bmsk_h[s]])
+                gids = np.concatenate([ogid_h[s], bgid_h[s]])
+            else:
+                pts, msk = owned_h[s], omsk_h[s]
+                gids = ogid_h[s]
+            gi, gj, dv, eb, pb = _sweep_slab_graph(
+                pts, msk, gids, eps, owned_rows=cap, metric=metric,
+                block=block, precision=precision, edge_budget=eb,
+                pair_budget=pb, cap_edges=cap_edges,
+            )
+            out_i.append(gi)
+            out_j.append(gj)
+            out_d.append(dv)
+    staging.give_back_after_put(host_bufs)
+    gi = np.concatenate(out_i) if out_i else np.empty(0, np.int32)
+    gj = np.concatenate(out_j) if out_j else np.empty(0, np.int32)
+    dv = np.concatenate(out_d) if out_d else np.empty(0, np.float32)
+    stats = {
+        "mode": "global_morton",
+        "halo_exchange": "morton_ring",
+        "owner_computes": True,
+        "duplicated_work_factor": 1.0,
+        "graph_pairs": int(len(gi)),
+        "graph_bytes": int(len(gi)) * 12,
+        "n_partitions": int(n_shards),
+        **{
+            k_: bstats[k_]
+            for k_ in (
+                "owned_cap", "pad_waste", "partition_sizes",
+                "n_shard_partitions",
+            )
+            if k_ in bstats
+        },
+        **{
+            k_: xstats[k_]
+            for k_ in (
+                "boundary_tiles", "boundary_rows",
+                "boundary_tile_bytes", "ring_rounds",
+            )
+            if k_ in xstats
+        },
+    }
+    return (gi, gj, dv), stats

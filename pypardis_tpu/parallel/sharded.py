@@ -1086,7 +1086,7 @@ def _chained_tables_overlap(
             "chained_halo", base_key + (float(eps),),
             tuple(a for triple in built_halo for a in triple),
         )
-    staging.give_back(host_bufs)
+    staging.give_back_after_put(host_bufs)
     overlap_eff = busy / wall if wall > 0 else 0.0
     from ..utils.log import log_phase
 
@@ -2118,7 +2118,7 @@ def sharded_dbscan(
             except Exception as e:  # noqa: BLE001 — rethrown below
                 if merge != "device" or not is_degradable_error(e):
                     raise
-                staging.give_back(host_bufs)
+                staging.give_back_after_put(host_bufs)
                 return _spill_to_host_merge(e)
         if merge == "host":
             tables, _zero, used_hcap = out
@@ -2133,7 +2133,7 @@ def sharded_dbscan(
             )
             _exec_stats(stats, oc_on=oc_on, pstats=pstats, block=block,
                         k=k, precision=precision, n=n)
-            staging.give_back(host_bufs)
+            staging.give_back_after_put(host_bufs)
             return _canonicalize_roots(labels, core), core, stats
         labels, core, m_rounds, used_hcap = out
         stats = dict(
@@ -2144,7 +2144,7 @@ def sharded_dbscan(
         labels, core = np.asarray(labels), np.asarray(core)
         _exec_stats(stats, oc_on=oc_on, pstats=pstats, block=block,
                     k=k, precision=precision, n=n)
-        staging.give_back(host_bufs)
+        staging.give_back_after_put(host_bufs)
         return _canonicalize_roots(labels, core), core, stats
     if (
         mesh.devices.size == 1
@@ -2238,7 +2238,7 @@ def sharded_dbscan(
         stats = dict(stats, merge="host")
         _exec_stats(stats, oc_on=oc_on, pstats=pstats, block=block,
                     k=k, precision=precision, n=n)
-        staging.give_back(host_bufs)
+        staging.give_back_after_put(host_bufs)
         return _canonicalize_roots(labels, core), core, stats
 
     def run_step(pb, mr):
@@ -2274,7 +2274,7 @@ def sharded_dbscan(
         except Exception as e:  # noqa: BLE001 — rethrown below
             if not is_degradable_error(e):
                 raise
-            staging.give_back(host_bufs)
+            staging.give_back_after_put(host_bufs)
             return _spill_to_host_merge(e)
     stats = dict(
         stats, merge="device", merge_rounds=int(m_rounds),
@@ -2283,7 +2283,7 @@ def sharded_dbscan(
     labels, core = np.asarray(labels), np.asarray(core)
     _exec_stats(stats, oc_on=oc_on, pstats=pstats, block=block,
                 k=k, precision=precision, n=n)
-    staging.give_back(host_bufs)
+    staging.give_back_after_put(host_bufs)
     return _canonicalize_roots(labels, core), core, stats
 
 
@@ -2602,6 +2602,197 @@ def sharded_dbscan_device(
     _exec_stats(stats, oc_on=oc_on, pstats=pstats, block=block,
                 k=k, precision=precision, n=n)
     return _canonicalize_roots(labels, core), core, stats, part, pid
+
+
+class SweepGraphOverflow(RuntimeError):
+    """The neighbor-pair graph cannot fit the sweep's edge cap.
+
+    A partial graph would silently miss cross-shard edges, so the
+    drivers never relabel from one — ``DBSCAN.sweep`` catches this and
+    degrades label-safely to per-config refits (k distance passes, the
+    pre-sweep cost, never wrong labels)."""
+
+
+def _sweep_slab_graph(
+    pts, msk, gids, eps, *, owned_rows, metric, block, precision,
+    edge_budget, pair_budget, cap_edges,
+):
+    """One shard slab's directed edges at ``eps``, in global-id space.
+
+    ``pts``/``msk``: the (rows, k) slab (owned prefix + halo/boundary
+    context); ``gids``: slab slot -> global point id (pad slots carry
+    an arbitrary id — their entries are masked out of the emission).
+    Runs the exact-total budget ladder (the PYPARDIS_PAIR_BUDGET
+    conventions: overflow is signalled exactly, one retry suffices)
+    and raises :class:`SweepGraphOverflow` past ``cap_edges``.
+    Returns ``(gi, gj, dval, edge_budget, pair_budget)`` with the
+    grown budgets so later shards start where this one ended.
+    """
+    from ..ops.distances import (
+        default_edge_budget,
+        neighbor_pair_graph,
+        neighbor_pair_graph_host,
+    )
+
+    rt = owned_rows // block
+    if jax.default_backend() == "cpu":
+        # Host-compaction route: the XLA scatter behind the device
+        # emission runs single-threaded on CPU (measured 65x a counts
+        # pass); numpy compaction of the same device-computed tiles is
+        # memory-speed and budget-free.
+        gi, gj, dv, st = neighbor_pair_graph_host(
+            pts, msk, eps, metric=metric, block=block,
+            precision=precision, layout="nd", row_tiles=rt,
+            pair_budget=pair_budget,
+        )
+        if len(gi) > cap_edges:
+            raise SweepGraphOverflow(
+                f"neighbor-pair graph needs {len(gi)} edges on one "
+                f"shard but the sweep cap is {cap_edges} "
+                f"(PYPARDIS_SWEEP_MAX_PAIRS); the sweep degrades to "
+                f"per-config refits"
+            )
+        gids = np.asarray(gids)
+        return gids[gi], gids[gj], dv, edge_budget, int(st[3])
+    eb = int(edge_budget or default_edge_budget(owned_rows))
+    pb = pair_budget
+    for attempt in (0, 1):
+        gi, gj, dv, st = neighbor_pair_graph(
+            pts, msk, eps, metric=metric, block=block,
+            precision=precision, layout="nd", row_tiles=rt,
+            budget=eb, pair_budget=pb,
+        )
+        st = np.asarray(st)
+        need_e, got_e = int(st[0]), int(st[1])
+        need_p, got_p = int(st[2]), int(st[3])
+        if need_e <= got_e and need_p <= got_p:
+            break
+        if need_e > cap_edges:
+            raise SweepGraphOverflow(
+                f"neighbor-pair graph needs {need_e} edges on one shard "
+                f"but the sweep cap is {cap_edges} "
+                f"(PYPARDIS_SWEEP_MAX_PAIRS); the sweep degrades to "
+                f"per-config refits"
+            )
+        if attempt == 1:
+            raise SweepGraphOverflow(
+                f"graph emission overflow persisted after an exact-"
+                f"total retry (edges {need_e}/{got_e}, tile pairs "
+                f"{need_p}/{got_p})"
+            )
+        obs_event(
+            "pair_overflow", total=need_e, budget=got_e,
+            route="sweep_graph",
+        )
+        eb = round_up(max(need_e, 1), 4096)
+        if need_p > got_p:
+            pb = round_up(max(need_p, 1), 4096)
+    dv_np = np.asarray(dv)
+    sel = np.isfinite(dv_np)
+    gids = np.asarray(gids)
+    return (
+        gids[np.asarray(gi)[sel]],
+        gids[np.asarray(gj)[sel]],
+        dv_np[sel],
+        eb,
+        pb,
+    )
+
+
+def sweep_graph_sharded(
+    points,
+    partitioner,
+    eps,
+    *,
+    block: int = 1024,
+    mesh=None,
+    precision: str = "high",
+    backend: str = "auto",
+    metric: str = "euclidean",
+    edge_budget: Optional[int] = None,
+    pair_budget: Optional[int] = None,
+    cap_edges: Optional[int] = None,
+):
+    """ONE distance pass at ``eps`` (the sweep's eps_max) over the KD
+    owner-computes slabs → the GLOBAL neighbor-pair graph.
+
+    The slab build rides the staging economy exactly like a fit
+    (:func:`_host_build_cached`: owned slabs keyed WITHOUT eps, so a
+    sweep after a fit — or a second sweep — re-ships only halos), and
+    the 2*eps_max halo guarantees every true edge of every config
+    ``eps_c <= eps_max`` is present: a neighbor within eps_c of an
+    owned point sits inside the eps_max expansion by containment.
+    Each directed edge is emitted exactly once, by its row's owner
+    (owner-computes: halo slots are column evidence, never rows), so
+    per-config counts over the graph are byte-identical to the
+    owner-computes counts pass.
+
+    Returns ``((gi, gj, dval) numpy arrays in global-id space,
+    stats)``; the per-config relabel over this graph converges to the
+    min-core-gid roots — the same canonical labels
+    (:func:`_canonicalize_roots`) every sharded train() route emits.
+    """
+    from ..ops.distances import sweep_max_edges
+
+    points = np.asarray(points)
+    n, k = points.shape
+    if mesh is None:
+        from .mesh import default_mesh
+
+        mesh = default_mesh()
+    n_shards = mesh.devices.size
+    axis = mesh.axis_names[0]
+    sharding = NamedSharding(mesh, P(axis))
+    if cap_edges is None:
+        cap_edges = sweep_max_edges()
+    with obs_span("sweep.build", mode="kd"):
+        arrays, bstats, bufs = _host_build_cached(
+            points, partitioner, eps, n_shards, block, sharding
+        )
+    owned, omsk, ogid, halo, hmsk, hgid = arrays
+    p_total, cap, _k = owned.shape
+    # ONE host gather of the slabs: per-shard indexing of the
+    # mesh-sharded arrays dispatches cross-device collective programs
+    # per slice (measured seconds each on the faked CPU mesh); the
+    # emission pass runs per shard on the default device anyway, so
+    # feeding it host slices keeps the loop collective-free.
+    slabs = [np.asarray(a) for a in arrays]
+    owned_h, omsk_h, ogid_h, halo_h, hmsk_h, hgid_h = slabs
+    out_i, out_j, out_d = [], [], []
+    eb, pb = edge_budget, pair_budget
+    with obs_span("sweep.extract", mode="kd", shards=int(p_total)):
+        for p in range(p_total):
+            pts = np.concatenate([owned_h[p], halo_h[p]], axis=0)
+            msk = np.concatenate([omsk_h[p], hmsk_h[p]])
+            gids = np.concatenate([ogid_h[p], hgid_h[p]])
+            gi, gj, dv, eb, pb = _sweep_slab_graph(
+                pts, msk, gids, eps, owned_rows=cap, metric=metric,
+                block=min(block, cap), precision=precision,
+                edge_budget=eb, pair_budget=pb, cap_edges=cap_edges,
+            )
+            out_i.append(gi)
+            out_j.append(gj)
+            out_d.append(dv)
+    staging.give_back_after_put(bufs)
+    gi = np.concatenate(out_i) if out_i else np.empty(0, np.int32)
+    gj = np.concatenate(out_j) if out_j else np.empty(0, np.int32)
+    dv = np.concatenate(out_d) if out_d else np.empty(0, np.float32)
+    stats = {
+        "mode": "kd",
+        "owner_computes": True,
+        "graph_pairs": int(len(gi)),
+        "graph_bytes": int(len(gi)) * 12,
+        "n_partitions": int(p_total),
+        **{
+            k_: bstats[k_]
+            for k_ in (
+                "owned_cap", "halo_cap", "halo_factor", "halo_bytes",
+                "pad_waste", "partition_sizes", "n_shard_partitions",
+            )
+            if k_ in bstats
+        },
+    }
+    return (gi, gj, dv), stats
 
 
 def _canonicalize_roots(labels: np.ndarray, core: np.ndarray) -> np.ndarray:

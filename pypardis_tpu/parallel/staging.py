@@ -135,9 +135,40 @@ def borrow(shape, dtype) -> np.ndarray:
 
 def give_back(bufs) -> None:
     """Return borrowed buffers to the pool (call only after the device
-    transfer is known consumed — e.g. once results materialized)."""
+    transfer is known consumed — e.g. once results materialized).
+
+    NOT for buffers whose device arrays ride the cross-fit device
+    cache — use :func:`give_back_after_put` for those (see its
+    aliasing contract)."""
     for buf in bufs:
         _host_pool[(buf.shape, buf.dtype.str)] = buf
+
+
+def _put_aliases_host() -> bool:
+    """Whether ``jax.device_put`` of an aligned numpy buffer may be a
+    ZERO-COPY view on this backend (CPU), rather than a real transfer
+    into device memory (TPU/GPU)."""
+    import jax
+
+    return jax.default_backend() == "cpu"
+
+
+def give_back_after_put(bufs) -> None:
+    """Return build buffers whose ``device_put`` products are CACHED
+    across fits (the owned/halo/boundary slab routes).
+
+    On CPU, XLA zero-copies aligned numpy buffers, so pooling them
+    would let a later ``borrow`` of the same (shape, dtype) overwrite
+    memory a cached slab still aliases — observed as corrupted owned
+    slabs on the second eps of a sweep (the fit(eps1)→fit(eps2)
+    staging-reuse path returned wrong labels).  There the buffers are
+    simply dropped; the pin/registration economy pooling funds only
+    exists on tunneled TPU runtimes, where device_put really copies.
+    Per-batch buffers whose device products are consumed before reuse
+    (the serving query slabs) keep the plain :func:`give_back`.
+    """
+    if not _put_aliases_host():
+        give_back(bufs)
 
 
 def device_get(route: str, key) -> Optional[tuple]:
@@ -218,6 +249,55 @@ def device_put_cached(route: str, key, arrays: tuple, aux=None) -> tuple:
     _device_cache[route] = (key, arrays, dict(aux or {}), nbytes)
     flight_note("staging.device_put", route=route, nbytes=int(nbytes))
     return arrays
+
+
+# ---------------------------------------------------------------------------
+# Sweep-graph route: the cached neighbor-pair slab behind DBSCAN.sweep.
+#
+# The graph extracted at eps_max serves EVERY config with eps <=
+# eps_max (re-thresholding cached dval is exact), so the route's key is
+# eps-FREE — data/mode/grid only — and the eps_max the entry was built
+# at rides in its aux.  A later sweep whose eps ceiling fits under the
+# cached one reuses the slab outright; per-config relabels inside one
+# sweep count their reuse through touch_route so configs 2..k report
+# ``staged_bytes_reused > 0`` like any warm staging hit.
+# ---------------------------------------------------------------------------
+
+SWEEP_GRAPH_ROUTE = "sweep_graph"
+
+
+def device_get_cover(route: str, key, eps_needed: float):
+    """``(arrays, aux)`` when ``route`` holds an entry for the eps-free
+    ``key`` whose recorded ``aux["eps_max"]`` covers ``eps_needed``
+    (>=, exact f32 compare is fine — equal sweeps re-key identically).
+    A key match with an insufficient ceiling evicts (the rebuild at the
+    larger eps_max replaces it); a key miss evicts as usual."""
+    entry = _device_cache.get(route)
+    if entry is None:
+        return None
+    ekey, arrays, aux, nbytes = entry
+    if ekey != key or float(aux.get("eps_max", -1.0)) < float(eps_needed):
+        del _device_cache[route]
+        flight_note("staging.evict", route=route, reason="key_miss")
+        return None
+    _fit_stats["reused"] += nbytes
+    flight_note("staging.reuse", route=route, nbytes=int(nbytes))
+    return arrays, dict(aux)
+
+
+def touch_route(route: str) -> int:
+    """Count one logical reuse of ``route``'s resident entry (bytes
+    added to the fit's reused counter) WITHOUT re-fetching it — the
+    per-config accounting of a sweep, where configs 2..k re-threshold
+    the device-resident graph the first config staged.  Returns the
+    bytes credited (0 when the route is empty)."""
+    entry = _device_cache.get(route)
+    if entry is None:
+        return 0
+    nbytes = int(entry[3])
+    _fit_stats["reused"] += nbytes
+    flight_note("staging.reuse", route=route, nbytes=nbytes)
+    return nbytes
 
 
 def _evict_all_device(error) -> None:

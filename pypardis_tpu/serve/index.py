@@ -98,6 +98,10 @@ class CorePointIndex:
         self.qblock = int(qblock)
         self.n_core = int(n_core)
         self.stats: Dict = dict(stats or {})
+        # Cosine-model frame flag (set by build_index / load_index):
+        # queries unit-normalize before centering, so the L2 kernels
+        # answer the cosine-threshold question exactly.
+        self.unit_norm = False
         self._margin = self.eps * _MARGIN_SLACK
         self._dev = None
         # Live-update state (the serve_index_delta path): monotone
@@ -657,7 +661,8 @@ class CorePointIndex:
         from ..parallel import staging
 
         for attr in ("center", "tree", "coords", "labels", "blo", "bhi",
-                     "block", "qblock", "n_core", "leaf_slabs", "gids"):
+                     "block", "qblock", "n_core", "leaf_slabs", "gids",
+                     "unit_norm"):
             setattr(self, attr, getattr(fresh, attr))
         self.src_index = getattr(fresh, "src_index", None)
         self.stats = dict(fresh.stats)
@@ -677,9 +682,20 @@ class CorePointIndex:
 
     def prepare_queries(self, X) -> np.ndarray:
         """Validated, centered float32 queries (the serving dtype both
-        the kernels and the oracle consume)."""
+        the kernels and the oracle consume).  A cosine-frame index
+        (``unit_norm``) projects queries onto the unit sphere first —
+        the same normalization the fit applied to the core set."""
         X = check_query_points(X, self.d)
-        return (X.astype(np.float64) - self.center).astype(np.float32)
+        X = X.astype(np.float64)
+        if self.unit_norm:
+            nrm = np.sqrt(np.einsum("ij,ij->i", X, X))
+            if not nrm.all():
+                raise ValueError(
+                    "metric='cosine' is undefined for zero vectors: "
+                    "query row(s) with zero norm"
+                )
+            X = X / nrm[:, None]
+        return (X - self.center).astype(np.float32)
 
     def route(self, qf32: np.ndarray):
         """[(slab, query indices)] in ascending slab order — each query
@@ -818,10 +834,24 @@ def build_index(
     model, *, leaves=None, block: int = 256, qblock: int = 128,
     seed: int = 0,
 ):
-    """Serving index of a fitted (or checkpoint-loaded) ``DBSCAN``."""
+    """Serving index of a fitted (or checkpoint-loaded) ``DBSCAN``.
+
+    A ``metric='cosine'`` model indexes in its unit-sphere kernel
+    frame: the core coordinates are already normalized (the model's
+    ``data`` frame), the index eps is the remapped L2 threshold
+    (``model.kernel_eps``), and ``unit_norm`` makes
+    :meth:`CorePointIndex.prepare_queries` project queries onto the
+    sphere too — so ``predict`` and the bitwise oracle both answer the
+    cosine question exactly through the unchanged L2 kernels.
+    """
     model._require_fitted()
     cores, labels = _model_core_set(model)
-    return CorePointIndex.build(
-        cores, labels, model.eps, leaves=leaves, block=block,
+    eps = float(getattr(model, "kernel_eps", model.eps))
+    idx = CorePointIndex.build(
+        cores, labels, eps, leaves=leaves, block=block,
         qblock=qblock, seed=seed,
     )
+    idx.unit_norm = (
+        getattr(model, "_metric_norm", None) == "cosine"
+    )
+    return idx

@@ -37,7 +37,11 @@ first-class workload instead of an interactive convenience:
 The lineage is the LSM-tree (O'Neil, Cheng, Gauthier & O'Neil 1996 —
 see PAPERS.md): absorb writes in cheap append-structured deltas, pay
 the re-organization in a background merge, serve reads continuously
-from the freshest generation.
+from the freshest generation.  At-scale deployments should point the
+merge at the fastest engine: ``Compactor(fit_kw={"mode":
+"global_morton"})`` runs the background refit on the zero-duplication
+global-Morton route (the measured 10M+ default; labels byte-identical
+to every other mode).
 
 Fault injection sites (``PYPARDIS_FAULTS``): ``ingest.batch`` fires at
 the head of every batched write — before any state mutates, so an
@@ -264,7 +268,13 @@ class Compactor:
     harness adopt :attr:`lock`).  ``fit_kw`` overrides the refit's
     DBSCAN construction (``mode``/``merge``/``mesh``/...); by default
     the refit runs the fused single-device engine with the live
-    model's eps/min_samples/block/precision.
+    model's eps/min_samples/block/precision — right for CI-scale
+    indexes.  **At scale (10M+ points) pass
+    ``fit_kw={"mode": "global_morton"}``**: the zero-duplication
+    global-Morton engine is the measured at-scale default for full
+    refits (streaming build, boundary tiles instead of halo slabs,
+    byte-identical labels), so the background compaction re-clusters
+    at the same speed a fresh fit would.
     """
 
     PHASES = ("snapshot", "refit", "build", "swap")

@@ -65,6 +65,37 @@ def check_kernel_backend(backend) -> str:
     return b
 
 
+def check_metric(metric, eps=None) -> str:
+    """Normalize/validate a DBSCAN metric spec at construction time.
+
+    Accepts the kernel metrics (euclidean/cityblock spellings and
+    scipy callables, via the kernels' own normalizer) plus
+    ``"cosine"``/``"angular"`` — a DRIVER metric: the fit path
+    unit-normalizes rows and remaps eps onto the L2 kernels (on the
+    unit sphere ``d^2 = 2 - 2 cos(theta)``), so the kernels never see
+    it.  For cosine, ``eps`` thresholds the cosine distance ``1 -
+    cos`` and must lie in (0, 2] — a threshold past 2 would accept
+    antipodal pairs of every orientation, which is always a spec bug.
+    """
+    name = metric
+    if callable(metric):
+        name = getattr(metric, "__name__", str(metric))
+    name = str(name).lower()
+    if name in ("cosine", "angular"):
+        if eps is not None and isinstance(
+            eps, (int, float, np.floating)
+        ) and np.isfinite(eps) and not 0 < eps <= 2:
+            raise ValueError(
+                f"metric='cosine' thresholds the cosine distance "
+                f"1 - cos(theta), which lies in [0, 2]; eps must be in "
+                f"(0, 2], got {eps}"
+            )
+        return "cosine"
+    from ..ops.distances import _norm_metric
+
+    return _norm_metric(metric)
+
+
 def validate_params(eps, min_samples) -> None:
     """Raise ValueError on an invalid concrete (eps, min_samples).
 

@@ -441,6 +441,62 @@ def main() -> None:
         if tel["sharding"].get("mode") != "global_morton":
             fail("northstar row did not run the global-Morton engine")
 
+    # Amortized-sweep contract (ISSUE 13): a sweep row must prove the
+    # one-distance-pass claim (distance_passes == 1 on a non-degraded
+    # row), carry a real graph, state per-config exactness (labels
+    # byte-identical + ARI == 1.0 vs solo fits), and — like every
+    # other row — the honest owner_computes / dispatch-tag fields.
+    if str(row["metric"]).startswith("sweep"):
+        if row.get("schema") != "pypardis_tpu/sweep@1":
+            fail(f"sweep row schema is {row.get('schema')!r}")
+        k = row.get("k")
+        if not isinstance(k, int) or k < 2:
+            fail(f"sweep row.k is {k!r}, expected int >= 2")
+        sw = tel.get("sweep")
+        if not isinstance(sw, dict):
+            fail("sweep row without telemetry.sweep block")
+        degraded = sw.get("degraded")
+        dp = row.get("distance_passes")
+        if degraded is None and dp != 1:
+            fail(
+                f"sweep row ran {dp!r} distance passes without a "
+                f"degradation reason — the one-pass claim is the row's "
+                f"whole point"
+            )
+        gp = row.get("graph_pairs")
+        if not isinstance(gp, int) or (degraded is None and gp <= 0):
+            fail(f"sweep row.graph_pairs is {gp!r}")
+        v = row.get("value")
+        if not isinstance(v, (int, float)) or v != v or v <= 0:
+            fail(f"sweep amortization value is {v!r}")
+        pcs = row.get("per_config")
+        if not isinstance(pcs, list) or len(pcs) != k:
+            fail(f"sweep row.per_config has {pcs!r}, expected {k} entries")
+        for i, pc in enumerate(pcs):
+            if pc.get("labels_match") is not True:
+                fail(f"per_config[{i}] labels_match is not True")
+            if pc.get("ari") != 1.0:
+                fail(f"per_config[{i}] ari is {pc.get('ari')!r}, not 1.0")
+            rl = pc.get("relabel_s")
+            if not isinstance(rl, (int, float)) or rl != rl or rl < 0:
+                fail(f"per_config[{i}] relabel_s is {rl!r}")
+        # The comparability contract every row carries, asserted on
+        # the sweep block too (stale-NOTE satellite: sweep rows must
+        # be as honest about what ran as fit rows are).
+        if not isinstance(sw.get("owner_computes"), bool):
+            fail(
+                f"telemetry.sweep.owner_computes is "
+                f"{sw.get('owner_computes')!r}, expected bool"
+            )
+        if sw.get("dispatch") not in ("pair", "dense"):
+            fail(
+                f"telemetry.sweep.dispatch is {sw.get('dispatch')!r}, "
+                f"expected 'pair' or 'dense'"
+            )
+        for key in ("graph_bytes", "distance_passes"):
+            if not isinstance(sw.get(key), int):
+                fail(f"telemetry.sweep.{key} is {sw.get(key)!r}")
+
     # Regression-gate contract (ISSUE 6): rows produced under `make
     # bench-smoke` ride through bench_diff --annotate first; the
     # verdict must be present and must not be a real regression.

@@ -1,0 +1,340 @@
+"""Amortized hyperparameter sweeps (ISSUE 13).
+
+One distance pass at eps_max materializes the neighbor-pair graph;
+every (eps, min_samples) config re-thresholds cached d2 and
+label-propagates over the cached pair list.  The correctness bar is
+the repo's usual one: each sweep config's labels BYTE-IDENTICAL to an
+independent train() at that config on the same mode — fused, KD
+owner-computes, global-Morton — plus the overflow degradation rung,
+eps-order invariance, degenerate geometries, and the staging economy
+(owned slabs eps-free, graph slab reused by configs 2..k).
+"""
+
+import numpy as np
+import pytest
+from sklearn.datasets import make_blobs
+
+from pypardis_tpu import DBSCAN, sweep_dbscan
+from pypardis_tpu.parallel import default_mesh
+from pypardis_tpu.parallel import staging
+
+EPS_LIST = [0.25, 0.4]
+MS_LIST = [3, 5]
+KW = dict(min_samples=5, block=128)
+
+
+@pytest.fixture(scope="module")
+def blobs():
+    X, _ = make_blobs(
+        n_samples=1200, centers=5, n_features=3, cluster_std=0.3,
+        random_state=3,
+    )
+    return X
+
+
+def _solo(X, eps, ms, **kw):
+    m = DBSCAN(eps=eps, min_samples=ms, **kw)
+    m.fit(X)
+    return np.asarray(m.labels_), np.asarray(m.core_sample_mask_)
+
+
+def _assert_parity(X, res, tag, **kw):
+    for eps, ms in res.configs:
+        ref_l, ref_c = _solo(X, eps, ms, **kw)
+        np.testing.assert_array_equal(
+            res.labels(eps, ms), ref_l, err_msg=f"{tag} eps={eps} ms={ms}"
+        )
+        np.testing.assert_array_equal(
+            res.core(eps, ms), ref_c, err_msg=f"{tag} eps={eps} ms={ms}"
+        )
+
+
+def test_fused_byte_parity(blobs):
+    """1-device sweep == per-config fused train(), Morton-first
+    numbering included, across both min_samples values."""
+    kw = dict(block=128, mesh=default_mesh(1))
+    m = DBSCAN(eps=0.4, min_samples=5, **kw)
+    res = m.sweep(blobs, EPS_LIST, MS_LIST)
+    assert res.stats["distance_passes"] == 1
+    assert res.stats["graph_pairs"] > 0
+    assert len(res) == len(EPS_LIST) * len(MS_LIST)
+    _assert_parity(blobs, res, "fused", **kw)
+    # The sweep leaves the model fitted at the LAST config.
+    last = res.configs[-1]
+    np.testing.assert_array_equal(m.labels_, res.labels(*last))
+    rep = m.report()
+    assert rep["sweep"]["distance_passes"] == 1
+    assert rep["sweep"]["k"] == len(res)
+    assert isinstance(rep["sweep"]["owner_computes"], bool)
+    assert rep["sweep"]["dispatch"] in ("pair", "dense")
+
+
+def test_kd_sharded_byte_parity_and_staging_reuse(blobs):
+    """8-device KD sweep == per-config sharded train() (canonical
+    min-core-gid labels), and configs 2..k reuse the device-resident
+    graph slab (staged_bytes_reused > 0)."""
+    kw = dict(block=128, mesh=default_mesh(8))
+    m = DBSCAN(eps=0.4, min_samples=5, **kw)
+    res = m.sweep(blobs, EPS_LIST, MS_LIST)
+    assert res.stats["distance_passes"] == 1
+    assert res.stats["mode"] == "kd"
+    assert res.stats["owner_computes"] is True
+    _assert_parity(blobs, res, "kd", **kw)
+    assert res.per_config[0]["staged_bytes_reused"] == 0
+    for cfg in res.per_config[1:]:
+        assert cfg["staged_bytes_reused"] > 0, cfg
+
+
+def test_global_morton_byte_parity(blobs):
+    """Global-Morton sweep == per-config GM train(): boundary tiles
+    selected at eps_max cover every smaller eps by construction."""
+    kw = dict(block=128, mesh=default_mesh(8), mode="global_morton")
+    m = DBSCAN(eps=0.4, min_samples=5, **kw)
+    res = m.sweep(blobs, EPS_LIST)
+    assert res.stats["mode"] == "global_morton"
+    assert res.stats["distance_passes"] == 1
+    _assert_parity(blobs, res, "gm", **kw)
+
+
+@pytest.mark.parametrize("precision", ["highest", "mixed"])
+def test_precision_modes(blobs, precision):
+    """The graph stores the rescore arithmetic's exact d2, so mixed
+    (and highest) sweeps stay byte-identical to same-precision fits."""
+    kw = dict(block=128, mesh=default_mesh(1), precision=precision)
+    res = DBSCAN(eps=0.4, min_samples=5, **kw).sweep(blobs, EPS_LIST)
+    _assert_parity(blobs, res, f"precision={precision}", **kw)
+
+
+def test_explicit_xla_backend(blobs):
+    kw = dict(block=128, mesh=default_mesh(1), kernel_backend="xla")
+    res = DBSCAN(eps=0.4, min_samples=5, **kw).sweep(blobs, [0.4])
+    _assert_parity(blobs, res, "xla", **kw)
+
+
+def test_eps_order_invariance(blobs):
+    """Sorted vs unsorted eps_list: identical per-config labels (the
+    graph depends only on eps_max; configs are independent)."""
+    m = DBSCAN(eps=0.4, min_samples=5, block=128, mesh=default_mesh(1))
+    res_sorted = m.sweep(blobs, sorted(EPS_LIST))
+    res_shuffled = m.sweep(blobs, sorted(EPS_LIST)[::-1])
+    for eps in EPS_LIST:
+        np.testing.assert_array_equal(
+            res_sorted.labels(eps), res_shuffled.labels(eps),
+            err_msg=f"eps={eps}",
+        )
+
+
+def test_second_sweep_reuses_graph(blobs):
+    """A second sweep under the cached eps ceiling reuses the graph
+    slab through the eps-free ``sweep_graph`` staging route: the
+    reused graph is the eps_max=0.4 one (same pair count), not a fresh
+    smaller extraction at 0.25."""
+    kw = dict(block=128, mesh=default_mesh(1))
+    staging.clear()
+    m = DBSCAN(eps=0.4, min_samples=5, **kw)
+    res1 = m.sweep(blobs, EPS_LIST)
+    res2 = m.sweep(blobs, [0.25])  # ceiling under the cached 0.4
+    assert int(m.metrics_["staged_bytes_reused"]) > 0
+    assert res2.stats["graph_pairs"] == res1.stats["graph_pairs"]
+    _assert_parity(blobs, res2, "warm", **kw)
+
+
+def test_overflow_degrades_to_per_config_refits(blobs, monkeypatch):
+    """A graph past PYPARDIS_SWEEP_MAX_PAIRS degrades label-safely:
+    per-config refits, telemetry says so, labels still exact."""
+    monkeypatch.setenv("PYPARDIS_SWEEP_MAX_PAIRS", "64")
+    staging.clear()  # a cached graph would bypass the extraction cap
+    kw = dict(block=128, mesh=default_mesh(1))
+    m = DBSCAN(eps=0.4, min_samples=5, **kw)
+    res = m.sweep(blobs, EPS_LIST)
+    assert res.stats["degraded"] == "per_config_refit"
+    assert res.stats["distance_passes"] == len(res.configs)
+    _assert_parity(blobs, res, "degraded", **kw)
+    assert m.report()["events"]["degraded"] >= 1
+
+
+def test_duplicate_points_and_all_noise():
+    """Degenerate geometries: coincident duplicates (zero-distance
+    edges, self-pair handling) and an eps so small every point is
+    noise at min_samples=5."""
+    rng = np.random.default_rng(0)
+    base = rng.normal(size=(40, 3))
+    X = np.concatenate([base, base, base, rng.normal(size=(80, 3)) + 8.0])
+    kw = dict(block=64, mesh=default_mesh(1))
+    res = DBSCAN(eps=0.3, min_samples=5, **kw).sweep(X, [1e-4, 0.3])
+    _assert_parity(X, res, "degenerate", **kw)
+    # the tiny-eps config: duplicates (3 copies each) miss
+    # min_samples=5, so everything is noise
+    assert set(np.unique(res.labels(1e-4))) == {-1}
+
+
+def test_min_samples_only_sweep(blobs):
+    """min_samples grid at one eps rides the same graph."""
+    kw = dict(block=128, mesh=default_mesh(1))
+    m = DBSCAN(eps=0.4, min_samples=5, **kw)
+    res = m.sweep(blobs, [0.4], [2, 5, 20])
+    assert res.stats["distance_passes"] == 1
+    _assert_parity(blobs, res, "ms-grid", **kw)
+
+
+def test_sweep_dbscan_functional(blobs):
+    res = sweep_dbscan(
+        blobs, [0.4], min_samples_list=[5], block=128,
+        mesh=default_mesh(1),
+    )
+    ref_l, _ = _solo(blobs, 0.4, 5, block=128, mesh=default_mesh(1))
+    np.testing.assert_array_equal(res.labels(0.4, 5), ref_l)
+    assert res.model.report()["sweep"]["k"] == 1
+
+
+def test_validation():
+    m = DBSCAN(eps=0.4, min_samples=5)
+    with pytest.raises(ValueError):
+        m.sweep(np.zeros((10, 2)), [])
+    with pytest.raises(ValueError):
+        m.sweep(np.zeros((10, 2)), [-0.5])
+    with pytest.raises(ValueError):
+        m.sweep(np.zeros((10, 2)), [0.5], [0])
+
+
+# -- the staging-aliasing regression the sweep work surfaced ------------
+
+
+def test_eps_change_staging_reuse_is_correct(blobs):
+    """fit(eps1) -> fit(eps2) with owned-slab reuse: labels must match
+    a cold fit at eps2 (regression: on CPU, device_put zero-copies, so
+    pooling the build buffers let a later borrow overwrite memory the
+    cached owned slabs still aliased — give_back_after_put)."""
+    part_kw = dict(min_samples=5, block=128, mesh=default_mesh(8))
+    staging.clear()
+    DBSCAN(eps=0.6, **part_kw).fit(blobs)
+    m = DBSCAN(eps=0.25, **part_kw)
+    m.fit(blobs)
+    warm = np.asarray(m.labels_)
+    assert m.metrics_["staged_bytes_reused"] > 0
+    staging.clear()
+    m2 = DBSCAN(eps=0.25, **part_kw)
+    m2.fit(blobs)
+    np.testing.assert_array_equal(warm, np.asarray(m2.labels_))
+
+
+# -- cosine metric (ISSUE 13 satellite) ---------------------------------
+
+
+@pytest.fixture(scope="module")
+def sphere_clusters():
+    """CLIP-like manifold data: clusters of directions, magnitudes
+    varied — cosine must ignore the magnitudes entirely."""
+    rng = np.random.default_rng(7)
+    centers = rng.normal(size=(4, 8))
+    centers /= np.linalg.norm(centers, axis=1, keepdims=True)
+    X = np.concatenate(
+        [c + rng.normal(scale=0.03, size=(120, 8)) for c in centers]
+    )
+    return X * rng.uniform(0.5, 2.0, size=(len(X), 1))
+
+
+def _cosine_oracle(X, eps, ms):
+    """Brute-force numpy cosine DBSCAN: f64 cosine distances, parallel
+    formulation (min-core-index components, border = min adjacent
+    root), canonical densified labels."""
+    from pypardis_tpu.ops.labels import densify_labels
+
+    Xn = X / np.linalg.norm(X, axis=1, keepdims=True)
+    adj = (1.0 - Xn @ Xn.T) <= eps
+    core = adj.sum(1) >= ms
+    n = len(X)
+    comp = np.full(n, -1)
+    cid = 0
+    import collections
+
+    for i in range(n):
+        if core[i] and comp[i] < 0:
+            q = collections.deque([i])
+            comp[i] = cid
+            while q:
+                u = q.popleft()
+                for v in np.flatnonzero(adj[u] & core):
+                    if comp[v] < 0:
+                        comp[v] = cid
+                        q.append(v)
+            cid += 1
+    roots = np.full(cid, n)
+    for i in np.flatnonzero(core):
+        roots[comp[i]] = min(roots[comp[i]], i)
+    lab = np.full(n, -1, np.int64)
+    for i in range(n):
+        if core[i]:
+            lab[i] = roots[comp[i]]
+        else:
+            nbr = np.flatnonzero(adj[i] & core)
+            if len(nbr):
+                lab[i] = min(roots[comp[j]] for j in nbr)
+    return densify_labels(lab), core
+
+
+def _canon(labels, core):
+    from pypardis_tpu.ops.labels import densify_labels
+    from pypardis_tpu.parallel.sharded import _canonicalize_roots
+
+    return densify_labels(
+        _canonicalize_roots(np.asarray(labels), np.asarray(core))
+    )
+
+
+def test_cosine_fit_pinned_against_numpy_oracle(sphere_clusters):
+    X = sphere_clusters
+    m = DBSCAN(eps=0.02, min_samples=5, metric="cosine", block=128)
+    m.fit(X)
+    ol, oc = _cosine_oracle(X, 0.02, 5)
+    np.testing.assert_array_equal(
+        _canon(m.labels_, m.core_sample_mask_), ol
+    )
+    np.testing.assert_array_equal(np.asarray(m.core_sample_mask_), oc)
+    # user-facing spec survives the kernel-frame swap
+    assert m.metric == "cosine" and m.eps == 0.02
+    assert m.report()["params"]["metric"] == "cosine"
+
+
+def test_cosine_predict_bitwise_oracle(sphere_clusters, tmp_path):
+    """predict == the index's brute-force oracle bitwise, and a
+    save/load round trip serves identical answers (unit_norm metadata
+    persisted)."""
+    X = sphere_clusters
+    rng = np.random.default_rng(1)
+    Q = rng.normal(size=(100, 8)) * rng.uniform(0.2, 3.0, (100, 1))
+    m = DBSCAN(eps=0.02, min_samples=5, metric="cosine", block=128)
+    m.fit(X)
+    pred = m.predict(Q)
+    olab, _ = m.query_engine().index.oracle_predict(Q)
+    np.testing.assert_array_equal(pred, olab)
+    # independent f64 cosine check of the noise/member split
+    Xn = X / np.linalg.norm(X, axis=1, keepdims=True)
+    Qn = Q / np.linalg.norm(Q, axis=1, keepdims=True)
+    cores = Xn[np.asarray(m.core_sample_mask_)]
+    within = ((1.0 - Qn @ cores.T) <= 0.02).any(1)
+    assert ((pred >= 0) == within).mean() > 0.99
+    path = str(tmp_path / "cosine_model.npz")
+    m.save(path)
+    m2 = DBSCAN.load(path)
+    assert m2.metric == "cosine"
+    np.testing.assert_array_equal(m2.predict(Q), pred)
+
+
+def test_cosine_sweep_rides_cached_graph(sphere_clusters):
+    X = sphere_clusters
+    kw = dict(metric="cosine", block=128, mesh=default_mesh(1))
+    m = DBSCAN(eps=0.02, min_samples=5, **kw)
+    res = m.sweep(X, [0.01, 0.05])
+    assert res.stats["distance_passes"] == 1
+    _assert_parity(X, res, "cosine-sweep", **kw)
+
+
+def test_cosine_validation():
+    with pytest.raises(ValueError):
+        DBSCAN(eps=2.5, metric="cosine")  # cosine distance <= 2
+    m = DBSCAN(eps=0.1, min_samples=2, metric="cosine")
+    with pytest.raises(ValueError):
+        m.fit(np.array([[1.0, 0.0], [0.0, 0.0]]))  # zero vector
+    with pytest.raises(NotImplementedError):
+        m.fit(np.eye(3)).live()  # live updates not yet supported
