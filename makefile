@@ -7,7 +7,7 @@ PY ?= python
 	partition-probe serve-probe live-probe ingest-probe \
 	global-morton-probe fault-probe bench-diff flight-check \
 	northstar northstar-smoke streammem-probe sort-probe \
-	kernel-probe sweep-probe demo clean
+	kernel-probe sweep-probe tune-probe demo clean
 
 all: native test
 
@@ -49,7 +49,7 @@ bench:
 # level builder's mp-doubling cost ratio exceeds 1.5x).
 bench-smoke: partition-probe serve-probe live-probe ingest-probe \
 		global-morton-probe fault-probe bench-diff flight-check \
-		northstar-smoke kernel-probe sweep-probe
+		northstar-smoke kernel-probe sweep-probe tune-probe
 	JAX_PLATFORMS=cpu BENCH_N=2000 BENCH_DIM=4 BENCH_REPS=1 \
 	BENCH_DEV_REPS=1 $(PY) bench.py \
 	| $(PY) scripts/bench_diff.py --annotate --baseline-dir . \
@@ -75,6 +75,19 @@ kernel-probe:
 # Acceptance-scale run: `SWEEP_N=100000 make sweep-probe`.
 sweep-probe:
 	$(PY) scripts/sweep_probe.py \
+	| $(PY) scripts/bench_diff.py --annotate --baseline-dir . \
+	| $(PY) scripts/check_bench_json.py --require-diff
+
+# Auto-tuning probe (ISSUE 14): one measured DBSCAN(auto=True) fit —
+# probe + corpus harvest + plan — against a >= 6-point explicit
+# config lattice on the same geometry.  Gates: planned config's wall
+# within 1.25x the best lattice point, probe+plan overhead <= 5% of
+# the auto fit's wall, auto labels byte-identical to the same
+# explicit config, finite predicted phases; the schema'd tune@1 row
+# rides the bench_diff cross-round gate.  Acceptance-scale run:
+# `TUNE_N=1000000 make tune-probe`.
+tune-probe:
+	$(PY) scripts/tune_probe.py \
 	| $(PY) scripts/bench_diff.py --annotate --baseline-dir . \
 	| $(PY) scripts/check_bench_json.py --require-diff
 

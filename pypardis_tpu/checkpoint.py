@@ -131,6 +131,13 @@ def save_model(model, path: str, *, live=None, index=None) -> None:
             np.asarray(model.core_sample_mask_, bool)
         ]
     extra = {}
+    # Auto-tuning plan (ISSUE 14): a planned fit's decision record —
+    # chosen config, predicted vs measured phases, explain trace —
+    # survives the checkpoint, so a loaded model can say why it ran
+    # the config it ran (and a re-serving process can reuse it).
+    tune = getattr(model, "_tune_stats", None)
+    if tune:
+        extra["tune"] = json.dumps(tune)
     if live is not None:
         extra.update(
             live_points=np.asarray(live["points"], np.float64),
@@ -242,6 +249,8 @@ def load_model(path: str):
         # without retraining or the original dataset.
         if "core_points" in z.files and z["core_points"].size:
             model._serve_core_points = z["core_points"]
+        if "tune" in z.files:
+            model._tune_stats = json.loads(str(z["tune"]))
         # Live-update payload (LiveModel.save checkpoints): the mutated
         # point set + byte-exact index slabs, handed to LiveModel.load
         # via _live_ckpt (plain load_model callers never see it).
@@ -314,9 +323,12 @@ def save_index(index, path: str) -> None:
             "n_core": index.n_core,
             "leaf_cap": int(index.stats.get("leaf_cap", 0)),
             "n_leaves": int(index.stats.get("n_leaves", 0)),
-            # Cosine-frame flag: a restored index must keep projecting
-            # queries onto the unit sphere (metric metadata, ISSUE 13).
+            # Driver-metric frame: a restored index must keep
+            # projecting queries — unit-sphere normalization for
+            # cosine (ISSUE 13), (lat, lon) embedding for haversine
+            # (ISSUE 14 satellite).
             "unit_norm": bool(getattr(index, "unit_norm", False)),
+            "projection": str(getattr(index, "projection", "none")),
         }),
         center=index.center,
         tree=np.asarray(index.tree, np.float64).reshape(-1, 5),
@@ -360,4 +372,8 @@ def load_index(path: str):
             },
         )
         idx.unit_norm = bool(params.get("unit_norm", False))
+        # Pre-haversine checkpoints carry only the bool.
+        idx.projection = str(
+            params.get("projection", "unit" if idx.unit_norm else "none")
+        )
     return idx

@@ -593,17 +593,51 @@ class DBSCAN:
         metric="euclidean",
         max_partitions: Optional[int] = None,
         split_method: str = "min_var",
-        block: int = 1024,
+        block: Optional[int] = None,
         mesh=None,
-        precision: str = "high",
+        precision: Optional[str] = None,
         kernel_backend: str = "auto",
-        merge: str = "auto",
+        merge: Optional[str] = None,
         profile_dir: Optional[str] = None,
         owner_computes: bool = True,
         overlap: Optional[bool] = None,
-        mode: str = "auto",
+        mode: Optional[str] = None,
         flight: Optional[str] = None,
+        auto: bool = False,
+        tune_corpus: Optional[str] = None,
     ):
+        # Auto-tuning (pypardis_tpu.tune): knobs the caller passed
+        # explicitly are PINNED — the planner never overrides them;
+        # ``None`` defaults resolve to the historical values here, so
+        # non-auto behavior is unchanged, while ``auto=True`` plans
+        # every unpinned knob per fit from a dataset probe + the
+        # telemetry corpus.  PYPARDIS_DISPATCH counts as a user pin of
+        # the dispatch knob.
+        self._tune_pinned: Dict = {}
+        if block is not None:
+            self._tune_pinned["block"] = int(block)
+        else:
+            block = 1024
+        if precision is not None:
+            self._tune_pinned["precision"] = precision
+        else:
+            precision = "high"
+        if merge is not None:
+            self._tune_pinned["merge"] = merge
+        else:
+            merge = "auto"
+        if mode is not None:
+            self._tune_pinned["mode"] = mode
+        else:
+            mode = "auto"
+        env_dispatch = os.environ.get("PYPARDIS_DISPATCH")
+        if env_dispatch and env_dispatch != "auto":
+            self._tune_pinned["dispatch"] = env_dispatch
+        self.auto = bool(auto)
+        # Local corpus override for the auto-fit feedback loop (None
+        # defers to PYPARDIS_TUNE_CORPUS / the default archive path).
+        self.tune_corpus = tune_corpus
+        self._tune_stats: Optional[Dict] = None
         if mode not in ("auto", "kd", "global_morton"):
             raise ValueError(
                 f"mode must be auto|kd|global_morton, got {mode!r}"
@@ -697,28 +731,33 @@ class DBSCAN:
     def kernel_eps(self) -> float:
         """eps in the KERNEL frame: for ``metric='cosine'`` the L2
         threshold ``sqrt(2 * eps)`` on the unit sphere (``d^2 = 2 - 2
-        cos``, monotone in angular distance), else eps unchanged.  The
-        serving index builds against this value
+        cos``, monotone in angular distance); for
+        ``metric='haversine'`` the CHORD ``2 sin(eps / 2)`` of the
+        great-circle angle (monotone on [0, pi]); else eps unchanged.
+        The serving index builds against this value
         (:func:`pypardis_tpu.serve.index.build_index`)."""
         if self._metric_norm == "cosine":
             return float(np.sqrt(2.0 * self.eps))
+        if self._metric_norm == "haversine":
+            return float(2.0 * np.sin(self.eps / 2.0))
         return float(self.eps)
 
     def _kernel_frame(self):
         """Context manager swapping ``(eps, metric)`` to the kernel
         frame for the duration of a fit/sweep body.
 
-        For cosine, every internal consumer of ``self.eps`` /
-        ``self.metric`` — halo expansion, staging keys, jobstate
-        metadata, the kernels themselves — must see the remapped L2
-        values, and there are a dozen such sites; one swap at the
-        boundary keeps them all consistent.  User-facing values are
-        restored on exit (``report()`` params and checkpoints carry
-        the cosine spec).  A no-op for the kernel metrics.
+        For the driver metrics (cosine, haversine), every internal
+        consumer of ``self.eps`` / ``self.metric`` — halo expansion,
+        staging keys, jobstate metadata, the kernels themselves —
+        must see the remapped L2 values, and there are a dozen such
+        sites; one swap at the boundary keeps them all consistent.
+        User-facing values are restored on exit (``report()`` params
+        and checkpoints carry the original spec).  A no-op for the
+        kernel metrics.
         """
         import contextlib
 
-        if self._metric_norm != "cosine":
+        if self._metric_norm not in ("cosine", "haversine"):
             return contextlib.nullcontext()
 
         @contextlib.contextmanager
@@ -743,13 +782,27 @@ class DBSCAN:
         shares) and eps remaps to ``sqrt(2 * eps)`` for the L2 kernels;
         labels are exactly the cosine-threshold clustering.
         """
-        if self._metric_norm == "cosine":
+        if self._metric_norm in ("cosine", "haversine"):
             keys, points = _as_keys_points(data)
             with self._kernel_frame():
-                self._train_impl((keys, _unit_rows(points)), resume)
+                self._train_impl(
+                    (keys, self._driver_frame_rows(points)), resume
+                )
             return self
 
         return self._train_impl(data, resume)
+
+    def _driver_frame_rows(self, points) -> np.ndarray:
+        """Project raw input rows into the driver metric's kernel
+        frame: unit-normalized for cosine, (lat, lon) radians embedded
+        onto the 3-D unit sphere for haversine (``model.data`` holds
+        the projected rows — the frame every downstream surface,
+        serving included, shares)."""
+        if self._metric_norm == "cosine":
+            return _unit_rows(points)
+        from .geometry import latlon_to_unit_sphere
+
+        return latlon_to_unit_sphere(points)
 
     def _train_impl(self, data, resume: Optional[str] = None) -> "DBSCAN":
         """The metric-agnostic fit body (kernel-frame eps/metric).
@@ -779,6 +832,15 @@ class DBSCAN:
 
         validate_params(self.eps, self.min_samples)
         keys, points = _as_keys_points(data)
+        # Auto-tuning happens BEFORE the jobstate opens: the checkpoint
+        # fingerprint must describe the PLANNED config (block/mode ride
+        # in fit_meta), and planning is deterministic given the same
+        # data, env, and corpus — a resumed auto fit re-plans the same
+        # config or the fingerprint rejects it loudly.
+        dispatch_token = None
+        self._tune_stats = None
+        if self.auto and len(points):
+            dispatch_token = self._plan_auto(points)
         ckpt_path = resume or os.environ.get("PYPARDIS_CKPT")
         if ckpt_path:
             from .utils.jobstate import JobState, fit_meta
@@ -905,6 +967,15 @@ class DBSCAN:
             raise
         finally:
             sampler.stop()
+            if dispatch_token is not None:
+                # The planned dispatch rode in PYPARDIS_DISPATCH for
+                # the fit body only; restore the ambient value so a
+                # later non-auto fit sees the user's environment.
+                prev = dispatch_token
+                if prev == "":
+                    os.environ.pop("PYPARDIS_DISPATCH", None)
+                else:
+                    os.environ["PYPARDIS_DISPATCH"] = prev
             if self._jobstate is not None:
                 # Persist any boundary state the cadence was still
                 # holding (a SIGKILL needs no help — every boundary
@@ -922,6 +993,8 @@ class DBSCAN:
         # bench scale and gigabytes at the north star, and fit_predict
         # callers never read it.
         self._result_cache = None
+        if self.auto and self._tune_stats is not None:
+            self._tune_finalize()
         return self
 
     def fit(self, X) -> "DBSCAN":
@@ -1010,8 +1083,8 @@ class DBSCAN:
         configs = [(e, m) for e in eps_vals for m in ms_vals]
 
         keys, points = _as_keys_points(data)
-        if self._metric_norm == "cosine":
-            points = _unit_rows(points)
+        if self._metric_norm in ("cosine", "haversine"):
+            points = self._driver_frame_rows(points)
         if len(points) == 0:
             raise ValueError("sweep needs a non-empty dataset")
 
@@ -1097,6 +1170,8 @@ class DBSCAN:
 
         if self._metric_norm == "cosine":
             eps_k = [float(np.sqrt(2.0 * e)) for e, _ in configs]
+        elif self._metric_norm == "haversine":
+            eps_k = [float(2.0 * np.sin(e / 2.0)) for e, _ in configs]
         else:
             eps_k = [float(e) for e, _ in configs]
         eps_max = max(eps_k)
@@ -1510,10 +1585,14 @@ class DBSCAN:
 
         labels_out, core_out, per_cfg = {}, {}, []
         relabel_s = []
-        kernel = self._metric_norm == "cosine"
         for cfg in configs:
             e_u, ms = cfg
-            e_k = float(np.sqrt(2.0 * e_u)) if kernel else float(e_u)
+            if self._metric_norm == "cosine":
+                e_k = float(np.sqrt(2.0 * e_u))
+            elif self._metric_norm == "haversine":
+                e_k = float(2.0 * np.sin(e_u / 2.0))
+            else:
+                e_k = float(e_u)
             t_c = _time.perf_counter()
             m = DBSCAN(
                 eps=e_k,
@@ -1701,12 +1780,12 @@ class DBSCAN:
         this fitted model — the incremental write surface (built on
         first use; kwargs force a rebuild).  Invalidated by a refit."""
         self._require_fitted()
-        if self._metric_norm == "cosine":
+        if self._metric_norm in ("cosine", "haversine"):
             raise NotImplementedError(
-                "live updates with metric='cosine' are not supported "
-                "yet: the incremental algebra reads model.eps in the "
-                "unit-sphere kernel frame; fit/predict/sweep all "
-                "support cosine"
+                f"live updates with metric={self._metric_norm!r} are "
+                f"not supported yet: the incremental algebra reads "
+                f"model.eps in the unit-sphere kernel frame; "
+                f"fit/predict/sweep all support it"
             )
         if self._live_model is None or kw:
             from .serve import LiveModel
@@ -1763,6 +1842,7 @@ class DBSCAN:
                 "overlap": self.overlap,
                 "mode": self.mode,
                 "flight": self.flight,
+                "auto": self.auto,
             },
             n_points=len(self.labels_),
             n_dims=self._fit_info.get("n_dims", 0),
@@ -1776,6 +1856,12 @@ class DBSCAN:
         # scripts/check_bench_json.py validates it on sweep@1 rows.
         if self._sweep_stats:
             rep["sweep"] = dict(self._sweep_stats)
+        # Auto-tuning block (ISSUE 14): present only on auto=True fits
+        # — the plan (with its explain trace), predicted vs measured
+        # per-phase seconds, corpus rows consulted, and whether the
+        # outcome fed back into the local corpus.
+        if self._tune_stats:
+            rep["tune"] = dict(self._tune_stats)
         return rep
 
     def summary(self) -> str:
@@ -1804,6 +1890,108 @@ class DBSCAN:
             "no telemetry recorded for this model (loaded from a "
             "checkpoint?) — export_trace needs an in-process fit"
         )
+
+    # -- auto-tuning ------------------------------------------------------
+
+    def _plan_auto(self, points) -> Optional[str]:
+        """Probe the input, harvest the corpus, plan the unpinned
+        knobs, and apply the plan to this model's config.
+
+        Returns the previous ``PYPARDIS_DISPATCH`` value (``""`` for
+        unset) when the plan took over the dispatch knob — the caller
+        restores it after the fit — or ``None`` when dispatch was
+        user-pinned.  Every planned knob is label-safe, so the fit's
+        labels are byte-identical to the same explicit config by
+        construction; user-pinned knobs are never overridden
+        (:mod:`pypardis_tpu.tune.planner`).
+        """
+        from .tune import harvest_corpus, plan_fit, probe_dataset
+        from .tune.probe import candidate_blocks
+
+        t0 = time.perf_counter()
+        pinned = dict(self._tune_pinned)
+        if _is_device_array(points):
+            pinned["_device_resident"] = True
+        try:
+            rows = harvest_corpus(local=self.tune_corpus)
+        except Exception:  # noqa: BLE001 — harvesting never fails a fit
+            rows = []
+        cand = set(candidate_blocks(len(points)))
+        if "block" in pinned:
+            cand.add(int(pinned["block"]))
+        probe = probe_dataset(
+            points, float(self.eps), blocks=sorted(cand),
+            devices=self._n_devices(),
+        )
+        plan = plan_fit(probe, pinned, rows)
+        cfg = plan.config
+        self.block = int(cfg.get("block", self.block))
+        if cfg.get("precision"):
+            self.precision = cfg["precision"]
+        if cfg.get("merge"):
+            self.merge = cfg["merge"]
+        if cfg.get("mode"):
+            self.mode = cfg["mode"]
+        token = None
+        if cfg.get("dispatch") and "dispatch" not in self._tune_pinned:
+            token = os.environ.get("PYPARDIS_DISPATCH", "")
+            os.environ["PYPARDIS_DISPATCH"] = str(cfg["dispatch"])
+        get_logger().info(
+            "auto-tune plan: %s", "; ".join(
+                f"{k}={cfg.get(k)}" for k in
+                ("mode", "block", "precision", "merge", "dispatch")
+            ),
+        )
+        self._tune_stats = {
+            "plan": plan.to_dict(),
+            "explain": plan.explain(),
+            "plan_s": round(time.perf_counter() - t0, 6),
+            "probe_s": round(probe.probe_s, 6),
+            "corpus_rows": len(rows),
+            "predicted_phases": dict(plan.predicted),
+        }
+        return token
+
+    def _tune_actual_phases(self) -> Dict[str, float]:
+        """The fit's measured build/exchange/compute/merge seconds in
+        the planner's phase vocabulary (GM reports its own
+        decomposition; KD/fused attribute partition->build and
+        cluster->compute, matching the model's terms)."""
+        m = self.metrics_
+        if "gm_build_s" in m or "gm_execute_s" in m:
+            return {
+                "build_s": float(m.get("gm_build_s", 0.0)),
+                "exchange_s": float(m.get("gm_exchange_s", 0.0)),
+                "compute_s": float(m.get("gm_execute_s", 0.0)),
+                "merge_s": float(m.get("gm_merge_s", 0.0)),
+                "total_s": float(m.get("total_s", 0.0)),
+            }
+        return {
+            "build_s": float(m.get("partition_s", 0.0)),
+            "exchange_s": 0.0,
+            "compute_s": float(m.get("cluster_s", 0.0)),
+            "merge_s": 0.0,
+            "total_s": float(m.get("total_s", 0.0)),
+        }
+
+    def _tune_finalize(self) -> None:
+        """Complete the tune telemetry with the measured outcome and
+        feed the (features, config, outcome) row back into the local
+        corpus — the loop that sharpens the model with use."""
+        from .tune import append_local_row, row_from_report
+
+        self._tune_stats["actual_phases"] = self._tune_actual_phases()
+        try:
+            row = row_from_report(self.report(), source="auto_fit")
+        except Exception:  # noqa: BLE001 — feedback never fails a fit
+            row = None
+        appended = False
+        if row is not None:
+            appended = append_local_row(
+                row, path=self.tune_corpus
+                if self.tune_corpus is not None else None,
+            )
+        self._tune_stats["corpus_appended"] = bool(appended)
 
     # -- internals --------------------------------------------------------
 

@@ -171,3 +171,42 @@ class BoxStack:
                 out=out[s:e],
             )
         return out
+
+
+def latlon_to_unit_sphere(points) -> np.ndarray:
+    """(N, 2) [lat, lon] RADIANS -> (N, 3) unit-sphere embedding.
+
+    The haversine metric's kernel frame: great-circle distance theta
+    between two points equals the angle between their unit vectors, and
+    the CHORD length ``2 sin(theta / 2)`` is monotone in theta on
+    [0, pi] — so after this embedding the existing L2 kernels answer
+    haversine thresholds exactly (``eps_theta -> 2 sin(eps_theta / 2)``,
+    the remap :attr:`pypardis_tpu.dbscan.DBSCAN.kernel_eps` applies).
+    Trigonometry runs in float64 (the centering-accuracy discipline);
+    float32 inputs come back float32.  Inputs follow the sklearn
+    haversine convention (radians, [lat, lon] column order); rows are
+    validated finite and 2-D — a degrees-by-mistake input is usually
+    caught by the eps validator instead (eps must be <= pi).
+    """
+    pts = np.asarray(points)
+    if pts.ndim != 2 or pts.shape[1] != 2:
+        raise ValueError(
+            f"metric='haversine' needs (N, 2) [lat, lon] input in "
+            f"radians, got shape {pts.shape}"
+        )
+    out_dtype = np.float32 if pts.dtype == np.float32 else np.float64
+    out = np.empty((len(pts), 3), out_dtype)
+    chunk = 1 << 20
+    for s in range(0, len(pts), chunk):
+        e = min(s + chunk, len(pts))
+        sub = np.asarray(pts[s:e], np.float64)
+        if not np.isfinite(sub).all():
+            raise ValueError(
+                "input contains NaN or infinite coordinates"
+            )
+        lat, lon = sub[:, 0], sub[:, 1]
+        clat = np.cos(lat)
+        out[s:e, 0] = (clat * np.cos(lon)).astype(out_dtype)
+        out[s:e, 1] = (clat * np.sin(lon)).astype(out_dtype)
+        out[s:e, 2] = np.sin(lat).astype(out_dtype)
+    return out

@@ -578,6 +578,34 @@ def format_summary(report: Dict) -> str:
             f"insert p50 {lv.get('insert_p50_ms', 0):.1f}ms"
             f"{batch_bit}{compact_bit}"
         )
+    tn = report.get("tune")
+    if tn:
+        plan = tn.get("plan", {})
+        cfg = plan.get("config", {})
+        pred = tn.get("predicted_phases", {}) or plan.get(
+            "predicted", {}
+        )
+        act = tn.get("actual_phases", {})
+        bits = [
+            "auto plan " + " ".join(
+                f"{k}={cfg.get(k)}"
+                for k in ("mode", "block", "precision", "merge",
+                          "dispatch")
+                if cfg.get(k) is not None
+            )
+        ]
+        if pred.get("total_s") is not None:
+            cmp_bit = f"predicted {pred['total_s']:.2f}s"
+            if act.get("total_s"):
+                cmp_bit += f" vs actual {act['total_s']:.2f}s"
+            bits.append(cmp_bit)
+        bits.append(
+            f"{tn.get('corpus_rows', 0)} corpus row(s), probe "
+            f"{tn.get('probe_s', 0.0):.3f}s"
+        )
+        if plan.get("fallback_reason"):
+            bits.append("heuristic fallback")
+        lines.append("  tune: " + ", ".join(bits))
     res = report.get("resources") or {}
     if res.get("samples", 0) > 0:
         pool = res.get("staging_pool_bytes", 0)

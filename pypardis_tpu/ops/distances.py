@@ -1091,8 +1091,28 @@ def sweep_max_edges() -> int:
     return int(os.environ.get("PYPARDIS_SWEEP_MAX_PAIRS", str(1 << 26)))
 
 
+def sweep_emission_route() -> str:
+    """Which pair-emission path the sweep graph build takes
+    (``host`` or ``device``).
+
+    ``PYPARDIS_SWEEP_EMISSION`` forces it; ``auto`` (default) routes
+    to host compaction on CPU — the XLA scatter behind the device
+    emission is single-threaded there (measured 65x a counts pass,
+    PR 13) — and to the device emission everywhere else.  The forced
+    ``device`` spelling is what lets CPU CI exercise the device
+    route's exact-total edge-budget ladder (the PR 13 NOTE debt).
+    """
+    env = os.environ.get("PYPARDIS_SWEEP_EMISSION", "auto")
+    if env in ("host", "device"):
+        return env
+    return "host" if jax.default_backend() == "cpu" else "device"
+
+
 def default_edge_budget(n: int) -> int:
-    """Default neighbor-pair graph capacity: 96 directed edges per row.
+    """Default neighbor-pair graph capacity: 96 directed edges per row
+    (``PYPARDIS_SWEEP_EDGE_BUDGET`` overrides the per-row default —
+    the deterministic way to drive the exact-total retry ladder in
+    tests and to pre-size known-dense sweeps).
 
     Self-pairs ride in the graph (the kernels count them too), and the
     blob/manifold probe geometries measure ~20-60 within-eps neighbors
@@ -1100,6 +1120,9 @@ def default_edge_budget(n: int) -> int:
     slab (budget * 12 bytes).  Overflow is signalled exactly (the
     returned total is the true count), so one retry always suffices.
     """
+    env = os.environ.get("PYPARDIS_SWEEP_EDGE_BUDGET")
+    if env:
+        return max(1, int(env))
     return max(1 << 16, 96 * n)
 
 

@@ -893,8 +893,10 @@ def sweep_graph_pipeline(
     )
 
     def run_extract():
-        if jax.default_backend() == "cpu":
-            # Host-compaction emission (see distances
+        from .distances import sweep_emission_route
+
+        if sweep_emission_route() == "host":
+            # Host-compaction emission (auto on CPU; see distances
             # .neighbor_pair_graph_host): same device arithmetic,
             # numpy stream compaction — the CPU XLA scatter behind the
             # device route is single-threaded and dominated the sweep.

@@ -2632,13 +2632,15 @@ def _sweep_slab_graph(
         default_edge_budget,
         neighbor_pair_graph,
         neighbor_pair_graph_host,
+        sweep_emission_route,
     )
 
     rt = owned_rows // block
-    if jax.default_backend() == "cpu":
-        # Host-compaction route: the XLA scatter behind the device
-        # emission runs single-threaded on CPU (measured 65x a counts
-        # pass); numpy compaction of the same device-computed tiles is
+    if sweep_emission_route() == "host":
+        # Host-compaction route (auto on CPU; PYPARDIS_SWEEP_EMISSION
+        # forces either): the XLA scatter behind the device emission
+        # runs single-threaded on CPU (measured 65x a counts pass);
+        # numpy compaction of the same device-computed tiles is
         # memory-speed and budget-free.
         gi, gj, dv, st = neighbor_pair_graph_host(
             pts, msk, eps, metric=metric, block=block,
@@ -2665,8 +2667,11 @@ def _sweep_slab_graph(
         st = np.asarray(st)
         need_e, got_e = int(st[0]), int(st[1])
         need_p, got_p = int(st[2]), int(st[3])
-        if need_e <= got_e and need_p <= got_p:
-            break
+        # Cap check BEFORE the no-overflow break (the fused loop's
+        # order): a graph that fits a generous budget must still
+        # respect the slab cap — the device-route ladder used to test
+        # the cap only after an overflow, a gap the forced-device CI
+        # coverage (PYPARDIS_SWEEP_EMISSION) exposed.
         if need_e > cap_edges:
             raise SweepGraphOverflow(
                 f"neighbor-pair graph needs {need_e} edges on one shard "
@@ -2674,6 +2679,8 @@ def _sweep_slab_graph(
                 f"(PYPARDIS_SWEEP_MAX_PAIRS); the sweep degrades to "
                 f"per-config refits"
             )
+        if need_e <= got_e and need_p <= got_p:
+            break
         if attempt == 1:
             raise SweepGraphOverflow(
                 f"graph emission overflow persisted after an exact-"

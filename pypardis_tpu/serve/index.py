@@ -98,10 +98,14 @@ class CorePointIndex:
         self.qblock = int(qblock)
         self.n_core = int(n_core)
         self.stats: Dict = dict(stats or {})
-        # Cosine-model frame flag (set by build_index / load_index):
-        # queries unit-normalize before centering, so the L2 kernels
-        # answer the cosine-threshold question exactly.
+        # Driver-metric frame (set by build_index / load_index):
+        # ``unit`` (cosine) unit-normalizes queries before centering,
+        # ``latlon`` (haversine) embeds (lat, lon)-radian queries onto
+        # the 3-D unit sphere — either way the L2 kernels then answer
+        # the driver metric's threshold question exactly.  The legacy
+        # ``unit_norm`` bool is kept in sync for old checkpoints.
         self.unit_norm = False
+        self.projection = "none"
         self._margin = self.eps * _MARGIN_SLACK
         self._dev = None
         # Live-update state (the serve_index_delta path): monotone
@@ -662,7 +666,7 @@ class CorePointIndex:
 
         for attr in ("center", "tree", "coords", "labels", "blo", "bhi",
                      "block", "qblock", "n_core", "leaf_slabs", "gids",
-                     "unit_norm"):
+                     "unit_norm", "projection"):
             setattr(self, attr, getattr(fresh, attr))
         self.src_index = getattr(fresh, "src_index", None)
         self.stats = dict(fresh.stats)
@@ -683,8 +687,15 @@ class CorePointIndex:
     def prepare_queries(self, X) -> np.ndarray:
         """Validated, centered float32 queries (the serving dtype both
         the kernels and the oracle consume).  A cosine-frame index
-        (``unit_norm``) projects queries onto the unit sphere first —
-        the same normalization the fit applied to the core set."""
+        (``unit_norm``/``projection='unit'``) projects queries onto
+        the unit sphere first; a haversine-frame index
+        (``projection='latlon'``) embeds (lat, lon)-radian queries
+        into the same 3-D frame the fit indexed — the projection the
+        fit applied to the core set, replayed on every query."""
+        if getattr(self, "projection", "none") == "latlon":
+            from ..geometry import latlon_to_unit_sphere
+
+            X = latlon_to_unit_sphere(check_query_points(X, 2))
         X = check_query_points(X, self.d)
         X = X.astype(np.float64)
         if self.unit_norm:
@@ -851,7 +862,9 @@ def build_index(
         cores, labels, eps, leaves=leaves, block=block,
         qblock=qblock, seed=seed,
     )
-    idx.unit_norm = (
-        getattr(model, "_metric_norm", None) == "cosine"
-    )
+    metric_norm = getattr(model, "_metric_norm", None)
+    idx.unit_norm = metric_norm == "cosine"
+    idx.projection = {
+        "cosine": "unit", "haversine": "latlon"
+    }.get(metric_norm, "none")
     return idx

@@ -1,0 +1,60 @@
+"""Auto-tuning subsystem: telemetry-fed cost model + config planner.
+
+The engine has six distributed modes, two dispatch policies, three
+precision ladders, two merge routes, and a block-size knob whose best
+value flipped 1024->256 when pair dispatch landed — and until this
+package the user picked all of them by hand.  The NoisePage/OtterTune
+move (fit a model on your own observed runs, plan from it) applied to
+the clustering stack:
+
+* :mod:`~pypardis_tpu.tune.corpus` — harvest every committed
+  ``BENCH_*``/``MESHSCALE_*``/``NORTHSTAR_*`` row plus the local
+  auto-fit archive into one schema'd feature table
+  (``tuning_corpus@1``);
+* :mod:`~pypardis_tpu.tune.probe` — a bounded-cost sampling pass over
+  the input estimating the features a plan depends on (density at eps,
+  live tile-pair fraction per candidate block, mixed-precision band
+  fraction, memory footprint) — memmap-safe, so out-of-core fits plan
+  too;
+* :mod:`~pypardis_tpu.tune.model` — an interpretable analytic
+  per-phase cost model whose coefficients fit from the corpus by least
+  squares per ``(backend, devices)`` bucket, with documented heuristic
+  fallbacks;
+* :mod:`~pypardis_tpu.tune.planner` — hard feasibility rules first
+  (memmap -> streaming global-Morton, 1 device -> chained, RSS
+  pressure -> merge=host), then score the discrete config lattice and
+  return a :class:`~pypardis_tpu.tune.planner.TunePlan` with an
+  ``explain()`` trace.
+
+Surface: ``DBSCAN(auto=True)`` — user-set knobs are pinned, only
+unset ones are planned, and every planned knob is label-safe, so
+labels are byte-identical to the same explicit config by
+construction.  Each auto fit appends its own (features, config,
+outcome) row to the local corpus so the model sharpens with use.
+"""
+
+from .corpus import (
+    CORPUS_SCHEMA,
+    CorpusRow,
+    append_local_row,
+    harvest_corpus,
+    local_corpus_path,
+    row_from_report,
+)
+from .model import CostModel
+from .planner import TunePlan, plan_fit
+from .probe import DatasetProbe, probe_dataset
+
+__all__ = [
+    "CORPUS_SCHEMA",
+    "CorpusRow",
+    "CostModel",
+    "DatasetProbe",
+    "TunePlan",
+    "append_local_row",
+    "harvest_corpus",
+    "local_corpus_path",
+    "plan_fit",
+    "probe_dataset",
+    "row_from_report",
+]

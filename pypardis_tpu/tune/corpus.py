@@ -1,0 +1,389 @@
+"""The tuning corpus: every observed run, one schema'd feature table.
+
+Rows come from three places, normalized into the same
+``pypardis_tpu/tuning_corpus@1`` shape:
+
+* the committed benchmark archives (``BENCH_*.json`` /
+  ``MESHSCALE_*.json`` / ``NORTHSTAR_*.json`` / ``*_probe`` rows) —
+  anything carrying a ``run_report@1`` telemetry block yields a FULL
+  row; partial archives (old BENCH tails, MESHSCALE mesh_rows) yield
+  partial rows with the unknown config fields null;
+* any JSON file/line the caller points :func:`harvest_corpus` at
+  (flight/report archives replayed to reports work too);
+* the local auto-fit archive (:func:`local_corpus_path`), one JSONL
+  row per ``DBSCAN(auto=True)`` fit — the feedback loop that sharpens
+  the model with use.
+
+A row is dataset stats x config x outcome:
+
+``features``: n, dim, devices, backend, input (ram/stream/device)
+``config``:   mode, block, precision, merge, dispatch, owner_computes
+``outcome``:  wall_s, per-phase build/exchange/compute/merge seconds,
+              samples_per_sec, live_pairs, live_pair_fraction,
+              kernel_passes, band_fraction, duplicated_work_factor,
+              halo_bytes (boundary bytes on GM), peak_host_rss_bytes
+
+Unknown fields are ``None`` — the model fitter only consumes rows
+that carry what its term needs, but every observed run is kept (the
+corpus is an archive, not a training set).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from dataclasses import asdict, dataclass, field
+from typing import Dict, List, Optional
+
+CORPUS_SCHEMA = "pypardis_tpu/tuning_corpus@1"
+
+# Committed-archive filename patterns the harvester scans for.
+_ARCHIVE_GLOBS = (
+    "BENCH_*.json",
+    "BENCH_SCALE_*.json",
+    "MESHSCALE_*.json",
+    "MULTICHIP_*.json",
+    "NORTHSTAR_*.json",
+    "STREAMMEM_*.json",
+)
+
+
+@dataclass
+class CorpusRow:
+    """One observed run (schema ``tuning_corpus@1``)."""
+
+    # -- features (dataset stats) --
+    n: Optional[int] = None
+    dim: Optional[int] = None
+    devices: Optional[int] = None
+    backend: Optional[str] = None
+    input: Optional[str] = None  # ram | stream | device
+    # -- config --
+    mode: Optional[str] = None  # fused | kd | global_morton | chained
+    block: Optional[int] = None
+    precision: Optional[str] = None
+    merge: Optional[str] = None
+    dispatch: Optional[str] = None  # pair | dense
+    owner_computes: Optional[bool] = None
+    # -- outcome --
+    wall_s: Optional[float] = None
+    build_s: Optional[float] = None
+    exchange_s: Optional[float] = None
+    compute_s: Optional[float] = None
+    merge_s: Optional[float] = None
+    samples_per_sec: Optional[float] = None
+    live_pairs: Optional[int] = None
+    live_pair_fraction: Optional[float] = None
+    kernel_tiles: Optional[int] = None
+    kernel_passes: Optional[int] = None
+    band_fraction: Optional[float] = None
+    duplicated_work_factor: Optional[float] = None
+    halo_bytes: Optional[int] = None
+    peak_host_rss_bytes: Optional[int] = None
+    # -- provenance --
+    source: str = ""
+    schema: str = field(default=CORPUS_SCHEMA)
+
+    def to_dict(self) -> Dict:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "CorpusRow":
+        known = {f for f in cls.__dataclass_fields__}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+    def complete_for_compute(self) -> bool:
+        """Whether the compute-term fitter can consume this row."""
+        return None not in (
+            self.compute_s, self.live_pairs, self.block, self.dim,
+            self.kernel_passes,
+        ) and self.compute_s > 0
+
+
+def _num(v):
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        return None
+    return v if v == v and abs(v) != float("inf") else None
+
+
+def row_from_report(report: Dict, *, wall_s=None,
+                    source: str = "") -> Optional[CorpusRow]:
+    """A corpus row from one ``run_report@1`` telemetry dict.
+
+    Phase mapping: the global-Morton engine reports its own
+    ``gm_build/gm_exchange/gm_execute/gm_merge`` decomposition; the KD
+    and fused routes attribute the partition phase to build and the
+    cluster phase to compute (their exchange rides inside the cluster
+    span — the model treats it as part of the compute term for those
+    modes, which is exactly how their wall behaves).
+    """
+    if not isinstance(report, dict) or "run" not in report:
+        return None
+    run = report.get("run", {})
+    sh = report.get("sharding", {})
+    comp = report.get("compute", {})
+    phases = report.get("phases", {})
+    params = report.get("params", {})
+    res = report.get("resources", {})
+
+    devices = int(run.get("n_devices", 1) or 1)
+    if sh.get("mode") == "global_morton":
+        mode = "global_morton"
+        build = _num(phases.get("gm_build"))
+        exchange = _num(phases.get("gm_exchange"))
+        compute = _num(phases.get("gm_execute"))
+        merge_s = _num(phases.get("gm_merge"))
+        halo = _num(sh.get("boundary_tile_bytes"))
+    else:
+        mode = ("chained" if sh.get("chained") else
+                "kd" if devices > 1 else "fused")
+        build = _num(phases.get("partition"))
+        exchange = None
+        compute = _num(phases.get("cluster"))
+        merge_s = None
+        halo = _num(sh.get("halo_bytes"))
+
+    tiles = _num(comp.get("kernel_tiles"))
+    pairs = _num(comp.get("live_pairs"))
+    dispatch = None
+    if tiles and pairs is not None:
+        # The report doesn't carry the dispatch tag directly; recover
+        # it the way the kernels decided it (trace-time auto policy).
+        try:
+            from ..ops.distances import pair_dispatch_enabled
+
+            dispatch = "pair" if pair_dispatch_enabled(int(tiles)) \
+                else "dense"
+        except Exception:  # noqa: BLE001 — provenance only
+            dispatch = None
+
+    total = _num(run.get("total_s"))
+    pps = _num(run.get("points_per_sec"))
+    return CorpusRow(
+        n=int(run.get("n_points", 0) or 0) or None,
+        dim=int(run.get("n_dims", 0) or 0) or None,
+        devices=devices,
+        backend=str(run.get("backend")) if run.get("backend") else None,
+        input=str(sh.get("input", "ram")),
+        mode=mode,
+        block=int(comp.get("kernel_block") or params.get("block") or 0)
+        or None,
+        precision=comp.get("precision_mode") or params.get("precision"),
+        merge=sh.get("merge"),
+        dispatch=dispatch,
+        owner_computes=sh.get("owner_computes"),
+        wall_s=_num(wall_s) if wall_s is not None else total,
+        build_s=build,
+        exchange_s=exchange,
+        compute_s=compute,
+        merge_s=merge_s,
+        samples_per_sec=pps,
+        live_pairs=int(pairs) if pairs is not None else None,
+        live_pair_fraction=_num(comp.get("live_pair_fraction")),
+        kernel_tiles=int(tiles) if tiles is not None else None,
+        kernel_passes=int(comp.get("kernel_passes") or 0) or None,
+        band_fraction=_num(comp.get("band_fraction")),
+        duplicated_work_factor=_num(sh.get("duplicated_work_factor")),
+        halo_bytes=int(halo) if halo is not None else None,
+        peak_host_rss_bytes=int(
+            _num(res.get("peak_host_rss_bytes")) or 0
+        ) or None,
+        source=source,
+    )
+
+
+def _rows_from_obj(obj, source: str) -> List[CorpusRow]:
+    """Corpus rows from one parsed JSON object of any archive shape."""
+    rows: List[CorpusRow] = []
+    if not isinstance(obj, dict):
+        return rows
+    if obj.get("schema") == CORPUS_SCHEMA:
+        rows.append(CorpusRow.from_dict(obj))
+        return rows
+    # run_report@1 embedded as `telemetry` (bench/probe/northstar rows)
+    # or the object IS a report.
+    tel = obj.get("telemetry") if isinstance(
+        obj.get("telemetry"), dict
+    ) else (obj if obj.get("schema", "").endswith("run_report@1")
+            else None)
+    if tel is not None:
+        # Prefer the row's own best-of-N samples over total_s: archived
+        # `samples_s` are the timed-region walls the metric was cut
+        # from; the report total includes generation/oracle overheads.
+        wall = None
+        samples = obj.get("samples_s")
+        if isinstance(samples, list) and samples:
+            finite = [s for s in samples if _num(s) is not None]
+            if finite:
+                wall = min(finite)
+        r = row_from_report(tel, wall_s=wall, source=source)
+        if r is not None:
+            rows.append(r)
+        return rows
+    # BENCH_r0*.json archive shape: {"n","cmd","rc","tail","parsed"} —
+    # the tail holds the emitted JSON line(s), possibly telemetry-free
+    # on old rounds.
+    if "tail" in obj and isinstance(obj["tail"], str):
+        for ln in obj["tail"].splitlines():
+            ln = ln.strip()
+            if not ln.startswith("{"):
+                continue
+            try:
+                inner = json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+            rows.extend(_rows_from_obj(inner, source))
+        if not rows and isinstance(obj.get("parsed"), dict):
+            p = obj["parsed"]
+            if _num(p.get("value")) is not None:
+                rows.append(CorpusRow(
+                    samples_per_sec=float(p["value"]),
+                    source=source,
+                ))
+        return rows
+    # MESHSCALE archive: partial mesh_rows (no telemetry block, but
+    # real measured walls on real device counts).
+    if isinstance(obj.get("mesh_rows"), list):
+        for r in obj["mesh_rows"]:
+            if not isinstance(r, dict):
+                continue
+            wall = _num(r.get("warm_fit_s")) or _num(r.get("cold_fit_s"))
+            rows.append(CorpusRow(
+                n=int(r.get("n", 0) or 0) or None,
+                dim=int(r.get("dim", 0) or 0) or None,
+                devices=int(r.get("mesh_devices", 0) or 0) or None,
+                backend=r.get("platform"),
+                mode=r.get("mode"),
+                merge=r.get("merge"),
+                wall_s=wall,
+                build_s=_num(r.get("partition_s")),
+                samples_per_sec=_num(r.get("warm_pts_per_sec_total")),
+                source=source,
+            ))
+        return rows
+    return rows
+
+
+def local_corpus_path() -> Optional[str]:
+    """The local auto-fit archive path (``PYPARDIS_TUNE_CORPUS``).
+
+    Default: ``~/.cache/pypardis_tpu/tuning_corpus.jsonl``.  Set the
+    env var to a path to relocate it, or to ``0``/empty to disable the
+    feedback loop entirely (auto fits then plan from the committed
+    archives and heuristics alone).
+    """
+    env = os.environ.get("PYPARDIS_TUNE_CORPUS")
+    if env is not None:
+        if env in ("", "0"):
+            return None
+        return env
+    return os.path.join(
+        os.path.expanduser("~"), ".cache", "pypardis_tpu",
+        "tuning_corpus.jsonl",
+    )
+
+
+def append_local_row(row: CorpusRow, path: Optional[str] = None) -> bool:
+    """Append one auto-fit row to the local archive (atomic enough:
+    one ``write`` of one line in append mode).  Returns False when the
+    archive is disabled or unwritable — the feedback loop is an
+    optimization, never a fit failure."""
+    if path is None:
+        path = local_corpus_path()
+    if not path:
+        return False
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "a") as f:
+            f.write(json.dumps(row.to_dict()) + "\n")
+        return True
+    except OSError:
+        return False
+
+
+# Parsed-file cache keyed by (path, mtime, size): an auto fit
+# harvests on EVERY fit (the feedback loop), but the committed
+# archives change only on commit — re-parsing them per fit was a
+# measurable slice of the <=5% probe-overhead budget.
+_FILE_CACHE: Dict = {}
+
+
+def _rows_from_file(path: str) -> List[CorpusRow]:
+    try:
+        st = os.stat(path)
+        key = (path, st.st_mtime_ns, st.st_size)
+    except OSError:
+        return []
+    hit = _FILE_CACHE.get(path)
+    if hit is not None and hit[0] == key:
+        return hit[1]
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError:
+        return []
+    objs = []
+    try:
+        objs = [json.loads(text)]
+    except json.JSONDecodeError:
+        for ln in text.splitlines():
+            ln = ln.strip()
+            if not ln.startswith("{"):
+                continue
+            try:
+                objs.append(json.loads(ln))
+            except json.JSONDecodeError:
+                continue
+    rows: List[CorpusRow] = []
+    for obj in objs:
+        rows.extend(_rows_from_obj(obj, os.path.basename(path)))
+    _FILE_CACHE[path] = (key, rows)
+    return rows
+
+
+def harvest_corpus(
+    roots=None, *, local: Optional[str] = None, extra_files=None,
+) -> List[CorpusRow]:
+    """Harvest every reachable observed run into corpus rows.
+
+    ``roots``: directories to scan for the committed archive globs
+    (default: the current working directory — where a repo checkout
+    keeps its ``BENCH_*.json`` family — plus ``PYPARDIS_TUNE_ROOT``
+    when set).  ``local``: the auto-fit JSONL archive (default
+    :func:`local_corpus_path`).  ``extra_files``: any further JSON /
+    JSONL files.  Unreadable or unparseable files are skipped — the
+    corpus harvests what exists, it never fails a fit.  Parsed
+    archives are cached per (mtime, size), so the per-fit harvest of
+    an auto model costs a handful of ``stat`` calls.
+    """
+    if roots is None:
+        roots = [os.getcwd()]
+        env_root = os.environ.get("PYPARDIS_TUNE_ROOT")
+        if env_root:
+            roots.append(env_root)
+    files: List[str] = []
+    for root in roots:
+        for pat in _ARCHIVE_GLOBS:
+            files.extend(sorted(glob.glob(os.path.join(root, pat))))
+    if extra_files:
+        files.extend(extra_files)
+    rows: List[CorpusRow] = []
+    for path in files:
+        rows.extend(_rows_from_file(path))
+    lpath = local if local is not None else local_corpus_path()
+    if lpath and os.path.exists(lpath):
+        try:
+            with open(lpath) as f:
+                for ln in f:
+                    ln = ln.strip()
+                    if not ln:
+                        continue
+                    try:
+                        d = json.loads(ln)
+                    except json.JSONDecodeError:
+                        continue  # torn final line of a killed writer
+                    rows.append(CorpusRow.from_dict(d))
+        except OSError:
+            pass
+    return rows

@@ -1,0 +1,259 @@
+"""Bounded-cost dataset probe: the features a plan depends on.
+
+One sampling pass over the input — never the full dataset — estimating
+what the cost model needs: density around eps, predicted live
+tile-pair fraction per candidate block (the tiled kernels' own work
+model), the mixed-precision band fraction, and the memory footprint
+vs ``PYPARDIS_RSS_SOFT_LIMIT``.  Reuses the partitioner's Morton-tile
+arithmetic (:func:`~pypardis_tpu.partition._chunked_center`,
+``spatial_order``, tile boxes, box-gap live counts) so the estimates
+share the engine's own geometry, and reads memmaps in strided
+contiguous chunks so out-of-core fits can be planned without faulting
+the whole file.
+
+The tile-geometry trick that makes a SAMPLE predictive: for a full-
+data kernel block ``B``, probe the sample of ``S`` rows at block
+``b = max(1, B * S / n)`` — the sample then has the same tile COUNT
+``T = ceil(n / B)`` as the full run, each sample tile subsamples the
+same spatial cell the full tile covers, so its bounding box (and the
+box-gap live-pair count) estimates the full tile's directly.  Sampled
+live weights transfer as-is: est live pairs = sum(w), est fraction =
+sum(w) / T^2.
+
+Cost bound: ``PYPARDIS_TUNE_SAMPLE`` rows (default 32768) for the
+tile pass, 1024 rows for the exact pairwise density pass — both
+independent of n.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import asdict, dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+_DENSITY_ROWS = 1024
+# Relative half-width of the mixed-precision rescore band around
+# eps^2 used for the band-fraction ESTIMATE (the real band is the
+# bf16 worst-case bound from ops.precision; ~2% of eps^2 is its
+# observed magnitude on recentred data — the estimate only has to
+# rank precision modes, the kernels compute the exact band anyway).
+_BAND_REL = 0.02
+
+
+@dataclass
+class DatasetProbe:
+    """Schema'd probe result (``tune_probe@1``)."""
+
+    n: int
+    dim: int
+    eps: float
+    devices: int
+    backend: str
+    is_memmap: bool
+    dtype_bytes: int
+    dataset_bytes: int
+    sample_rows: int
+    probe_s: float
+    # Estimated within-eps neighbors per point (self included — the
+    # kernels count self-pairs too).
+    neighbors_per_point: float
+    # Fraction of ALL point pairs within eps (sampled, exact pass).
+    pair_fraction_in_eps: float
+    # Fraction of sampled pairs whose d^2 lands in the mixed-precision
+    # rescore band around eps^2.
+    pair_fraction_in_band: float
+    # Per candidate block: estimated tiles, live tile pairs, live
+    # tile-pair fraction, and the derived band fraction (band pairs /
+    # pairs examined per pass).
+    blocks: Dict[int, Dict[str, float]] = field(default_factory=dict)
+    rss_soft_limit: int = 0
+    memory_pressure: bool = False
+    # Predicted peak anonymous footprint of an in-RAM fit (staged f32
+    # slabs ~= 3x the f32 dataset: host staging + device copy + layout
+    # products), for the feasibility rules.
+    est_fit_rss_bytes: int = 0
+    schema: str = "pypardis_tpu/tune_probe@1"
+
+    def to_dict(self) -> Dict:
+        d = asdict(self)
+        d["blocks"] = {str(k): v for k, v in self.blocks.items()}
+        return d
+
+
+def _live_fraction(lo, hi, eps: float, row_cap: int = 512,
+                   col_cap: int = 1024) -> float:
+    """Live (box-gap <= eps) fraction of the tile-pair grid, from a
+    strided subsample of row and column tiles.
+
+    The engine's own ``_weights_from_boxes`` computes exact per-tile
+    counts for the work-balanced split; the probe only needs the
+    FRACTION, which is invariant under even-stride sampling
+    (Morton-adjacent tiles are spatially redundant), so capping both
+    sides bounds the pass at ``row_cap * col_cap`` box pairs per
+    candidate block regardless of n.
+    """
+    nt = len(lo)
+    rs = max(1, -(-nt // row_cap))
+    cs = max(1, -(-nt // col_cap))
+    rlo, rhi = lo[::rs], hi[::rs]
+    clo, chi = lo[::cs], hi[::cs]
+    gap = np.maximum(
+        0.0,
+        np.maximum(clo[None] - rhi[:, None], rlo[:, None] - chi[None]),
+    )
+    eps2 = np.float32(eps) ** 2
+    return float(
+        (np.sum(gap * gap, axis=-1) <= eps2).mean()
+    )
+
+
+def _sample_rows(points, n: int, k: int, target: int) -> np.ndarray:
+    """A (<=target, k) float sample in strided contiguous chunks.
+
+    Contiguous chunks keep memmap reads sequential (64 seeks, not
+    ``target`` random faults); the even stride keeps the sample
+    spatially representative of the global Morton geometry.
+    """
+    if n <= target:
+        return np.asarray(points[:], dtype=np.float64, copy=True) \
+            if not isinstance(points, np.ndarray) else \
+            np.array(points, dtype=np.float64, copy=True)
+    chunks = 64
+    per = max(1, target // chunks)
+    out = np.empty((per * chunks, k), np.float64)
+    stride = n / chunks
+    for c in range(chunks):
+        s = min(int(c * stride), n - per)
+        out[c * per:(c + 1) * per] = points[s:s + per]
+    return out
+
+
+def probe_dataset(
+    points,
+    eps: float,
+    *,
+    blocks=(128, 256, 512, 1024),
+    devices: Optional[int] = None,
+    backend: Optional[str] = None,
+    sample_rows: Optional[int] = None,
+) -> DatasetProbe:
+    """Estimate the plan-relevant features of ``points`` at ``eps``.
+
+    ``eps`` is the KERNEL-frame threshold (the caller remaps cosine/
+    haversine before probing, exactly as the fit does).  ``blocks``
+    are the candidate kernel blocks the planner will score.
+    """
+    from ..obs.resources import (
+        host_rss_bytes, memory_pressure, rss_soft_limit,
+    )
+    from ..partition import (
+        _chunked_center, _tile_boxes_inram, spatial_order,
+    )
+
+    t0 = time.perf_counter()
+    n, k = points.shape
+    if sample_rows is None:
+        env = os.environ.get("PYPARDIS_TUNE_SAMPLE")
+        if env:
+            sample_rows = int(env)
+        else:
+            # Adaptive: the probe must stay a small FRACTION of the
+            # fit, and fit wall grows with n while the probe's cost
+            # tracks the sample — n/16 keeps the ratio bounded at
+            # small n, the 32768 cap keeps it bounded at large n.
+            sample_rows = min(1 << 15, max(1 << 12, n // 16))
+    if devices is None:
+        import jax
+
+        devices = jax.device_count()
+    if backend is None:
+        import jax
+
+        backend = jax.default_backend()
+    is_memmap = isinstance(points, np.memmap)
+    dtype_bytes = int(np.dtype(points.dtype).itemsize) \
+        if np.dtype(points.dtype).kind == "f" else 8
+
+    sample = _sample_rows(points, n, k, max(int(sample_rows), 256))
+    s_rows = len(sample)
+    # The probe's own center (sample-bounded cost): fine for tile
+    # geometry — recentring only needs magnitude control, and the
+    # sample mean is within O(sigma/sqrt(S)) of the dataset mean.
+    center = _chunked_center(sample, s_rows, k)
+    sub = (sample - center).astype(np.float32)
+    order = spatial_order(sub)
+
+    # -- exact pairwise density on a small sub-sample -----------------
+    dens = sub[
+        np.linspace(0, s_rows - 1, min(s_rows, _DENSITY_ROWS)).astype(
+            np.int64
+        )
+    ].astype(np.float64)
+    # |x|^2 + |y|^2 - 2xy via one gemm (the kernels' own expansion):
+    # the naive (m, m, k) broadcast temp costs seconds at 2048 rows,
+    # the gemm milliseconds.
+    sq = np.einsum("ij,ij->i", dens, dens)
+    d2 = np.maximum(
+        sq[:, None] + sq[None, :] - 2.0 * (dens @ dens.T), 0.0
+    ).ravel()
+    eps2 = float(eps) ** 2
+    m = len(dens) * len(dens)
+    p_eps = float(np.count_nonzero(d2 <= eps2)) / m
+    p_band = float(
+        np.count_nonzero(np.abs(d2 - eps2) <= _BAND_REL * eps2)
+    ) / m
+    neighbors = p_eps * n
+
+    # -- per-block tile geometry --------------------------------------
+    block_stats: Dict[int, Dict[str, float]] = {}
+    for B in sorted({int(b) for b in blocks}):
+        if B <= 0:
+            continue
+        tiles = max(1, -(-n // B))
+        b_s = max(1, int(round(B * s_rows / n)))
+        lo, hi = _tile_boxes_inram(sub, order, b_s)
+        frac = min(1.0, _live_fraction(lo, hi, float(eps)))
+        live_pairs = frac * tiles * tiles
+        band_fraction = min(
+            1.0, p_band / frac if frac > 0 else 0.0
+        )
+        block_stats[B] = {
+            "tiles": float(tiles),
+            "live_pairs": float(live_pairs),
+            "live_pair_fraction": float(frac),
+            "band_fraction": float(band_fraction),
+        }
+
+    limit = rss_soft_limit()
+    est_rss = int(3 * n * k * 4) + host_rss_bytes()
+    return DatasetProbe(
+        n=int(n),
+        dim=int(k),
+        eps=float(eps),
+        devices=int(devices),
+        backend=str(backend),
+        is_memmap=bool(is_memmap),
+        dtype_bytes=dtype_bytes,
+        dataset_bytes=int(n * k * dtype_bytes),
+        sample_rows=int(s_rows),
+        probe_s=float(time.perf_counter() - t0),
+        neighbors_per_point=float(neighbors),
+        pair_fraction_in_eps=p_eps,
+        pair_fraction_in_band=p_band,
+        blocks=block_stats,
+        rss_soft_limit=int(limit),
+        memory_pressure=bool(memory_pressure()),
+        est_fit_rss_bytes=est_rss,
+    )
+
+
+def candidate_blocks(n: int, base=(128, 256, 512, 1024)) -> List[int]:
+    """The block lattice clamped to the dataset (a block above n/2
+    degenerates to one tile — keep one such candidate at most)."""
+    from ..utils import clamp_block
+
+    out = sorted({int(clamp_block(b, n)) for b in base})
+    return out or [128]

@@ -81,6 +81,25 @@ def check_metric(metric, eps=None) -> str:
     if callable(metric):
         name = getattr(metric, "__name__", str(metric))
     name = str(name).lower()
+    if name == "haversine":
+        # Driver metric for trajectories: (lat, lon) radians embed
+        # onto the 3-D unit sphere and the great-circle eps remaps to
+        # the chord ``2 sin(eps / 2)`` for the L2 kernels
+        # (geometry.latlon_to_unit_sphere).  eps is the great-circle
+        # ANGLE in radians — the sklearn haversine convention (scale
+        # by the sphere radius outside); past pi every pair qualifies,
+        # which is always a spec bug (degrees passed as radians, most
+        # likely).
+        if eps is not None and isinstance(
+            eps, (int, float, np.floating)
+        ) and np.isfinite(eps) and not 0 < eps <= np.pi:
+            raise ValueError(
+                f"metric='haversine' thresholds the great-circle "
+                f"angle in RADIANS, which lies in [0, pi]; eps must "
+                f"be in (0, pi], got {eps} (degrees instead of "
+                f"radians?)"
+            )
+        return "haversine"
     if name in ("cosine", "angular"):
         if eps is not None and isinstance(
             eps, (int, float, np.floating)

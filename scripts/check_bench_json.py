@@ -497,6 +497,71 @@ def main() -> None:
             if not isinstance(sw.get(key), int):
                 fail(f"telemetry.sweep.{key} is {sw.get(key)!r}")
 
+    # Auto-tuning contract (ISSUE 14): a tune row must carry the plan
+    # (all five knobs), FINITE predicted per-phase seconds, a probe
+    # overhead within the 5% budget, proof that auto-vs-explicit
+    # labels were byte-identical, and a >= 6-point measured lattice
+    # with the planned config inside the 1.25x envelope of its best.
+    if str(row["metric"]).startswith("tune"):
+        if row.get("schema") != "pypardis_tpu/tune@1":
+            fail(f"tune row schema is {row.get('schema')!r}")
+        tn = tel.get("tune")
+        if not isinstance(tn, dict):
+            fail("tune row without telemetry.tune block")
+        plan = row.get("plan")
+        if not isinstance(plan, dict) or not isinstance(
+            plan.get("config"), dict
+        ):
+            fail(f"tune row.plan is {plan!r}")
+        for knob in ("mode", "block", "precision", "merge",
+                     "dispatch"):
+            if plan["config"].get(knob) in (None, ""):
+                fail(f"tune plan missing knob {knob!r}")
+        pred = row.get("predicted_phases")
+        if not isinstance(pred, dict):
+            fail(f"tune row.predicted_phases is {pred!r}")
+        for key in ("build_s", "exchange_s", "compute_s", "merge_s",
+                    "total_s"):
+            v = pred.get(key)
+            if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                    or v != v or v in (float("inf"), float("-inf")) \
+                    or v < 0:
+                fail(
+                    f"tune predicted_phases.{key} is {v!r}, expected "
+                    f"a finite number >= 0"
+                )
+        pof = row.get("probe_overhead_fraction")
+        if not isinstance(pof, (int, float)) or isinstance(pof, bool) \
+                or pof != pof or not 0 <= pof <= 0.05:
+            fail(
+                f"tune probe_overhead_fraction is {pof!r}, expected a "
+                f"finite number in [0, 0.05]"
+            )
+        if row.get("labels_match") is not True:
+            fail(
+                "tune row labels_match is not True — auto labels must "
+                "be byte-identical to the same explicit config"
+            )
+        lat = row.get("lattice")
+        if not isinstance(lat, list) or len(lat) < 6:
+            fail(
+                f"tune lattice has {len(lat) if isinstance(lat, list) else lat!r} "
+                f"point(s), need >= 6 measured configs"
+            )
+        for i, e in enumerate(lat):
+            w = e.get("wall_s") if isinstance(e, dict) else None
+            if not isinstance(w, (int, float)) or isinstance(w, bool) \
+                    or w != w or w <= 0:
+                fail(f"tune lattice[{i}].wall_s is {w!r}")
+        v = row.get("value")
+        if not isinstance(v, (int, float)) or v != v or v <= 0:
+            fail(f"tune value is {v!r}")
+        if v > 1.25:
+            fail(
+                f"tune planned config measured {v}x the best lattice "
+                f"config (gate: 1.25x)"
+            )
+
     # Regression-gate contract (ISSUE 6): rows produced under `make
     # bench-smoke` ride through bench_diff --annotate first; the
     # verdict must be present and must not be a real regression.

@@ -1,0 +1,214 @@
+#!/usr/bin/env python
+"""CI probe for the auto-tuning subsystem (ISSUE 14).
+
+Warms the candidate configs (jit compiles are a fixed process cost,
+not the planning quality under test), then:
+
+1. runs a measured ``DBSCAN(auto=True)`` fit — the probe/plan overhead
+   and the planned config come from its ``report()["tune"]`` block;
+2. measures a >= 6-point config lattice (mode x block, merge=host) of
+   EXPLICIT fits on the same geometry, best-of-2 each, cold staging —
+   the planned config added if the grid missed it;
+3. gates, enforced here (nonzero exit) and re-checked by
+   ``scripts/check_bench_json.py``:
+
+   * planned config's measured wall <= 1.25x the best lattice config;
+   * probe + plan overhead <= 5% of the auto fit's wall;
+   * auto labels BYTE-IDENTICAL to the same explicit config;
+   * every predicted phase finite.
+
+Emits ONE bench-style JSON row (schema ``pypardis_tpu/tune@1``):
+``metric="tune_planned_within"``, ``value`` = planned wall / best
+lattice wall, the plan + predicted-vs-actual phases, the measured
+lattice, probe overhead, and the auto fit's full ``run_report@1``
+telemetry (with its ``tune`` block).  Geometry via env: TUNE_N
+(default 120000 — large enough that the bounded probe is a small
+fraction of the fit), TUNE_DIM (8), TUNE_EPS (0.9), TUNE_BLOCKS
+(128,256,512).
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+_N_DEV = int(os.environ.get("PYPARDIS_PROBE_DEVICES", "8"))
+if os.environ.get("PYPARDIS_PROBE_PLATFORM") != "native":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={_N_DEV}"
+        ).strip()
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+
+if os.environ.get("PYPARDIS_PROBE_PLATFORM") != "native":
+    jax.config.update("jax_platforms", "cpu")
+    if "jax_num_cpu_devices" in jax.config._value_holders:
+        jax.config.update("jax_num_cpu_devices", _N_DEV)
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _explicit_kw(cfg):
+    kw = dict(block=int(cfg["block"]), precision=cfg["precision"])
+    if cfg.get("mode") and cfg["mode"] != "auto":
+        kw["mode"] = cfg["mode"]
+    if cfg.get("merge") and cfg["merge"] != "auto":
+        kw["merge"] = cfg["merge"]
+    return kw
+
+
+def main() -> None:
+    from benchdata import ari_vs_truth, make_blob_data
+    from pypardis_tpu import DBSCAN
+    from pypardis_tpu.parallel import default_mesh, staging
+
+    n = int(os.environ.get("TUNE_N", 120000))
+    dim = int(os.environ.get("TUNE_DIM", 8))
+    eps = float(os.environ.get("TUNE_EPS", 0.9))
+    ms = 5
+    lattice_blocks = [
+        int(b) for b in os.environ.get(
+            "TUNE_BLOCKS", "128,256,512"
+        ).split(",")
+    ]
+    X, truth = make_blob_data(n, dim, seed=7)
+    mesh = default_mesh(min(_N_DEV, jax.device_count()))
+    # Isolated feedback archive: the probe must not read or mutate the
+    # operator's local corpus (the committed archives still harvest).
+    corpus = os.path.join(
+        tempfile.mkdtemp(prefix="pypardis_tune_probe_"),
+        "corpus.jsonl",
+    )
+    base_kw = dict(min_samples=ms, mesh=mesh)
+
+    # -- warm-up (compiles for auto + every lattice config) -----------
+    DBSCAN(eps=eps, auto=True, tune_corpus=corpus, **base_kw).fit(X)
+    lattice_cfgs = [
+        {"mode": mode, "block": b, "precision": "high",
+         "merge": "host", "dispatch": "auto"}
+        for mode in ("kd", "global_morton") for b in lattice_blocks
+    ]
+    for cfg in lattice_cfgs:
+        DBSCAN(eps=eps, **_explicit_kw(cfg), **base_kw).fit(X)
+
+    # -- measured auto fit --------------------------------------------
+    staging.clear()
+    model = DBSCAN(eps=eps, auto=True, tune_corpus=corpus, **base_kw)
+    t0 = time.perf_counter()
+    model.fit(X)
+    auto_wall = time.perf_counter() - t0
+    tel = model.report()
+    tune = tel["tune"]
+    plan_cfg = dict(tune["plan"]["config"])
+    overhead = float(tune["plan_s"])  # probe + harvest + scoring
+    overhead_fraction = overhead / max(auto_wall, 1e-9)
+    ari = ari_vs_truth(np.asarray(model.labels_), truth)
+
+    # -- auto vs explicit byte parity ---------------------------------
+    ref = DBSCAN(eps=eps, **_explicit_kw(plan_cfg), **base_kw)
+    old_disp = os.environ.get("PYPARDIS_DISPATCH")
+    os.environ["PYPARDIS_DISPATCH"] = str(plan_cfg["dispatch"])
+    try:
+        ref.fit(X)
+    finally:
+        if old_disp is None:
+            os.environ.pop("PYPARDIS_DISPATCH", None)
+        else:
+            os.environ["PYPARDIS_DISPATCH"] = old_disp
+    labels_match = bool(
+        np.array_equal(np.asarray(model.labels_),
+                       np.asarray(ref.labels_))
+    )
+    assert labels_match, (
+        "auto labels differ from the same explicit config"
+    )
+
+    # -- measured lattice (planned config included) -------------------
+    if not any(
+        all(c[k] == plan_cfg[k] for k in ("mode", "block", "merge",
+                                          "precision"))
+        for c in lattice_cfgs
+    ):
+        lattice_cfgs.append(dict(plan_cfg))
+        DBSCAN(eps=eps, **_explicit_kw(plan_cfg), **base_kw).fit(X)
+    lattice = []
+    for cfg in lattice_cfgs:
+        walls = []
+        for _rep in range(2):
+            staging.clear()
+            m = DBSCAN(eps=eps, **_explicit_kw(cfg), **base_kw)
+            t0 = time.perf_counter()
+            m.fit(X)
+            walls.append(time.perf_counter() - t0)
+        lattice.append({
+            "config": cfg,
+            "wall_s": round(min(walls), 4),
+            "samples_s": [round(w, 4) for w in walls],
+        })
+    assert len(lattice) >= 6, f"lattice has {len(lattice)} points"
+    best = min(lattice, key=lambda e: e["wall_s"])
+    planned_entry = min(
+        (
+            e for e in lattice
+            if all(
+                e["config"][k] == plan_cfg[k]
+                for k in ("mode", "block", "merge", "precision")
+            )
+        ),
+        key=lambda e: e["wall_s"],
+        default=None,
+    )
+    assert planned_entry is not None, "planned config missing from lattice"
+    within = planned_entry["wall_s"] / max(best["wall_s"], 1e-9)
+
+    # -- gates --------------------------------------------------------
+    assert within <= 1.25, (
+        f"planned config {plan_cfg} measured {planned_entry['wall_s']}s"
+        f" — {within:.2f}x the best lattice config "
+        f"{best['config']} at {best['wall_s']}s"
+    )
+    assert overhead_fraction <= 0.05, (
+        f"probe+plan overhead {overhead:.3f}s is "
+        f"{overhead_fraction:.1%} of the {auto_wall:.3f}s auto fit "
+        f"(gate: 5%)"
+    )
+    for k, v in tune["predicted_phases"].items():
+        assert np.isfinite(v), f"predicted {k} is {v}"
+
+    row = {
+        "metric": "tune_planned_within",
+        "value": round(within, 4),
+        "unit": "x",
+        "schema": "pypardis_tpu/tune@1",
+        "n": n,
+        "dim": dim,
+        "eps": eps,
+        "mesh_devices": int(mesh.devices.size),
+        "plan": tune["plan"],
+        "predicted_phases": tune["predicted_phases"],
+        "actual_phases": tune["actual_phases"],
+        "probe_overhead_s": round(overhead, 4),
+        "probe_overhead_fraction": round(overhead_fraction, 5),
+        "auto_wall_s": round(auto_wall, 4),
+        "planned_wall_s": planned_entry["wall_s"],
+        "best_wall_s": best["wall_s"],
+        "best_config": best["config"],
+        "labels_match": labels_match,
+        "corpus_rows": int(tune["corpus_rows"]),
+        "lattice": lattice,
+        "samples_s": [planned_entry["wall_s"]],
+        "ari_vs_truth": ari,
+        "telemetry": tel,
+    }
+    print(json.dumps(row))
+
+
+if __name__ == "__main__":
+    main()

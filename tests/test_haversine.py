@@ -1,0 +1,199 @@
+"""metric="haversine" for trajectories (ISSUE 14 satellite).
+
+(lat, lon)-radian rows embed onto the 3-D unit sphere and the
+great-circle eps remaps to the chord ``2 sin(eps/2)`` for the L2
+kernels — the PR 13 cosine machinery with a different projection.
+The correctness bar mirrors the cosine one: fit pinned BITWISE against
+a brute-force numpy haversine oracle, predict bitwise against the
+index oracle, save/load round trip serves identically (projection
+metadata persisted), sweeps ride the cached graph, validation rejects
+out-of-range eps loudly.
+"""
+
+import numpy as np
+import pytest
+
+from pypardis_tpu import DBSCAN
+from pypardis_tpu.geometry import latlon_to_unit_sphere
+from pypardis_tpu.parallel import default_mesh
+
+EPS = 0.05  # radians of great-circle arc
+MS = 5
+
+
+@pytest.fixture(scope="module")
+def trajectories():
+    """GeoLife-like clusters of (lat, lon) radian points: dense stop
+    clusters at well-separated locations (BASELINE config 3's shape),
+    longitudes spanning the dateline-free band."""
+    rng = np.random.default_rng(11)
+    centers = np.column_stack([
+        rng.uniform(-1.2, 1.2, 6), rng.uniform(-2.8, 2.8, 6)
+    ])
+    return np.concatenate([
+        c + rng.normal(scale=0.008, size=(130, 2)) for c in centers
+    ])
+
+
+def _haversine_adj(X, eps):
+    """f64 numpy haversine adjacency (the standard two-sin formula)."""
+    lat, lon = X[:, 0], X[:, 1]
+    dlat = lat[:, None] - lat[None, :]
+    dlon = lon[:, None] - lon[None, :]
+    h = (
+        np.sin(dlat / 2.0) ** 2
+        + np.cos(lat[:, None]) * np.cos(lat[None, :])
+        * np.sin(dlon / 2.0) ** 2
+    )
+    theta = 2.0 * np.arcsin(np.sqrt(np.clip(h, 0.0, 1.0)))
+    return theta <= eps
+
+
+def _oracle(X, eps, ms):
+    """Brute-force haversine DBSCAN, parallel formulation
+    (min-core-index components, border = min adjacent root)."""
+    import collections
+
+    from pypardis_tpu.ops.labels import densify_labels
+
+    adj = _haversine_adj(X, eps)
+    core = adj.sum(1) >= ms
+    n = len(X)
+    comp = np.full(n, -1)
+    cid = 0
+    for i in range(n):
+        if core[i] and comp[i] < 0:
+            q = collections.deque([i])
+            comp[i] = cid
+            while q:
+                u = q.popleft()
+                for v in np.flatnonzero(adj[u] & core):
+                    if comp[v] < 0:
+                        comp[v] = cid
+                        q.append(v)
+            cid += 1
+    roots = np.full(cid, n)
+    for i in np.flatnonzero(core):
+        roots[comp[i]] = min(roots[comp[i]], i)
+    lab = np.full(n, -1, np.int64)
+    for i in range(n):
+        if core[i]:
+            lab[i] = roots[comp[i]]
+        else:
+            nbr = np.flatnonzero(adj[i] & core)
+            if len(nbr):
+                lab[i] = min(roots[comp[j]] for j in nbr)
+    return densify_labels(lab), core
+
+
+def _canon(labels, core):
+    from pypardis_tpu.ops.labels import densify_labels
+    from pypardis_tpu.parallel.sharded import _canonicalize_roots
+
+    return densify_labels(
+        _canonicalize_roots(np.asarray(labels), np.asarray(core))
+    )
+
+
+def test_embedding_is_exact_chord_frame(trajectories):
+    """The unit-sphere embedding's chord distances reproduce the
+    haversine angles: |e(a) - e(b)| == 2 sin(theta/2) to f64 accuracy,
+    so the eps remap is a pure monotone re-threshold."""
+    X = trajectories[:100]
+    E = latlon_to_unit_sphere(X)
+    assert E.shape == (100, 3)
+    np.testing.assert_allclose(
+        np.linalg.norm(E, axis=1), 1.0, atol=1e-12
+    )
+    adj = _haversine_adj(X, EPS)
+    chord2 = np.sum((E[:, None, :] - E[None, :, :]) ** 2, axis=-1)
+    kernel_eps = 2.0 * np.sin(EPS / 2.0)
+    agree = (chord2 <= kernel_eps ** 2) == adj
+    assert agree.mean() > 0.9999  # only exact-threshold ties may differ
+
+
+def test_fit_pinned_against_numpy_oracle(trajectories):
+    X = trajectories
+    m = DBSCAN(eps=EPS, min_samples=MS, metric="haversine", block=128)
+    m.fit(X)
+    ol, oc = _oracle(X, EPS, MS)
+    np.testing.assert_array_equal(
+        _canon(m.labels_, m.core_sample_mask_), ol
+    )
+    np.testing.assert_array_equal(np.asarray(m.core_sample_mask_), oc)
+    # user-facing spec survives the kernel-frame swap
+    assert m.metric == "haversine" and m.eps == EPS
+    assert m.report()["params"]["metric"] == "haversine"
+    # model.data is the embedded kernel frame every surface shares
+    assert m.data.shape == (len(X), 3)
+
+
+def test_sharded_modes_match_oracle(trajectories):
+    X = trajectories
+    ol, _ = _oracle(X, EPS, MS)
+    for kw in (
+        dict(mesh=default_mesh(8)),
+        dict(mesh=default_mesh(8), mode="global_morton"),
+    ):
+        m = DBSCAN(
+            eps=EPS, min_samples=MS, metric="haversine", block=128,
+            **kw,
+        )
+        m.fit(X)
+        np.testing.assert_array_equal(
+            _canon(m.labels_, m.core_sample_mask_), ol,
+            err_msg=str(kw),
+        )
+
+
+def test_predict_bitwise_oracle_and_save_load(trajectories, tmp_path):
+    X = trajectories
+    rng = np.random.default_rng(1)
+    Q = X[rng.integers(0, len(X), 80)] + rng.normal(
+        scale=0.002, size=(80, 2)
+    )
+    m = DBSCAN(eps=EPS, min_samples=MS, metric="haversine", block=128)
+    m.fit(X)
+    pred = m.predict(Q)
+    olab, _ = m.query_engine().index.oracle_predict(Q)
+    np.testing.assert_array_equal(pred, olab)
+    # independent f64 haversine membership check
+    cores = np.asarray(m.core_sample_mask_)
+    within = _haversine_adj(
+        np.concatenate([Q, X]), EPS
+    )[:len(Q), len(Q):][:, cores].any(1)
+    assert ((pred >= 0) == within).mean() > 0.99
+    path = str(tmp_path / "hav_model.npz")
+    m.save(path)
+    m2 = DBSCAN.load(path)
+    assert m2.metric == "haversine"
+    np.testing.assert_array_equal(m2.predict(Q), pred)
+    # the restored engine still projects (lat, lon) queries
+    assert m2.query_engine().index.projection == "latlon"
+
+
+def test_sweep_rides_cached_graph(trajectories):
+    X = trajectories
+    kw = dict(metric="haversine", block=128, mesh=default_mesh(1))
+    m = DBSCAN(eps=EPS, min_samples=MS, **kw)
+    res = m.sweep(X, [0.03, 0.06])
+    assert res.stats["distance_passes"] == 1
+    for eps in (0.03, 0.06):
+        ref = DBSCAN(eps=eps, min_samples=MS, **kw).fit(X)
+        np.testing.assert_array_equal(
+            res.labels(eps), ref.labels_, err_msg=str(eps)
+        )
+
+
+def test_validation():
+    with pytest.raises(ValueError):
+        DBSCAN(eps=4.0, metric="haversine")  # radians, not degrees
+    m = DBSCAN(eps=0.1, min_samples=2, metric="haversine")
+    with pytest.raises(ValueError):
+        m.fit(np.zeros((4, 3)))  # needs (N, 2) lat/lon
+    with pytest.raises(ValueError):
+        m.fit(np.array([[0.1, np.nan]]))
+    with pytest.raises(NotImplementedError):
+        m.fit(np.random.default_rng(0).normal(
+            scale=0.01, size=(8, 2)
+        )).live()

@@ -338,3 +338,68 @@ def test_cosine_validation():
         m.fit(np.array([[1.0, 0.0], [0.0, 0.0]]))  # zero vector
     with pytest.raises(NotImplementedError):
         m.fit(np.eye(3)).live()  # live updates not yet supported
+
+
+# -- device-route edge-budget ladder (the PR 13 NOTE debt) --------------
+#
+# On CPU the sweep graph auto-routes to host compaction, so the device
+# emission's exact-total budget ladder ran untested until the
+# PYPARDIS_SWEEP_EMISSION override landed (ISSUE 14 satellite): force
+# the device route on the CI mesh, undersize the initial edge budget,
+# and pin (a) the ladder's one-retry recovery with byte-exact labels,
+# (b) the hard-cap overflow degrading label-safely to refits.
+
+
+def test_device_route_ladder_retries_and_recovers(blobs, monkeypatch):
+    """Undersized edge budget on the forced device route: exactly one
+    pair_overflow event, then the exact-total retry sizes the slab and
+    labels stay byte-identical to the host-route sweep."""
+    kw = dict(block=128, mesh=default_mesh(8))
+    staging.clear()
+    ref = DBSCAN(eps=0.4, min_samples=5, **kw).sweep(blobs, EPS_LIST)
+
+    monkeypatch.setenv("PYPARDIS_SWEEP_EMISSION", "device")
+    monkeypatch.setenv("PYPARDIS_SWEEP_EDGE_BUDGET", "4096")
+    staging.clear()
+    m = DBSCAN(eps=0.4, min_samples=5, **kw)
+    res = m.sweep(blobs, EPS_LIST)
+    rep = m.report()
+    assert rep["sweep"]["degraded"] is None
+    assert rep["events"]["pair_overflow"] >= 1  # the ladder fired
+    for eps in EPS_LIST:
+        np.testing.assert_array_equal(
+            res.labels(eps), ref.labels(eps), err_msg=str(eps)
+        )
+        np.testing.assert_array_equal(
+            res.core(eps), ref.core(eps), err_msg=str(eps)
+        )
+
+
+def test_device_route_cap_overflow_degrades(blobs, monkeypatch):
+    """The hard PYPARDIS_SWEEP_MAX_PAIRS cap on the device route:
+    SweepGraphOverflow -> label-safe per-config refits, telemetry
+    honest about the degradation."""
+    monkeypatch.setenv("PYPARDIS_SWEEP_EMISSION", "device")
+    monkeypatch.setenv("PYPARDIS_SWEEP_MAX_PAIRS", "64")
+    staging.clear()
+    kw = dict(block=128, mesh=default_mesh(8))
+    m = DBSCAN(eps=0.4, min_samples=5, **kw)
+    res = m.sweep(blobs, EPS_LIST)
+    assert res.stats["degraded"] == "per_config_refit"
+    assert m.report()["events"]["degraded"] >= 1
+    _assert_parity(blobs, res, "device-cap", **kw)
+
+
+def test_fused_device_route_parity(blobs, monkeypatch):
+    """The fused (1-device) sweep's device emission path, forced on
+    CPU: byte parity with the auto (host-compaction) route."""
+    kw = dict(block=128, mesh=default_mesh(1))
+    staging.clear()
+    ref = DBSCAN(eps=0.4, min_samples=5, **kw).sweep(blobs, EPS_LIST)
+    monkeypatch.setenv("PYPARDIS_SWEEP_EMISSION", "device")
+    staging.clear()
+    res = DBSCAN(eps=0.4, min_samples=5, **kw).sweep(blobs, EPS_LIST)
+    for eps in EPS_LIST:
+        np.testing.assert_array_equal(
+            res.labels(eps), ref.labels(eps), err_msg=str(eps)
+        )

@@ -1,0 +1,376 @@
+"""Auto-tuning subsystem (ISSUE 14).
+
+The correctness bar: ``DBSCAN(auto=True)`` labels BYTE-IDENTICAL to
+the same explicit config (every planned knob is label-safe by
+construction) on the fused, KD owner-computes, and global-Morton
+geometries; user-pinned knobs never overridden; each hard feasibility
+rule (memmap -> streaming GM, 1 device -> chained/fused, RSS pressure
+-> merge=host) deterministic; the corpus harvest / cost-model fit /
+plan checkpoint round-trip all pinned.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+from sklearn.datasets import make_blobs
+
+from pypardis_tpu import DBSCAN
+from pypardis_tpu.parallel import default_mesh, staging
+from pypardis_tpu.tune import (
+    CostModel,
+    CorpusRow,
+    TunePlan,
+    harvest_corpus,
+    plan_fit,
+    probe_dataset,
+    row_from_report,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _isolated_corpus(monkeypatch, tmp_path):
+    """Never read the developer's local archive or write ~/.cache from
+    tests; each test gets a throwaway corpus file."""
+    monkeypatch.setenv(
+        "PYPARDIS_TUNE_CORPUS", str(tmp_path / "corpus.jsonl")
+    )
+
+
+@pytest.fixture(scope="module")
+def blobs():
+    X, _ = make_blobs(
+        n_samples=1600, centers=5, n_features=4, cluster_std=0.3,
+        random_state=3,
+    )
+    return X
+
+
+def _explicit(X, proto, cfg, **kw):
+    """An explicit fit at exactly the planned config."""
+    kw = dict(kw)
+    if cfg.get("mode") and cfg["mode"] != "auto":
+        kw["mode"] = cfg["mode"]
+    if cfg.get("merge") and cfg["merge"] != "auto":
+        kw["merge"] = cfg["merge"]
+    m = DBSCAN(
+        eps=proto.eps, min_samples=proto.min_samples,
+        block=cfg["block"], precision=cfg["precision"], **kw,
+    )
+    old = os.environ.get("PYPARDIS_DISPATCH")
+    os.environ["PYPARDIS_DISPATCH"] = str(cfg["dispatch"])
+    try:
+        m.fit(X)
+    finally:
+        if old is None:
+            os.environ.pop("PYPARDIS_DISPATCH", None)
+        else:
+            os.environ["PYPARDIS_DISPATCH"] = old
+    return m
+
+
+# -- corpus -------------------------------------------------------------
+
+
+def test_harvest_committed_archives():
+    rows = harvest_corpus(roots=[_REPO], local="")
+    assert len(rows) >= 8, [r.source for r in rows]
+    assert all(r.schema.endswith("tuning_corpus@1") for r in rows)
+    # The northstar row is a FULL row: config + phase decomposition.
+    ns = [r for r in rows if r.source.startswith("NORTHSTAR")]
+    assert ns and ns[0].complete_for_compute()
+    assert ns[0].mode == "global_morton"
+    assert ns[0].exchange_s and ns[0].merge_s
+
+
+def test_row_from_report_and_local_roundtrip(blobs, tmp_path):
+    m = DBSCAN(eps=0.4, min_samples=5, block=128).fit(blobs)
+    row = row_from_report(m.report(), source="t")
+    assert row.n == len(blobs) and row.dim == 4
+    assert row.mode in ("fused", "kd", "chained")
+    assert row.compute_s is not None and row.compute_s > 0
+    d = json.loads(json.dumps(row.to_dict()))
+    assert CorpusRow.from_dict(d).to_dict() == row.to_dict()
+
+
+# -- probe --------------------------------------------------------------
+
+
+def test_probe_features(blobs):
+    p = probe_dataset(blobs, 0.4, devices=8, backend="cpu")
+    assert p.n == len(blobs) and p.dim == 4
+    assert p.probe_s < 5.0
+    assert p.neighbors_per_point > 1
+    for b, st in p.blocks.items():
+        assert 0.0 < st["live_pair_fraction"] <= 1.0
+        assert st["tiles"] == -(-p.n // b)
+    # coarser blocks -> fewer tiles, higher live fraction
+    bs = sorted(p.blocks)
+    fr = [p.blocks[b]["live_pair_fraction"] for b in bs]
+    assert fr == sorted(fr)
+
+
+def test_probe_memmap(tmp_path):
+    X, _ = make_blobs(n_samples=4000, n_features=4, random_state=0)
+    path = str(tmp_path / "mm.f32")
+    mm = np.memmap(path, dtype=np.float32, mode="w+", shape=X.shape)
+    mm[:] = X
+    mm.flush()
+    p = probe_dataset(mm, 0.4, devices=8, backend="cpu")
+    assert p.is_memmap and p.n == 4000
+    assert p.blocks
+
+
+# -- planner feasibility rules -----------------------------------------
+
+
+def test_rule_memmap_forces_streaming_gm(tmp_path):
+    X, _ = make_blobs(n_samples=4000, n_features=4, random_state=0)
+    mm = np.memmap(
+        str(tmp_path / "mm.f32"), dtype=np.float32, mode="w+",
+        shape=X.shape,
+    )
+    mm[:] = X
+    mm.flush()
+    p = probe_dataset(mm, 0.4, devices=8, backend="cpu")
+    plan = plan_fit(p, {}, [])
+    assert plan.config["mode"] == "global_morton"
+    assert any("memmap" in r for r in plan.rules)
+
+
+def test_rule_one_device_forces_fused(blobs):
+    p = probe_dataset(blobs, 0.4, devices=1, backend="cpu")
+    plan = plan_fit(p, {}, [])
+    assert plan.config["mode"] == "auto"
+    assert plan.config["merge"] == "auto"
+    assert any("fused-or-chained" in r for r in plan.rules)
+
+
+def test_rule_rss_pressure_forces_host_merge(blobs, monkeypatch):
+    monkeypatch.setenv("PYPARDIS_RSS_SOFT_LIMIT", "1")
+    p = probe_dataset(blobs, 0.4, devices=8, backend="cpu")
+    assert p.memory_pressure
+    plan = plan_fit(p, {}, [])
+    assert plan.config["merge"] == "host"
+    assert any("RSS pressure" in r or "pressure" in r
+               for r in plan.rules)
+
+
+def test_pinned_knobs_never_overridden(blobs):
+    p = probe_dataset(blobs, 0.4, devices=8, backend="cpu",
+                      blocks=(128, 256, 512))
+    pin = {"block": 512, "precision": "highest", "mode": "kd",
+           "merge": "device", "dispatch": "dense"}
+    plan = plan_fit(p, pin, [])
+    for k, v in pin.items():
+        assert plan.config[k] == v, (k, plan.config)
+        assert "pinned" in plan.knob_reasons[k]
+    assert plan.pinned == pin
+
+
+def test_pinned_conflict_with_rule_keeps_pin(blobs, monkeypatch):
+    monkeypatch.setenv("PYPARDIS_RSS_SOFT_LIMIT", "1")
+    p = probe_dataset(blobs, 0.4, devices=8, backend="cpu")
+    plan = plan_fit(p, {"merge": "device"}, [])
+    assert plan.config["merge"] == "device"  # the user wins
+    assert any("keeping the pin" in r for r in plan.rules)
+
+
+def test_explain_names_every_knob(blobs):
+    p = probe_dataset(blobs, 0.4, devices=8, backend="cpu")
+    plan = plan_fit(p, {}, harvest_corpus(roots=[_REPO], local=""))
+    text = plan.explain()
+    for knob in ("mode", "block", "precision", "merge", "dispatch"):
+        assert knob in text
+    assert "predicted" in text and "probe" in text
+    # round-trips through the checkpoint dict form
+    p2 = TunePlan.from_dict(
+        json.loads(json.dumps(plan.to_dict()))
+    )
+    assert p2.config == plan.config
+    assert p2.explain() == text
+
+
+# -- cost model ---------------------------------------------------------
+
+
+def test_cost_model_fit_recovers_coefficients():
+    """Synthetic corpus generated from known coefficients: the
+    per-bucket least squares recovers them and predictions rank
+    configs correctly."""
+    rng = np.random.default_rng(0)
+    true_flop, true_visit = 2e-10, 5e-6
+    rows = []
+    for i in range(8):
+        pairs = int(rng.integers(1000, 100000))
+        block = int(rng.choice([128, 256, 512]))
+        passes = int(rng.integers(3, 8))
+        dim = 16
+        flops = pairs * block * block * (dim + 2) * 2.0 * passes
+        rows.append(CorpusRow(
+            n=100000, dim=dim, devices=8, backend="cpu", mode="kd",
+            block=block, precision="high", merge="host",
+            kernel_passes=passes, live_pairs=pairs,
+            compute_s=true_flop * flops + true_visit * pairs * passes,
+        ))
+    m = CostModel.fit_from_corpus(rows, "cpu", 8)
+    assert m.sources["pair_flop_s"] == "corpus"
+    assert abs(m.coef["pair_flop_s"] - true_flop) / true_flop < 0.05
+    ph = m.predict_phases(
+        n=100000, dim=16, devices=8, mode="kd", block=256,
+        precision="high", merge="host", dispatch="pair",
+        live_pairs=50000, tiles=400, passes=5,
+    )
+    assert all(v >= 0 for v in ph.values())
+    assert ph["total_s"] == pytest.approx(
+        ph["build_s"] + ph["exchange_s"] + ph["compute_s"]
+        + ph["merge_s"]
+    )
+
+
+def test_cost_model_heuristic_fallback():
+    m = CostModel.fit_from_corpus([], "cpu", 8)
+    assert all(s == "heuristic" for s in m.sources.values())
+    ph = m.predict_phases(
+        n=10000, dim=8, devices=8, mode="global_morton", block=256,
+        precision="mixed", merge="device", dispatch="dense",
+        live_pairs=1000, tiles=40, boundary_bytes=1 << 20,
+    )
+    assert ph["exchange_s"] > 0 and ph["total_s"] > 0
+
+
+# -- DBSCAN(auto=True): byte parity with the explicit config -----------
+
+
+def test_auto_fused_byte_parity(blobs):
+    m = DBSCAN(eps=0.4, min_samples=5, auto=True, mesh=default_mesh(1))
+    m.fit(blobs)
+    tune = m.report()["tune"]
+    cfg = tune["plan"]["config"]
+    assert cfg["mode"] == "auto"  # 1 device: fused engine
+    ref = _explicit(blobs, m, cfg, mesh=default_mesh(1))
+    np.testing.assert_array_equal(m.labels_, ref.labels_)
+    np.testing.assert_array_equal(
+        m.core_sample_mask_, ref.core_sample_mask_
+    )
+
+
+def test_auto_kd_byte_parity(blobs):
+    staging.clear()
+    m = DBSCAN(
+        eps=0.4, min_samples=5, auto=True, mode="kd",
+        mesh=default_mesh(8),
+    )
+    m.fit(blobs)
+    cfg = m.report()["tune"]["plan"]["config"]
+    assert cfg["mode"] == "kd"  # the pin
+    ref = _explicit(blobs, m, cfg, mesh=default_mesh(8))
+    np.testing.assert_array_equal(m.labels_, ref.labels_)
+
+
+def test_auto_global_morton_byte_parity(blobs):
+    staging.clear()
+    m = DBSCAN(
+        eps=0.4, min_samples=5, auto=True, mode="global_morton",
+        mesh=default_mesh(8),
+    )
+    m.fit(blobs)
+    cfg = m.report()["tune"]["plan"]["config"]
+    assert cfg["mode"] == "global_morton"
+    ref = _explicit(blobs, m, cfg, mesh=default_mesh(8))
+    np.testing.assert_array_equal(m.labels_, ref.labels_)
+
+
+def test_auto_unpinned_mesh_byte_parity(blobs):
+    staging.clear()
+    m = DBSCAN(eps=0.4, min_samples=5, auto=True, mesh=default_mesh(8))
+    m.fit(blobs)
+    cfg = m.report()["tune"]["plan"]["config"]
+    assert cfg["mode"] in ("kd", "global_morton")  # planner's choice
+    ref = _explicit(blobs, m, cfg, mesh=default_mesh(8))
+    np.testing.assert_array_equal(m.labels_, ref.labels_)
+    np.testing.assert_array_equal(
+        m.core_sample_mask_, ref.core_sample_mask_
+    )
+
+
+def test_auto_user_pin_survives_fit(blobs):
+    m = DBSCAN(
+        eps=0.4, min_samples=5, auto=True, block=512,
+        precision="highest", mesh=default_mesh(8),
+    )
+    m.fit(blobs)
+    assert m.block == 512 and m.precision == "highest"
+    cfg = m.report()["tune"]["plan"]["config"]
+    assert cfg["block"] == 512 and cfg["precision"] == "highest"
+
+
+def test_auto_dispatch_env_restored(blobs, monkeypatch):
+    monkeypatch.delenv("PYPARDIS_DISPATCH", raising=False)
+    m = DBSCAN(eps=0.4, min_samples=5, auto=True, mesh=default_mesh(1))
+    m.fit(blobs)
+    assert "PYPARDIS_DISPATCH" not in os.environ
+    monkeypatch.setenv("PYPARDIS_DISPATCH", "dense")
+    m2 = DBSCAN(eps=0.4, min_samples=5, auto=True,
+                mesh=default_mesh(1))
+    m2.fit(blobs)
+    # env pin respected AND restored
+    assert os.environ["PYPARDIS_DISPATCH"] == "dense"
+    assert m2.report()["tune"]["plan"]["config"]["dispatch"] == "dense"
+
+
+# -- telemetry, feedback, checkpoint ------------------------------------
+
+
+def test_auto_report_summary_and_feedback(blobs, tmp_path):
+    corpus = str(tmp_path / "corpus.jsonl")
+    m = DBSCAN(
+        eps=0.4, min_samples=5, auto=True, mesh=default_mesh(1),
+        tune_corpus=corpus,
+    )
+    m.fit(blobs)
+    tune = m.report()["tune"]
+    for key in ("plan", "explain", "probe_s", "plan_s", "corpus_rows",
+                "predicted_phases", "actual_phases",
+                "corpus_appended"):
+        assert key in tune, key
+    pred = tune["predicted_phases"]
+    for p in ("build_s", "exchange_s", "compute_s", "merge_s",
+              "total_s"):
+        assert np.isfinite(pred[p])
+    assert tune["corpus_appended"] is True
+    with open(corpus) as f:
+        lines = [json.loads(ln) for ln in f if ln.strip()]
+    assert len(lines) == 1 and lines[0]["n"] == len(blobs)
+    assert "auto plan" in m.summary()
+    # the next auto fit consumes its predecessor's row
+    m2 = DBSCAN(
+        eps=0.4, min_samples=5, auto=True, mesh=default_mesh(1),
+        tune_corpus=corpus,
+    )
+    m2.fit(blobs)
+    assert m2.report()["tune"]["corpus_rows"] > tune["corpus_rows"]
+
+
+def test_plan_survives_checkpoint(blobs, tmp_path):
+    m = DBSCAN(eps=0.4, min_samples=5, auto=True, mesh=default_mesh(1))
+    m.fit(blobs)
+    path = str(tmp_path / "auto_model.npz")
+    m.save(path)
+    m2 = DBSCAN.load(path)
+    assert m2._tune_stats is not None
+    assert m2._tune_stats["plan"]["config"] == \
+        m.report()["tune"]["plan"]["config"]
+    np.testing.assert_array_equal(m2.labels_, m.labels_)
+
+
+def test_non_auto_unchanged(blobs):
+    """auto defaults off: no probe, no tune block, classic defaults."""
+    m = DBSCAN(eps=0.4, min_samples=5)
+    assert m.block == 1024 and m.precision == "high"
+    assert m.merge == "auto" and m.mode == "auto"
+    m.fit(blobs)
+    assert "tune" not in m.report()
