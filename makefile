@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: all wheel native test verify tpu-smoke bench bench-smoke \
+.PHONY: all wheel native test verify lint tpu-smoke bench bench-smoke \
 	partition-probe serve-probe live-probe ingest-probe \
 	global-morton-probe fault-probe bench-diff flight-check \
 	northstar northstar-smoke streammem-probe sort-probe \
@@ -27,9 +27,22 @@ test:
 	$(PY) -m pytest tests/ -q -m slow
 
 # The ROADMAP tier-1 gate, verbatim (scripts/verify.sh): the fast suite
-# on the faked 8-device CPU mesh, with the pass-count echo CI scrapes.
-verify:
+# on the faked 8-device CPU mesh, with the pass-count echo CI scrapes —
+# preceded by the sub-second static-invariant gate.
+verify: lint
 	bash scripts/verify.sh
+
+# graftlint (ISSUE 15): the AST-level invariant checker — tracer-safe
+# module constants (R1), device_put aliasing discipline (R2),
+# trace-time env reads (R3), the PYPARDIS_* env registry + README
+# table sync (R4), seal_f32 FMA discipline (R5), fault-site and
+# magic-width hygiene (R6), unused imports (R7).  Stdlib-ast only
+# (never imports jax), whole repo in ~3s, zero-entry baseline;
+# `--list-rules` / `--envdocs` / `--write-baseline` for the tooling
+# surface.  Runtime is itself gated (< 10s) in tests/test_analysis.py
+# so this can never become the slow step.
+lint:
+	$(PY) scripts/graftlint.py
 
 # Hardware validation: compiles + runs the Pallas kernels through Mosaic
 # on the real chip (tests skip themselves off-TPU). Run before shipping
@@ -47,7 +60,7 @@ bench:
 # check_bench_json --require-diff fails CI on a real regression),
 # then the CI-sized partitioner depth-scaling probe (fails when the
 # level builder's mp-doubling cost ratio exceeds 1.5x).
-bench-smoke: partition-probe serve-probe live-probe ingest-probe \
+bench-smoke: lint partition-probe serve-probe live-probe ingest-probe \
 		global-morton-probe fault-probe bench-diff flight-check \
 		northstar-smoke kernel-probe sweep-probe tune-probe
 	JAX_PLATFORMS=cpu BENCH_N=2000 BENCH_DIM=4 BENCH_REPS=1 \

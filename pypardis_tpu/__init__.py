@@ -30,7 +30,9 @@ def _enable_compile_cache():
     exotic deployments may reject the config)."""
     import os
 
-    path = os.environ.get(
+    from .utils import envreg
+
+    path = envreg.raw(
         "PYPARDIS_COMPILE_CACHE",
         os.path.join(
             os.path.expanduser("~"), ".cache", "pypardis_tpu", "xla"

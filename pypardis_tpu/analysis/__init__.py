@@ -1,0 +1,31 @@
+"""graftlint — AST-level enforcement of the project's correctness
+invariants.
+
+Four of ten consecutive PRs each shipped a fix for a *latent, silent*
+violation of an unwritten project rule: PR 6's ``UnexpectedTracerError``
+from module-level ``jnp`` scalar constants, PR 13's corrupted labels
+from ``jax.device_put`` zero-copy aliasing of pooled build buffers,
+PR 11's trace-time ``os.environ`` read baked into a jitted program, and
+PR 4's ``seal_f32`` discipline against XLA FMA contraction.  This
+package turns those rules (plus env-var registration, fault-site and
+magic-width hygiene, and an unused-import sweep) into named,
+machine-checked lint gates — the correctness-tooling third leg of the
+repo's self-verification stool next to ``check_bench_json`` (telemetry
+schema) and ``bench_diff`` (perf regressions).
+
+Everything here is stdlib-``ast`` only: no jax, no numpy, no imports
+from the rest of the package at runtime (the env-var registry and the
+fault-site registry are parsed *statically* from their source files),
+so ``scripts/graftlint.py`` runs in well under a second.
+
+Surface: :func:`run_lint` (the driver), :data:`ALL_RULES`, and the
+rule classes themselves for targeted use in tests.
+"""
+
+from .base import Finding, LintContext, Rule, RULE_REGISTRY
+from .driver import LintResult, default_fileset, run_lint
+
+__all__ = [
+    "Finding", "LintContext", "Rule", "RULE_REGISTRY",
+    "LintResult", "default_fileset", "run_lint",
+]

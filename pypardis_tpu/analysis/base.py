@@ -1,0 +1,95 @@
+"""Finding / Rule model shared by every graftlint check.
+
+A rule sees one parsed :class:`~pypardis_tpu.analysis.source.SourceFile`
+at a time (``visit``) and may emit more findings once the whole fileset
+has been seen (``finalize`` — cross-file checks like the env-var
+registry and fault-site registries).  Rules register themselves into
+:data:`RULE_REGISTRY` via the :func:`register` decorator; the driver
+instantiates one of each per run, so per-run state lives on the
+instance.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple, Type
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location.
+
+    ``severity`` is ``"error"`` (fails the run) or ``"note"``
+    (report-only — e.g. unused imports in ``scripts/``, where probe
+    CLIs keep convenience imports on purpose).
+    """
+
+    rule: str
+    path: str  # repo-relative, posix separators
+    line: int
+    col: int
+    message: str
+    severity: str = "error"
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}"
+
+
+@dataclass
+class LintContext:
+    """Per-run shared state: the repo root, the statically parsed
+    registries, and a scratch dict rules use to carry per-file
+    collections into ``finalize``."""
+
+    root: str
+    env_registry: "object" = None  # analysis.envmodel.EnvRegistry
+    fault_sites: Tuple[str, ...] = ()
+    fault_sites_path: str = "pypardis_tpu/utils/faults.py"
+    shared: Dict[str, object] = field(default_factory=dict)
+
+
+class Rule:
+    """Base class: subclass, set ``name``/``issue_rule``/``doc``,
+    implement ``visit`` (and optionally ``finalize``)."""
+
+    name: str = ""
+    # The ISSUE-15 rule family this check implements (R1..R7) — one
+    # family may ship as several named rules (R6 = fault-site +
+    # magic-width).
+    issue_rule: str = ""
+    doc: str = ""
+
+    def visit(self, src, ctx: LintContext) -> List[Finding]:
+        return []
+
+    def finalize(self, ctx: LintContext) -> List[Finding]:
+        return []
+
+
+RULE_REGISTRY: Dict[str, Type[Rule]] = {}
+
+
+def register(cls: Type[Rule]) -> Type[Rule]:
+    assert cls.name and cls.name not in RULE_REGISTRY, cls
+    RULE_REGISTRY[cls.name] = cls
+    return cls
+
+
+def attr_chain(node: ast.AST) -> Optional[List[str]]:
+    """``a.b.c`` -> ``["a", "b", "c"]``; None when the chain roots in
+    anything but a plain name (calls, subscripts)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return None
+
+
+def call_name(node: ast.Call) -> str:
+    """Dotted name of a call's callee ("" when not a plain chain)."""
+    chain = attr_chain(node.func)
+    return ".".join(chain) if chain else ""

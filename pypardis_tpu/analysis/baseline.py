@@ -1,0 +1,61 @@
+"""Grandfathered-finding baseline (``graftlint_baseline@1``).
+
+The committed baseline ships with ZERO entries — every true positive
+the first full run surfaced was fixed in the PR that introduced the
+linter — but the machinery exists so a future emergency can land with
+a grandfathered finding instead of a deleted rule, and so the
+baseline's contents are reviewable in diffs (each entry carries the
+rule, path, and offending line text, not just a hash).
+
+Fingerprints hash the rule, path, and *whitespace-normalized line
+text* — NOT the line number — so unrelated edits above a grandfathered
+site don't churn the file.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Dict, List, Set, Tuple
+
+from .base import Finding
+
+FORMAT = "graftlint_baseline@1"
+
+
+def fingerprint(finding: Finding, line_text: str) -> str:
+    norm = " ".join(line_text.split())
+    blob = f"{finding.rule}|{finding.path}|{norm}"
+    return hashlib.sha1(blob.encode("utf-8")).hexdigest()[:16]
+
+
+def load(path: str) -> Set[str]:
+    if not os.path.exists(path):
+        return set()
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    if data.get("format") != FORMAT:
+        raise ValueError(
+            f"{path}: expected format {FORMAT!r}, "
+            f"got {data.get('format')!r}"
+        )
+    return {e["fingerprint"] for e in data.get("entries", [])}
+
+
+def write(path: str, items: List[Tuple[Finding, str]]) -> None:
+    """``items`` pairs each finding with its source line text."""
+    entries = [
+        {
+            "fingerprint": fingerprint(f, line),
+            "rule": f.rule,
+            "path": f.path,
+            "line_text": " ".join(line.split()),
+        }
+        for f, line in items
+    ]
+    entries.sort(key=lambda e: (e["path"], e["rule"], e["fingerprint"]))
+    data: Dict = {"format": FORMAT, "entries": entries}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")

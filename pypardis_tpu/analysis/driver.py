@@ -1,0 +1,132 @@
+"""graftlint driver: discover → parse → rules → suppressions →
+baseline → verdict.
+
+Import side effects: importing this module registers every rule
+module (the ``RULE_REGISTRY`` population is the import), nothing
+else — no jax, no package modules.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# graftlint: disable=unused-import -- importing populates RULE_REGISTRY
+from . import (
+    rules_env, rules_hygiene, rules_numerics, rules_staging,
+    rules_tracer,
+)
+from .base import Finding, LintContext, RULE_REGISTRY
+from .baseline import fingerprint as baseline_fingerprint
+from .baseline import load as baseline_load
+from .envmodel import parse_env_registry, parse_fault_sites
+from .source import SourceFile, discover_files, load_source
+
+# Rules the driver itself emits (suppressions / parse failures) — part
+# of the known-rule set so directives can reference them.
+_DRIVER_RULES = ("bad-suppression", "parse-error")
+
+
+@dataclass
+class LintResult:
+    findings: List[Finding] = field(default_factory=list)  # errors
+    notes: List[Finding] = field(default_factory=list)
+    suppressed: int = 0
+    baselined: int = 0
+    elapsed_s: float = 0.0
+    files: int = 0
+    # (finding, source line text) for --write-baseline
+    raw_pairs: List[Tuple[Finding, str]] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+
+def known_rule_names() -> Tuple[str, ...]:
+    return tuple(sorted(RULE_REGISTRY)) + _DRIVER_RULES
+
+
+def run_lint(
+    root: str,
+    paths: Optional[Sequence[str]] = None,
+    rules: Optional[Sequence[str]] = None,
+    baseline_path: Optional[str] = None,
+) -> LintResult:
+    """Run the checker.
+
+    ``paths`` overrides the default fileset (scratch-file checks in
+    tests and the acceptance gate); ``rules`` restricts to named rules
+    (fixture tests); ``baseline_path`` points at the committed
+    grandfather file (zero entries in this repo).
+    """
+    t0 = time.perf_counter()
+    result = LintResult()
+    known = set(known_rule_names())
+    if rules is not None:
+        bad = sorted(set(rules) - set(RULE_REGISTRY))
+        if bad:
+            raise ValueError(f"unknown rule(s): {', '.join(bad)}")
+    active = {
+        name: cls() for name, cls in RULE_REGISTRY.items()
+        if rules is None or name in rules
+    }
+
+    ctx = LintContext(root=root)
+    # Explicit-paths runs are PARTIAL: cross-file "declared but
+    # unused" checks can't conclude anything and skip themselves.
+    ctx.shared["partial_run"] = paths is not None
+    ctx.env_registry = parse_env_registry(root)
+    sites, site_lines = parse_fault_sites(root)
+    ctx.fault_sites = sites
+    ctx.shared["fault_site_lines"] = site_lines
+
+    files = list(paths) if paths is not None else discover_files(root)
+    result.files = len(files)
+    sources: Dict[str, SourceFile] = {}
+    collected: List[Tuple[Finding, SourceFile]] = []
+    for path in files:
+        src = load_source(path, root, known)
+        sources[src.rel] = src
+        if src.parse_error is not None:
+            collected.append((src.parse_error, src))
+            continue
+        for f in src.suppression_findings:
+            collected.append((f, src))
+        for rule in active.values():
+            for f in rule.visit(src, ctx):
+                collected.append((f, src))
+    for rule in active.values():
+        for f in rule.finalize(ctx):
+            collected.append((f, sources.get(f.path)))
+
+    baseline = (
+        baseline_load(baseline_path) if baseline_path else set()
+    )
+    for f, src in collected:
+        line_text = ""
+        if src is not None and 0 < f.line <= len(src.lines):
+            line_text = src.lines[f.line - 1]
+        if src is not None and f.rule in src.suppressions.get(
+            f.line, ()
+        ):
+            result.suppressed += 1
+            continue
+        if f.severity == "note":
+            result.notes.append(f)
+            continue
+        result.raw_pairs.append((f, line_text))
+        if baseline and baseline_fingerprint(f, line_text) in baseline:
+            result.baselined += 1
+            continue
+        result.findings.append(f)
+
+    result.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    result.notes.sort(key=lambda f: (f.path, f.line, f.rule))
+    result.elapsed_s = time.perf_counter() - t0
+    return result
+
+
+def default_fileset(root: str) -> List[str]:
+    return discover_files(root)

@@ -1,0 +1,130 @@
+"""Static models of the two in-repo registries graftlint enforces
+against: the env-var registry (``utils/envreg.py``) and the
+fault-injection site registry (``utils/faults.py``).
+
+Parsed with ``ast`` from source — never imported — so the linter stays
+jax-free and sub-second, and a syntactically broken registry is itself
+a loud lint failure rather than an import-time crash.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class EnvEntry:
+    name: str
+    type: str
+    default: str
+    doc: str
+
+
+@dataclass
+class EnvRegistry:
+    path: str  # repo-relative
+    entries: Tuple[EnvEntry, ...]
+
+    @property
+    def names(self) -> Tuple[str, ...]:
+        return tuple(e.name for e in self.entries)
+
+    def render_markdown(self) -> str:
+        """Byte-identical to ``envreg.render_markdown()`` — asserted
+        by tests/test_analysis.py so the static and runtime renderers
+        cannot drift."""
+        lines = [
+            "| Variable | Type | Default | Meaning |",
+            "| --- | --- | --- | --- |",
+        ]
+        for e in self.entries:
+            doc = " ".join(e.doc.split())
+            lines.append(
+                f"| `{e.name}` | {e.type} | `{e.default}` | {doc} |"
+            )
+        return "\n".join(lines) + "\n"
+
+
+def _const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def parse_env_registry(root: str) -> EnvRegistry:
+    """Extract the ``_DECLARATIONS`` tuple of ``EnvVar(...)`` literal
+    calls.  Non-literal fields raise — the registry is declared data,
+    not code."""
+    rel = "pypardis_tpu/utils/envreg.py"
+    path = os.path.join(root, rel)
+    with open(path, "r", encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=rel)
+    entries: List[EnvEntry] = []
+    for node in tree.body:
+        if not (isinstance(node, (ast.Assign, ast.AnnAssign))):
+            continue
+        targets = (
+            node.targets if isinstance(node, ast.Assign)
+            else [node.target]
+        )
+        if not any(isinstance(t, ast.Name) and t.id == "_DECLARATIONS"
+                   for t in targets):
+            continue
+        value = node.value
+        if not isinstance(value, ast.Tuple):
+            raise ValueError(f"{rel}: _DECLARATIONS must be a tuple")
+        for elt in value.elts:
+            if not (isinstance(elt, ast.Call)
+                    and isinstance(elt.func, ast.Name)
+                    and elt.func.id == "EnvVar"):
+                raise ValueError(
+                    f"{rel}:{elt.lineno}: _DECLARATIONS entries must "
+                    f"be literal EnvVar(...) calls"
+                )
+            fields = [_const_str(a) for a in elt.args]
+            for kw in elt.keywords:
+                fields.append(_const_str(kw.value))
+            if len(fields) != 4 or any(f is None for f in fields):
+                raise ValueError(
+                    f"{rel}:{elt.lineno}: EnvVar fields must be four "
+                    f"string literals (name, type, default, doc)"
+                )
+            entries.append(EnvEntry(*fields))
+    if not entries:
+        raise ValueError(f"{rel}: no _DECLARATIONS tuple found")
+    return EnvRegistry(path=rel, entries=tuple(entries))
+
+
+def parse_fault_sites(root: str) -> Tuple[Tuple[str, ...],
+                                          Dict[str, int]]:
+    """``(sites, site -> declaration line)`` from the ``KNOWN_SITES``
+    tuple in ``utils/faults.py``.  Duplicates are preserved so the
+    fault-site rule can flag them."""
+    rel = "pypardis_tpu/utils/faults.py"
+    path = os.path.join(root, rel)
+    with open(path, "r", encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=rel)
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == "KNOWN_SITES"
+                   for t in node.targets):
+            continue
+        if not isinstance(node.value, (ast.Tuple, ast.List)):
+            break
+        sites: List[str] = []
+        lines: Dict[str, int] = {}
+        for elt in node.value.elts:
+            s = _const_str(elt)
+            if s is None:
+                raise ValueError(
+                    f"{rel}:{elt.lineno}: KNOWN_SITES entries must be "
+                    f"string literals"
+                )
+            sites.append(s)
+            lines.setdefault(s, elt.lineno)
+        return tuple(sites), lines
+    raise ValueError(f"{rel}: no KNOWN_SITES tuple found")

@@ -1,0 +1,44 @@
+"""Human-facing rendering of a lint run (the CLI's output layer)."""
+
+from __future__ import annotations
+
+from typing import List
+
+from .base import RULE_REGISTRY
+from .driver import LintResult
+
+
+def render(result: LintResult, verbose: bool = False) -> str:
+    lines: List[str] = []
+    for f in result.findings:
+        lines.append(f"{f.location()}: [{f.rule}] {f.message}")
+    if result.notes and (verbose or not result.findings):
+        for f in result.notes:
+            lines.append(
+                f"{f.location()}: [{f.rule}] note: {f.message}"
+            )
+    extras = []
+    if result.suppressed:
+        extras.append(f"{result.suppressed} suppressed")
+    if result.baselined:
+        extras.append(f"{result.baselined} baselined")
+    if result.notes:
+        extras.append(f"{len(result.notes)} notes")
+    tail = f" ({', '.join(extras)})" if extras else ""
+    verdict = (
+        "ok" if result.ok
+        else f"{len(result.findings)} finding(s)"
+    )
+    lines.append(
+        f"graftlint: {verdict} — {result.files} files in "
+        f"{result.elapsed_s:.2f}s{tail}"
+    )
+    return "\n".join(lines)
+
+
+def render_rules() -> str:
+    lines = []
+    for name in sorted(RULE_REGISTRY):
+        cls = RULE_REGISTRY[name]
+        lines.append(f"{name} ({cls.issue_rule}): {cls.doc}")
+    return "\n".join(lines)

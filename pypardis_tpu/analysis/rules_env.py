@@ -1,0 +1,366 @@
+"""R3/R4 — environment-variable discipline.
+
+R3 ``trace-env-read`` (the PR 11 dispatch-tag class): a direct
+``os.environ`` / ``os.getenv`` read inside a function reachable from a
+``jax.jit`` / ``pjit`` / ``shard_map`` / ``pallas_call`` entry point is
+evaluated at TRACE time — the value is silently baked into the
+compiled program and flipping the variable later does nothing until
+caches clear.  Such reads must go through
+``pypardis_tpu.utils.envreg.raw``, whose docstring owns that contract.
+Reachability is a best-effort static call graph: module-local calls by
+name (lexically scoped, so jitted closures inside builder functions
+resolve), plus cross-module edges through package-internal imports
+(``from .distances import foo`` / ``from .. import staging``).  The
+graph over-approximates (a name match is an edge); the whole-repo
+zero-findings gate in tests keeps the over-approximation honest.
+
+R4 ``env-registry``: every ``PYPARDIS_*`` token anywhere in the
+fileset — string literals, docstrings, comments — must be declared in
+``utils/envreg.py``.  Unregistered names fail with a did-you-mean
+suggestion (the near-miss-typo gate), and the README "Environment
+variables" table must match the registry render exactly
+(``scripts/graftlint.py --envdocs`` regenerates it).
+"""
+
+from __future__ import annotations
+
+import ast
+import difflib
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .base import Finding, LintContext, Rule, attr_chain, register
+
+_JIT_WRAPPERS = {"jit", "pjit", "shard_map", "pallas_call"}
+
+ENVDOCS_BEGIN = "<!-- graftlint:envdocs:begin -->"
+ENVDOCS_END = "<!-- graftlint:envdocs:end -->"
+
+# The final char class excludes a trailing underscore, so a prefix
+# reference written with a star (the PYPARDIS_COMPACT_* watermarks,
+# say) tokenizes as the prefix with the star following it.
+_TOKEN_RE = re.compile(r"PYPARDIS_[A-Z0-9_]*[A-Z0-9]")
+
+
+def _rel_to_module(rel: str) -> Optional[Tuple[str, ...]]:
+    """Package-relative module path: ``pypardis_tpu/ops/distances.py``
+    -> ``("ops", "distances")``; None outside the package."""
+    if not rel.startswith("pypardis_tpu/") or not rel.endswith(".py"):
+        return None
+    parts = rel[len("pypardis_tpu/"):-len(".py")].split("/")
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return tuple(parts)
+
+
+def _module_to_rel(parts: Tuple[str, ...]) -> str:
+    return "pypardis_tpu/" + "/".join(parts) + ".py"
+
+
+class _ModuleGraph:
+    """Per-module symbol/call/read collection for R3."""
+
+    def __init__(self, rel: str, tree: ast.Module):
+        self.rel = rel
+        self.mod = _rel_to_module(rel)
+        # funckey -> ast node; funckey = (rel, qualname)
+        self.functions: Dict[Tuple[str, str], ast.AST] = {}
+        self.jit_roots: Set[Tuple[str, str]] = set()
+        self.edges: Dict[Tuple[str, str],
+                         Set[Tuple[str, str]]] = {}
+        self.env_reads: Dict[Tuple[str, str],
+                             List[ast.AST]] = {}
+        # local alias -> target module rel (import of a module)
+        self.mod_aliases: Dict[str, str] = {}
+        # local name -> (target module rel, name) (from-import)
+        self.from_names: Dict[str, Tuple[str, str]] = {}
+        self._collect_imports(tree)
+        self._walk_scope(tree, qual="", scopes=[{}])
+
+    # -- imports -------------------------------------------------------
+    def _resolve_from(self, node: ast.ImportFrom) -> Optional[
+            Tuple[str, ...]]:
+        if self.mod is None:
+            return None
+        if node.level == 0:
+            if not (node.module or "").startswith("pypardis_tpu"):
+                return None
+            return tuple((node.module or "").split(".")[1:])
+        # relative: level 1 = this module's package
+        base = self.mod[:-1] if self.mod else ()
+        up = node.level - 1
+        if up > len(base):
+            return None
+        base = base[:len(base) - up] if up else base
+        extra = tuple((node.module or "").split(".")) \
+            if node.module else ()
+        return base + extra
+
+    def _collect_imports(self, tree: ast.Module) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name.startswith("pypardis_tpu."):
+                        parts = tuple(a.name.split(".")[1:])
+                        alias = a.asname or a.name.split(".")[-1]
+                        self.mod_aliases[alias] = _module_to_rel(parts)
+            elif isinstance(node, ast.ImportFrom):
+                target = self._resolve_from(node)
+                if target is None:
+                    continue
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    local = a.asname or a.name
+                    # `from ..parallel import staging` binds a module;
+                    # `from .distances import foo` binds a function.
+                    sub = target + (a.name,)
+                    self.mod_aliases.setdefault(
+                        local, _module_to_rel(sub)
+                    )
+                    if target:
+                        self.from_names[local] = (
+                            _module_to_rel(target), a.name
+                        )
+
+    # -- scoped walk ---------------------------------------------------
+    def _walk_scope(self, node: ast.AST, qual: str,
+                    scopes: List[Dict[str, Tuple[str, str]]]) -> None:
+        body = getattr(node, "body", [])
+        local: Dict[str, Tuple[str, str]] = {}
+        for stmt in body:
+            if isinstance(
+                stmt, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                q = f"{qual}.{stmt.name}" if qual else stmt.name
+                local[stmt.name] = (self.rel, q)
+        scopes = scopes + [local]
+        owner = (self.rel, qual) if qual else None
+        for stmt in body:
+            if isinstance(
+                stmt, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                q = f"{qual}.{stmt.name}" if qual else stmt.name
+                key = (self.rel, q)
+                self.functions[key] = stmt
+                if self._jitted_decorators(stmt):
+                    self.jit_roots.add(key)
+                self._walk_scope(stmt, q, scopes)
+            elif isinstance(stmt, ast.ClassDef):
+                q = f"{qual}.{stmt.name}" if qual else stmt.name
+                self._walk_scope(stmt, q, scopes)
+            else:
+                # module/class-scope statement: jit-wrap calls here
+                # (`step = jax.jit(_step)`) mark their arguments.
+                self._scan_statement(stmt, owner, scopes)
+
+    def _jitted_decorators(self, fn: ast.AST) -> bool:
+        for dec in fn.decorator_list:
+            for sub in ast.walk(dec):
+                chain = attr_chain(sub) or []
+                if chain and chain[-1] in _JIT_WRAPPERS:
+                    return True
+                if isinstance(sub, ast.Call):
+                    chain = attr_chain(sub.func) or []
+                    if chain and chain[-1] in _JIT_WRAPPERS:
+                        return True
+        return False
+
+    def _resolve_name(self, name: str,
+                      scopes: List[Dict[str, Tuple[str, str]]]
+                      ) -> Optional[Tuple[str, str]]:
+        for scope in reversed(scopes):
+            if name in scope:
+                return scope[name]
+        if name in self.from_names:
+            rel, target = self.from_names[name]
+            return (rel, target)
+        return None
+
+    def _resolve_call(self, call: ast.Call,
+                      scopes: List[Dict[str, Tuple[str, str]]]
+                      ) -> Optional[Tuple[str, str]]:
+        chain = attr_chain(call.func)
+        if not chain:
+            return None
+        if len(chain) == 1:
+            return self._resolve_name(chain[0], scopes)
+        if len(chain) == 2 and chain[0] in self.mod_aliases:
+            return (self.mod_aliases[chain[0]], chain[1])
+        return None
+
+    def _mark_jit_args(self, call: ast.Call,
+                       scopes: List[Dict[str, Tuple[str, str]]]
+                       ) -> None:
+        chain = attr_chain(call.func) or []
+        if not chain or chain[-1] not in _JIT_WRAPPERS:
+            return
+        for arg in list(call.args) + [
+            kw.value for kw in call.keywords
+        ]:
+            if isinstance(arg, ast.Name):
+                key = self._resolve_name(arg.id, scopes)
+                if key is not None:
+                    self.jit_roots.add(key)
+
+    @staticmethod
+    def _env_read(node: ast.AST) -> bool:
+        if isinstance(node, ast.Call):
+            chain = attr_chain(node.func) or []
+            if chain[-2:] == ["environ", "get"]:
+                return True
+            if chain and chain[-1] == "getenv":
+                return True
+            # __import__("os").environ.get(...)
+            f = node.func
+            if (isinstance(f, ast.Attribute) and f.attr == "get"
+                    and isinstance(f.value, ast.Attribute)
+                    and f.value.attr == "environ"):
+                return True
+        if isinstance(node, ast.Subscript):
+            chain = attr_chain(node.value) or []
+            if chain[-1:] == ["environ"]:
+                # reads AND writes subscript; only flag loads
+                return isinstance(node.ctx, ast.Load)
+        return False
+
+    def _scan_statement(self, stmt: ast.stmt,
+                        owner: Optional[Tuple[str, str]],
+                        scopes: List[Dict[str, Tuple[str, str]]]
+                        ) -> None:
+        """Calls, jit-wrap markings, and env reads in one non-def
+        statement (def statements recurse via ``_walk_scope``, so a
+        statement walk here never meets a nested FunctionDef)."""
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                self._mark_jit_args(node, scopes)
+                if owner is not None:
+                    callee = self._resolve_call(node, scopes)
+                    if callee is not None:
+                        self.edges.setdefault(owner, set()).add(callee)
+            if owner is not None and self._env_read(node):
+                self.env_reads.setdefault(owner, []).append(node)
+
+
+@register
+class TraceEnvReadRule(Rule):
+    name = "trace-env-read"
+    issue_rule = "R3"
+    doc = ("os.environ reads reachable from jit/shard_map/pjit bake "
+           "the value into the compiled program (PR 11); route "
+           "through utils.envreg.raw")
+
+    def visit(self, src, ctx: LintContext) -> List[Finding]:
+        if src.tree is None or src.kind != "package":
+            return []
+        if src.rel.endswith("utils/envreg.py"):
+            return []  # the accessor module owns the contract
+        graphs = ctx.shared.setdefault("r3_graphs", {})
+        graphs[src.rel] = _ModuleGraph(src.rel, src.tree)
+        return []
+
+    def finalize(self, ctx: LintContext) -> List[Finding]:
+        graphs: Dict[str, _ModuleGraph] = ctx.shared.get(
+            "r3_graphs", {}
+        )
+        roots: Set[Tuple[str, str]] = set()
+        edges: Dict[Tuple[str, str], Set[Tuple[str, str]]] = {}
+        for g in graphs.values():
+            roots |= g.jit_roots
+            for k, v in g.edges.items():
+                edges.setdefault(k, set()).update(v)
+        # Nested functions of a reachable function are reachable
+        # (closures trace with their parent): add parent->child edges.
+        for g in graphs.values():
+            for rel, qual in g.functions:
+                if "." in qual:
+                    parent = qual.rsplit(".", 1)[0]
+                    if (rel, parent) in g.functions:
+                        edges.setdefault((rel, parent), set()).add(
+                            (rel, qual)
+                        )
+        reachable: Set[Tuple[str, str]] = set()
+        frontier = list(roots)
+        while frontier:
+            key = frontier.pop()
+            if key in reachable:
+                continue
+            reachable.add(key)
+            frontier.extend(edges.get(key, ()))
+        out: List[Finding] = []
+        for g in graphs.values():
+            for key, nodes in g.env_reads.items():
+                if key not in reachable:
+                    continue
+                for node in nodes:
+                    out.append(Finding(
+                        self.name, key[0], node.lineno,
+                        node.col_offset,
+                        f"os.environ read in {key[1]!r}, reachable "
+                        f"from a jit/shard_map entry point — the "
+                        f"value is baked in at trace time (PR 11); "
+                        f"read it via utils.envreg.raw, which "
+                        f"documents that contract",
+                    ))
+        return out
+
+
+@register
+class EnvRegistryRule(Rule):
+    name = "env-registry"
+    issue_rule = "R4"
+    doc = ("every PYPARDIS_* name must be declared in utils/envreg.py; "
+           "the README table is generated from the registry")
+
+    def visit(self, src, ctx: LintContext) -> List[Finding]:
+        names = set(ctx.env_registry.names)
+        out: List[Finding] = []
+        seen_here: Set[str] = set()
+        for m in _TOKEN_RE.finditer(src.text):
+            token = m.group(0)
+            tail = src.text[m.end():m.end() + 2]
+            if tail[:1] == "*" or tail == "_*":
+                if any(n.startswith(token) for n in names):
+                    continue
+            elif token in names:
+                continue
+            if token in seen_here:
+                continue
+            seen_here.add(token)
+            line = src.text.count("\n", 0, m.start()) + 1
+            hint = difflib.get_close_matches(token, names, n=1)
+            suffix = f" — did you mean {hint[0]}?" if hint else ""
+            out.append(Finding(
+                self.name, src.rel, line, 0,
+                f"{token} is not declared in utils/envreg.py"
+                f"{suffix} (declare it with a type/default/doc, or "
+                f"fix the typo)",
+            ))
+        return out
+
+    def finalize(self, ctx: LintContext) -> List[Finding]:
+        readme = os.path.join(ctx.root, "README.md")
+        if not os.path.exists(readme):
+            return []
+        with open(readme, "r", encoding="utf-8") as f:
+            text = f.read()
+        begin = text.find(ENVDOCS_BEGIN)
+        end = text.find(ENVDOCS_END)
+        if begin < 0 or end < 0 or end < begin:
+            return [Finding(
+                self.name, "README.md", 1, 0,
+                f"README.md lacks the generated env-var table "
+                f"markers {ENVDOCS_BEGIN!r} / {ENVDOCS_END!r}",
+            )]
+        committed = text[begin + len(ENVDOCS_BEGIN):end].strip("\n")
+        expected = ctx.env_registry.render_markdown().strip("\n")
+        if committed != expected:
+            line = text.count("\n", 0, begin) + 1
+            return [Finding(
+                self.name, "README.md", line, 0,
+                "README env-var table is stale vs utils/envreg.py — "
+                "regenerate with `python scripts/graftlint.py "
+                "--envdocs` and paste between the markers",
+            )]
+        return []

@@ -1,0 +1,325 @@
+"""R6/R7 — fault-site, magic-width, and import hygiene.
+
+R6a ``fault-site``: every fault-injection site string (the first
+argument of ``faults.maybe_fail``, the ``site=`` of
+``staging.transfer``, and the site names inside ``faults.plan`` spec
+literals) must be declared in the ``KNOWN_SITES`` tuple in
+``utils/faults.py`` — and every declared site must be used somewhere,
+so the registry (and the docstring table generated next to it) cannot
+rot the way the module's site table silently missed ``gm.execute`` /
+``gm.chained_range`` for two PRs.  Dynamic (non-literal) site
+arguments are allowed only inside the staging/faults plumbing that
+forwards them.
+
+R6b ``magic-width``: the pair-stats row is ``(PAIR_STATS_WIDTH,)`` =
+``(5,)`` wide — and was ``(3,)`` before PR 7 widened it, which is
+exactly why a literal ``5`` (or legacy ``3``) in stats shapes and
+unpack subscripts is a trap: the next widening silently truncates.
+In the kernel/driver modules that carry pair stats, stats-shaped
+constructor calls and negative unpack subscripts on stats-named
+values must spell ``ops.precision.PAIR_STATS_WIDTH``.
+
+R7 ``unused-import`` (bonus): an import whose bound name never
+appears again in the file.  Enforced for the package and the repo-root
+entry points; report-only (a note) for ``scripts/`` where probe CLIs
+keep convenience imports.  Side-effect imports suppress with
+``# graftlint: disable=unused-import -- <side effect>``.
+"""
+
+from __future__ import annotations
+
+import ast
+import difflib
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .base import Finding, LintContext, Rule, attr_chain, register
+
+# -- R6a fault-site ----------------------------------------------------
+
+_SPEC_SITE_RE = re.compile(r"(^|,)\s*(?P<site>[a-z0-9_.]+?)\s*[:=]")
+
+_FORWARDING_FILES = (
+    "pypardis_tpu/parallel/staging.py",
+    "pypardis_tpu/utils/faults.py",
+)
+
+
+def _spec_sites(spec: str) -> List[str]:
+    return [m.group("site") for m in _SPEC_SITE_RE.finditer(spec)]
+
+
+@register
+class FaultSiteRule(Rule):
+    name = "fault-site"
+    issue_rule = "R6"
+    doc = ("every fault-injection site string must be declared in "
+           "faults.KNOWN_SITES, and every declared site used")
+
+    def visit(self, src, ctx: LintContext) -> List[Finding]:
+        if src.tree is None:
+            return []
+        out: List[Finding] = []
+        used: Dict[str, Tuple[str, int]] = ctx.shared.setdefault(
+            "fault_sites_used", {}
+        )
+
+        def record(site: str, lineno: int) -> None:
+            used.setdefault(site, (src.rel, lineno))
+
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Call):
+                chain = attr_chain(node.func) or []
+                tail = chain[-1] if chain else ""
+                if tail == "maybe_fail" and node.args:
+                    arg = node.args[0]
+                    if (isinstance(arg, ast.Constant)
+                            and isinstance(arg.value, str)):
+                        record(arg.value, node.lineno)
+                    elif src.rel not in _FORWARDING_FILES:
+                        out.append(Finding(
+                            self.name, src.rel, node.lineno,
+                            node.col_offset,
+                            "non-literal fault site — only the "
+                            "staging/faults forwarding layer may "
+                            "pass a computed site name",
+                        ))
+                elif tail == "transfer":
+                    for kw in node.keywords:
+                        if kw.arg != "site":
+                            continue
+                        if (isinstance(kw.value, ast.Constant)
+                                and isinstance(kw.value.value, str)):
+                            record(kw.value.value, node.lineno)
+                        elif src.rel not in _FORWARDING_FILES:
+                            out.append(Finding(
+                                self.name, src.rel, node.lineno,
+                                node.col_offset,
+                                "non-literal fault site in "
+                                "staging.transfer(site=...)",
+                            ))
+                elif tail == "plan" and node.args:
+                    arg = node.args[0]
+                    if (isinstance(arg, ast.Constant)
+                            and isinstance(arg.value, str)):
+                        for site in _spec_sites(arg.value):
+                            record(site, node.lineno)
+            elif isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                # literal defaults of a `site` parameter (the
+                # staging.transfer signature default is a real use)
+                args = node.args
+                pos = args.posonlyargs + args.args
+                for a, d in zip(pos[len(pos) - len(args.defaults):],
+                                args.defaults):
+                    if (a.arg == "site"
+                            and isinstance(d, ast.Constant)
+                            and isinstance(d.value, str)):
+                        record(d.value, node.lineno)
+                for a, d in zip(args.kwonlyargs, args.kw_defaults):
+                    if (a.arg == "site" and d is not None
+                            and isinstance(d, ast.Constant)
+                            and isinstance(d.value, str)):
+                        record(d.value, node.lineno)
+        return out
+
+    def finalize(self, ctx: LintContext) -> List[Finding]:
+        used: Dict[str, Tuple[str, int]] = ctx.shared.get(
+            "fault_sites_used", {}
+        )
+        known = ctx.fault_sites
+        known_set = set(known)
+        out: List[Finding] = []
+        seen: Set[str] = set()
+        for site in known:
+            if site in seen:
+                out.append(Finding(
+                    self.name, ctx.fault_sites_path,
+                    ctx.shared.get("fault_site_lines", {}).get(site, 1),
+                    0,
+                    f"duplicate KNOWN_SITES entry {site!r}",
+                ))
+            seen.add(site)
+        for site, (rel, lineno) in sorted(used.items()):
+            if site in known_set:
+                continue
+            hint = difflib.get_close_matches(site, known_set, n=1)
+            suffix = f" — did you mean {hint[0]!r}?" if hint else ""
+            out.append(Finding(
+                self.name, rel, lineno, 0,
+                f"fault site {site!r} is not declared in "
+                f"faults.KNOWN_SITES{suffix}",
+            ))
+        if ctx.shared.get("partial_run"):
+            return out  # can't judge "unused" from a partial fileset
+        for site in known:
+            if site not in used:
+                out.append(Finding(
+                    self.name, ctx.fault_sites_path,
+                    ctx.shared.get("fault_site_lines", {}).get(site, 1),
+                    0,
+                    f"KNOWN_SITES entry {site!r} has no remaining "
+                    f"injection site — remove it (or restore the "
+                    f"site)",
+                ))
+        return out
+
+
+# -- R6b magic-width ---------------------------------------------------
+
+_STATS_MODULES = (
+    "pypardis_tpu/ops/pipeline.py",
+    "pypardis_tpu/ops/labels.py",
+    "pypardis_tpu/ops/distances.py",
+    "pypardis_tpu/ops/pallas_kernels.py",
+    "pypardis_tpu/parallel/sharded.py",
+    "pypardis_tpu/parallel/global_morton.py",
+    "pypardis_tpu/utils/budget.py",
+)
+
+_STATS_NAME_RE = re.compile(r"(pair_?stats|pstats|packed)", re.I)
+_CTOR_NAMES = {"zeros", "ones", "full", "empty", "reshape",
+               "broadcast_to"}
+_WIDTHS = (5, 3)  # current width and the pre-PR 7 legacy width
+
+
+def _neg_const(node: ast.AST) -> Optional[int]:
+    if (isinstance(node, ast.UnaryOp)
+            and isinstance(node.op, ast.USub)
+            and isinstance(node.operand, ast.Constant)
+            and isinstance(node.operand.value, int)):
+        return -node.operand.value
+    return None
+
+
+@register
+class MagicWidthRule(Rule):
+    name = "magic-width"
+    issue_rule = "R6"
+    doc = ("pair-stats shapes and unpack subscripts must spell "
+           "ops.precision.PAIR_STATS_WIDTH, not literal 5/3 — the "
+           "PR 7 widening trap")
+
+    def visit(self, src, ctx: LintContext) -> List[Finding]:
+        if src.tree is None:
+            return []
+        if not any(src.rel.endswith(m.split("/", 1)[1]) or src.rel == m
+                   for m in _STATS_MODULES):
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Subscript):
+                base = node.value
+                if not (isinstance(base, ast.Name)
+                        and _STATS_NAME_RE.search(base.id)):
+                    continue
+                flagged = []
+                idx = node.slice
+                v = _neg_const(idx)
+                # -1 stays legal (generic last-element); -2..-5 are
+                # stats-column arithmetic in disguise.
+                if v is not None and v in (-2, -3, -4, -5):
+                    flagged.append(idx)
+                if isinstance(idx, ast.Slice):
+                    for bound in (idx.lower, idx.upper):
+                        if bound is None:
+                            continue
+                        bv = _neg_const(bound)
+                        if bv is not None and bv in (-3, -5):
+                            flagged.append(bound)
+                for f in flagged:
+                    out.append(Finding(
+                        self.name, src.rel, node.lineno,
+                        node.col_offset,
+                        f"literal stats-width subscript on "
+                        f"{base.id!r} — index relative to "
+                        f"ops.precision.PAIR_STATS_WIDTH instead "
+                        f"(the row was (3,) before PR 7 widened it; "
+                        f"the next widening will silently truncate "
+                        f"this unpack)",
+                    ))
+            elif isinstance(node, ast.Call):
+                chain = attr_chain(node.func) or []
+                if not chain or chain[-1] not in _CTOR_NAMES:
+                    continue
+                stmt_text = src.statement_text(node)
+                if not re.search(r"stat", stmt_text, re.I):
+                    continue
+                shape_args = [a for a in node.args
+                              if isinstance(a, ast.Tuple)]
+                for tup in shape_args:
+                    if not tup.elts:
+                        continue
+                    last = tup.elts[-1]
+                    if (isinstance(last, ast.Constant)
+                            and last.value in _WIDTHS):
+                        out.append(Finding(
+                            self.name, src.rel, node.lineno,
+                            node.col_offset,
+                            "literal pair-stats width in a shape — "
+                            "use ops.precision.PAIR_STATS_WIDTH",
+                        ))
+        return out
+
+
+# -- R7 unused-import --------------------------------------------------
+
+
+@register
+class UnusedImportRule(Rule):
+    name = "unused-import"
+    issue_rule = "R7"
+    doc = ("import whose bound name never appears again in the file; "
+           "enforced for the package, report-only for scripts/")
+
+    def visit(self, src, ctx: LintContext) -> List[Finding]:
+        if src.tree is None or src.rel.endswith("__init__.py"):
+            return []
+        severity = "note" if src.kind == "scripts" else "error"
+        # (name, import stmt node)
+        bindings: List[Tuple[str, ast.stmt]] = []
+        import_spans: List[Tuple[int, int]] = []
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    name = a.asname or a.name.split(".")[0]
+                    bindings.append((name, node))
+                import_spans.append(
+                    (node.lineno, getattr(node, "end_lineno",
+                                          node.lineno))
+                )
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "__future__":
+                    continue
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    bindings.append((a.asname or a.name, node))
+                import_spans.append(
+                    (node.lineno, getattr(node, "end_lineno",
+                                          node.lineno))
+                )
+        if not bindings:
+            return []
+        import_text = "\n".join(
+            "\n".join(src.lines[s - 1:e]) for s, e in import_spans
+        )
+        out: List[Finding] = []
+        seen: Set[Tuple[str, int]] = set()
+        for name, node in bindings:
+            key = (name, node.lineno)
+            if key in seen:
+                continue
+            seen.add(key)
+            pat = re.compile(rf"\b{re.escape(name)}\b")
+            total = len(pat.findall(src.text))
+            in_imports = len(pat.findall(import_text))
+            if total > in_imports:
+                continue
+            out.append(Finding(
+                self.name, src.rel, node.lineno, node.col_offset,
+                f"{name!r} is imported but never used",
+                severity=severity,
+            ))
+        return out

@@ -1,0 +1,123 @@
+"""R5 — ``seal_f32`` discipline in oracle-exact distance paths (the
+PR 4 FMA-contraction class).
+
+The serving contract is labels AND d2 bitwise-equal to the numpy
+oracle.  XLA:CPU FMA-contracts ``acc + d*d`` (last-ulp drift immune to
+``optimization_barrier`` / bitcast tricks — PR 4 tried them all);
+the only construct that survives every optimizer is sealing each
+squared term behind an integer XOR with a RUNTIME zero
+(``ops.query.seal_f32``).  This rule pins that discipline where the
+bitwise contract lives: a squared product (``d * d`` with identical
+operands, or ``d ** 2``) appearing as an operand of an ADDITION — the
+exact multiply-feeds-add shape an FMA fuses — must sit inside a
+``seal_f32(...)`` argument.  Standalone squares (``jnp.sum(g * g)``,
+``e * e``) have no contraction target and stay unflagged, which keeps
+the conservative box-gap/band pruning code out of scope by
+construction.
+
+Scopes: all of ``ops/query.py``, and the ``query*`` kernels in
+``ops/pallas_kernels.py``.  The bulk clustering kernels in
+``ops/distances.py`` are deliberately NOT in scope — their contract is
+symmetric-comparison consistency, not oracle bit-parity, and sealing
+them would forfeit real MXU throughput.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .base import Finding, LintContext, Rule, attr_chain, register
+
+_WHOLE_FILE_SCOPES = ("ops/query.py",)
+_FUNC_SCOPES = {"ops/pallas_kernels.py": "query"}
+
+
+def _squared_term(node: ast.AST) -> bool:
+    if isinstance(node, ast.BinOp):
+        if isinstance(node.op, ast.Mult):
+            left, right = node.left, node.right
+            return (
+                isinstance(left, ast.Name)
+                and isinstance(right, ast.Name)
+                and left.id == right.id
+            )
+        if isinstance(node.op, ast.Pow):
+            return (
+                isinstance(node.left, ast.Name)
+                and isinstance(node.right, ast.Constant)
+                and node.right.value == 2
+            )
+    return False
+
+
+def _sealed(src, node: ast.AST) -> bool:
+    for anc in src.ancestors(node):
+        if isinstance(anc, ast.Call):
+            chain = attr_chain(anc.func) or []
+            if chain and chain[-1] == "seal_f32":
+                return True
+        if isinstance(anc, ast.stmt):
+            break
+    return False
+
+
+def _feeds_addition(src, node: ast.AST) -> bool:
+    """Whether the squared term is a direct operand of a ``+`` —
+    the multiply-feeds-add shape FMA contraction fuses."""
+    for anc in src.ancestors(node):
+        if isinstance(anc, ast.BinOp) and isinstance(anc.op, ast.Add):
+            return True
+        if isinstance(anc, (ast.Call, ast.stmt)):
+            break
+    return False
+
+
+@register
+class SealF32Rule(Rule):
+    name = "seal-f32"
+    issue_rule = "R5"
+    doc = ("squared-distance accumulation in oracle-exact paths must "
+           "route each d*d through seal_f32 (PR 4: XLA FMA "
+           "contraction breaks bitwise parity)")
+
+    def _scoped_functions(self, src):
+        """Function nodes whose bodies this rule covers (None =
+        whole file)."""
+        for rel_suffix, prefix in _FUNC_SCOPES.items():
+            if src.rel.endswith(rel_suffix):
+                return [
+                    node for node in ast.walk(src.tree)
+                    if isinstance(
+                        node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ) and prefix in node.name
+                ]
+        for rel_suffix in _WHOLE_FILE_SCOPES:
+            if src.rel.endswith(rel_suffix):
+                return None
+        return []
+
+    def visit(self, src, ctx: LintContext) -> List[Finding]:
+        if src.tree is None or src.kind != "package":
+            return []
+        scope = self._scoped_functions(src)
+        if scope == []:
+            return []
+        roots = [src.tree] if scope is None else scope
+        out: List[Finding] = []
+        for root in roots:
+            for node in ast.walk(root):
+                if not _squared_term(node):
+                    continue
+                if not _feeds_addition(src, node):
+                    continue
+                if _sealed(src, node):
+                    continue
+                out.append(Finding(
+                    self.name, src.rel, node.lineno, node.col_offset,
+                    "unsealed squared term in an oracle-exact path — "
+                    "XLA FMA-contracts `acc + d*d`, breaking bitwise "
+                    "oracle parity (PR 4); wrap the square in "
+                    "seal_f32(d * d, zero_i32)",
+                ))
+        return out

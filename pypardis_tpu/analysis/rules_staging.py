@@ -1,0 +1,113 @@
+"""R2 — ``jax.device_put`` aliasing discipline (the PR 13 corruption
+class).
+
+On CPU (and any backend where :func:`staging.may_alias_host` is true)
+``jax.device_put`` of an aligned numpy buffer is ZERO-COPY: the
+returned "device" array aliases the host memory.  PR 13 found
+fit(eps1)→fit(eps2) returning corrupted labels because pooled build
+buffers were device_put into the slab cache and then handed back to
+the pool — the next borrow overwrote live cached slabs.  The fix is
+:func:`staging.give_back_after_put`, which *drops* (never pools) build
+buffers on aliasing backends.
+
+The enforceable AST contract: a direct ``jax.device_put`` call in the
+package must sit inside one of the sanctioned shapes —
+
+* in ``parallel/staging.py`` itself (the layer that owns the hazard);
+* inside a callable passed to ``staging.transfer(...)`` (the fault-
+  injected, retried transfer scope every slab shipment uses);
+* in a function that also calls ``staging.give_back_after_put`` (the
+  audited put-then-drop pairing);
+* under an inline ``# graftlint: disable=device-put-aliasing -- <why
+  this buffer is never pool-borrowed>`` suppression.
+
+Everything else is a finding: the author must either route through the
+staging layer or state the buffer's provenance in a suppression
+reason.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from .base import Finding, LintContext, Rule, attr_chain, register
+
+
+def _is_device_put(node: ast.Call) -> bool:
+    chain = attr_chain(node.func)
+    if not chain:
+        return False
+    return chain[-1] == "device_put" and (
+        len(chain) == 1 or chain[-2] in ("jax", "_jax")
+    )
+
+
+def _enclosing_function(src, node: ast.AST) -> Optional[ast.AST]:
+    for anc in src.ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return anc
+    return None
+
+
+def _inside_transfer_arg(src, node: ast.AST) -> bool:
+    """Whether ``node`` sits inside a lambda/def that is an argument
+    of a ``staging.transfer(...)`` call."""
+    prev = node
+    for anc in src.ancestors(node):
+        if isinstance(
+            anc, (ast.Lambda, ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            prev = anc
+            continue
+        if isinstance(anc, ast.Call) and prev is not node:
+            chain = attr_chain(anc.func)
+            if chain and chain[-1] == "transfer":
+                return True
+    return False
+
+
+def _function_gives_back(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            chain = attr_chain(node.func)
+            if chain and chain[-1] == "give_back_after_put":
+                return True
+    return False
+
+
+@register
+class DevicePutAliasingRule(Rule):
+    name = "device-put-aliasing"
+    issue_rule = "R2"
+    doc = ("direct jax.device_put outside the staging layer risks "
+           "zero-copy aliasing of pooled buffers (PR 13); wrap in "
+           "staging.transfer / pair with give_back_after_put")
+
+    def visit(self, src, ctx: LintContext) -> List[Finding]:
+        if src.tree is None or src.kind != "package":
+            return []
+        if src.rel.endswith("parallel/staging.py"):
+            return []
+        if "device_put" not in src.text:
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Call)
+                    and _is_device_put(node)):
+                continue
+            if _inside_transfer_arg(src, node):
+                continue
+            fn = _enclosing_function(src, node)
+            if fn is not None and _function_gives_back(fn):
+                continue
+            out.append(Finding(
+                self.name, src.rel, node.lineno, node.col_offset,
+                "direct jax.device_put outside the staging "
+                "discipline: on aliasing backends a pooled build "
+                "buffer put this way corrupts cached slabs (PR 13); "
+                "wrap the put in staging.transfer(...), pair it with "
+                "staging.give_back_after_put, or suppress with the "
+                "buffer's provenance as the reason",
+            ))
+        return out

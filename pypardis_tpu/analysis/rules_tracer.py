@@ -1,0 +1,118 @@
+"""R1 — no module-level ``jnp`` constants (the PR 6 tracer-poisoning
+class).
+
+A module-scope binding whose value is built by a ``jax.numpy`` call
+(``_ZERO = jnp.int32(0)``) is evaluated at *import time*.  If the
+module's first import happens inside a jit trace (a lazy in-function
+import — exactly how ``parallel/halo.py`` was first imported inside
+``ring_exchange_step``'s trace), the "constant" is born a TRACER and
+poisons every later use with ``UnexpectedTracerError``.  Numpy scalars
+are the sanctioned replacement: trace-inert, and every kernel promotes
+them identically.
+
+Inert ``jnp`` accesses stay allowed: ``jnp.iinfo(...)``/``jnp.finfo``
+return host-side dtype-info objects (``jnp.iinfo(jnp.int32).max`` is a
+Python int), and bare attribute references (``jnp.float32`` as a dtype,
+``jnp.inf``) create no array.  ``jax.jit(...)`` wrapping at module
+scope is likewise fine — it traces lazily at first call, not at
+import.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from .base import Finding, LintContext, Rule, attr_chain, register
+
+# jnp-rooted calls that return host objects, not jax arrays.
+_INERT_FUNCS = {"iinfo", "finfo", "dtype", "result_type", "issubdtype"}
+
+
+def _jnp_aliases(tree: ast.Module) -> Set[str]:
+    """Local names bound to the ``jax.numpy`` module."""
+    aliases: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax.numpy":
+                    aliases.add(a.asname or "jax")  # jax.numpy.x form
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "jax":
+                for a in node.names:
+                    if a.name == "numpy":
+                        aliases.add(a.asname or "numpy")
+            elif node.module == "jax.numpy":
+                # from jax.numpy import int32 — any call to the
+                # imported name is an array constructor.
+                for a in node.names:
+                    if a.name not in _INERT_FUNCS:
+                        aliases.add(a.asname or a.name)
+    return aliases
+
+
+def _is_jnp_call(node: ast.Call, aliases: Set[str]) -> bool:
+    chain = attr_chain(node.func)
+    if not chain:
+        return False
+    if chain[0] == "jnp" or chain[0] in aliases:
+        pass
+    elif len(chain) >= 2 and chain[0] == "jax" and chain[1] == "numpy":
+        chain = chain[1:]
+    else:
+        return False
+    return chain[-1] not in _INERT_FUNCS
+
+
+def _module_scope_statements(tree: ast.Module):
+    """Module-body statements, descending into module-level if/try
+    blocks (conditional imports, platform guards) but never into
+    function or class bodies."""
+    stack = list(tree.body)
+    while stack:
+        node = stack.pop(0)
+        yield node
+        if isinstance(node, (ast.If, ast.Try)):
+            for part in ("body", "orelse", "finalbody"):
+                stack.extend(getattr(node, part, []) or [])
+        elif isinstance(node, (ast.For, ast.While, ast.With)):
+            stack.extend(node.body)
+            stack.extend(getattr(node, "orelse", []) or [])
+
+
+@register
+class ModuleJnpConstantRule(Rule):
+    name = "module-jnp-constant"
+    issue_rule = "R1"
+    doc = ("module-scope jnp/jax.numpy value bindings become tracers "
+           "when first imported inside a trace; use numpy scalars")
+
+    def visit(self, src, ctx: LintContext) -> List[Finding]:
+        if src.tree is None or src.kind != "package":
+            return []
+        aliases = _jnp_aliases(src.tree)
+        if not aliases and "jnp" not in src.text:
+            return []
+        aliases.add("jnp")  # the conventional alias, even if indirect
+        out: List[Finding] = []
+        for stmt in _module_scope_statements(src.tree):
+            if not isinstance(
+                stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)
+            ):
+                continue
+            value = stmt.value
+            if value is None:
+                continue
+            for node in ast.walk(value):
+                if isinstance(node, ast.Call) and _is_jnp_call(
+                    node, aliases
+                ):
+                    out.append(Finding(
+                        self.name, src.rel, node.lineno,
+                        node.col_offset,
+                        "module-level jax.numpy value binding "
+                        "(imported inside a trace it becomes a "
+                        "tracer — PR 6); bind a numpy scalar/array "
+                        "instead",
+                    ))
+        return out

@@ -1,0 +1,188 @@
+"""Source loading, fileset discovery, and inline suppressions.
+
+Suppression syntax (mirrors the familiar ``noqa`` shape but demands a
+reason — an unexplained suppression is itself a finding)::
+
+    x = thing()  # graftlint: disable=device-put-aliasing -- replicated
+                 # broadcast of caller-owned arrays, never pool-borrowed
+
+* On a code line: suppresses the named rules for findings ON that line.
+* On a comment-only line: suppresses them for the next CODE line (long
+  call expressions rarely have trailing room); the reason may continue
+  over following comment lines, which are skipped.
+* ``disable=all`` is intentionally not supported — every suppression
+  names its rule, so deleting a rule surfaces its stale suppressions.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from .base import Finding
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable=(?P<rules>[a-z0-9_,\- ]+?)"
+    r"(?:\s*--\s*(?P<reason>.*\S))?\s*$"
+)
+
+
+@dataclass
+class SourceFile:
+    """One parsed file plus everything rules need from it."""
+
+    path: str        # absolute
+    rel: str         # repo-relative, posix
+    kind: str        # "package" | "scripts" | "root"
+    text: str
+    lines: List[str]
+    tree: Optional[ast.Module]
+    parse_error: Optional[Finding]
+    # line -> rule names suppressed on that line
+    suppressions: Dict[int, Set[str]] = field(default_factory=dict)
+    suppression_findings: List[Finding] = field(default_factory=list)
+    _parents: Optional[Dict[int, ast.AST]] = None
+
+    def parent_map(self) -> Dict[int, ast.AST]:
+        """id(node) -> parent node, built lazily once per file."""
+        if self._parents is None:
+            parents: Dict[int, ast.AST] = {}
+            for node in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(node):
+                    parents[id(child)] = node
+            self._parents = parents
+        return self._parents
+
+    def ancestors(self, node: ast.AST):
+        parents = self.parent_map()
+        cur = parents.get(id(node))
+        while cur is not None:
+            yield cur
+            cur = parents.get(id(cur))
+
+    def statement_text(self, node: ast.AST) -> str:
+        """Source of the statement enclosing ``node`` (the node itself
+        when it is a statement)."""
+        stmt = node
+        for anc in [node] + list(self.ancestors(node)):
+            if isinstance(anc, ast.stmt):
+                stmt = anc
+                break
+        end = getattr(stmt, "end_lineno", stmt.lineno)
+        return "\n".join(self.lines[stmt.lineno - 1:end])
+
+
+def _scan_suppressions(src: SourceFile, known_rules: Set[str]) -> None:
+    """Populate ``src.suppressions`` from ``# graftlint:`` comments.
+
+    tokenize (not line regex) so a ``# graftlint:`` inside a string
+    literal never parses as a directive.
+    """
+    try:
+        tokens = list(tokenize.generate_tokens(
+            io.StringIO(src.text).readline
+        ))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return
+    code_lines: Set[int] = set()
+    comments: List[Tuple[int, str]] = []
+    for tok in tokens:
+        if tok.type == tokenize.COMMENT:
+            comments.append((tok.start[0], tok.string))
+        elif tok.type not in (
+            tokenize.NL, tokenize.NEWLINE, tokenize.INDENT,
+            tokenize.DEDENT, tokenize.ENDMARKER,
+        ):
+            code_lines.update(range(tok.start[0], tok.end[0] + 1))
+    for lineno, comment in comments:
+        m = _SUPPRESS_RE.search(comment)
+        if m is None:
+            # The tool name followed by a colon marks a directive;
+            # prose mentions of the bare tool name stay legal.
+            if "graftlint" + ":" in comment:
+                src.suppression_findings.append(Finding(
+                    "bad-suppression", src.rel, lineno, 0,
+                    "unparseable graftlint directive (expected "
+                    "'# graftlint: disable=<rule>[,<rule>] -- "
+                    "<reason>')",
+                ))
+            continue
+        rules = {r.strip() for r in m.group("rules").split(",")
+                 if r.strip()}
+        reason = m.group("reason")
+        bad = sorted(r for r in rules if r not in known_rules)
+        if bad:
+            src.suppression_findings.append(Finding(
+                "bad-suppression", src.rel, lineno, 0,
+                f"suppression names unknown rule(s): {', '.join(bad)}",
+            ))
+            rules -= set(bad)
+        if not reason:
+            src.suppression_findings.append(Finding(
+                "bad-suppression", src.rel, lineno, 0,
+                "suppression without a reason — append "
+                "'-- <why this site is safe>'",
+            ))
+            continue  # a reasonless suppression suppresses nothing
+        if lineno in code_lines:
+            target = lineno
+        else:
+            after = [ln for ln in code_lines if ln > lineno]
+            if not after:
+                continue
+            target = min(after)
+        src.suppressions.setdefault(target, set()).update(rules)
+
+
+def load_source(path: str, root: str,
+                known_rules: Set[str]) -> SourceFile:
+    rel = os.path.relpath(path, root).replace(os.sep, "/")
+    if rel.startswith("pypardis_tpu/"):
+        kind = "package"
+    elif rel.startswith("scripts/"):
+        kind = "scripts"
+    else:
+        kind = "root"
+    with open(path, "r", encoding="utf-8") as f:
+        text = f.read()
+    tree = None
+    err = None
+    try:
+        tree = ast.parse(text, filename=rel)
+    except SyntaxError as e:
+        err = Finding(
+            "parse-error", rel, e.lineno or 1, e.offset or 0,
+            f"syntax error: {e.msg}",
+        )
+    src = SourceFile(
+        path=path, rel=rel, kind=kind, text=text,
+        lines=text.splitlines(), tree=tree, parse_error=err,
+    )
+    if tree is not None:
+        _scan_suppressions(src, known_rules)
+    return src
+
+
+def discover_files(root: str) -> List[str]:
+    """The enforced fileset: the package, the probe/CI scripts, and
+    the repo-root entry points (``bench.py`` / ``benchdata.py``)."""
+    out: List[str] = []
+    for sub in ("pypardis_tpu", "scripts"):
+        base = os.path.join(root, sub)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = sorted(
+                d for d in dirnames if d != "__pycache__"
+            )
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    for fn in ("bench.py", "benchdata.py"):
+        p = os.path.join(root, fn)
+        if os.path.exists(p):
+            out.append(p)
+    return out

@@ -30,7 +30,7 @@ from .aggregator import ClusterAggregator
 from .geometry import BoundingBox
 from .ops import densify_labels
 from .partition import KDPartitioner
-from .utils import clamp_block, round_up, validate_params
+from .utils import clamp_block, envreg, round_up, validate_params
 from .utils.log import get_logger, log_phase
 
 
@@ -141,9 +141,7 @@ def _check_finite(points) -> None:
     PYPARDIS_SKIP_FINITE_CHECK=1 to skip for trusted pipelines where
     the extra read matters (e.g. repeated fits of a verified memmap).
     """
-    import os
-
-    if os.environ.get("PYPARDIS_SKIP_FINITE_CHECK") == "1":
+    if envreg.raw("PYPARDIS_SKIP_FINITE_CHECK") == "1":
         return
     if _is_device_array(points):
         import jax.numpy as jnp
@@ -204,12 +202,10 @@ def _layout_cacheable(cap: int, k: int) -> bool:
     coordinates, PYPARDIS_LAYOUT_CACHE_MAX bytes to change) and
     PYPARDIS_LAYOUT_CACHE=0 disables it outright.
     """
-    import os
-
-    if os.environ.get("PYPARDIS_LAYOUT_CACHE", "1") == "0":
+    if envreg.raw("PYPARDIS_LAYOUT_CACHE", "1") == "0":
         return False
     max_bytes = int(
-        os.environ.get("PYPARDIS_LAYOUT_CACHE_MAX", 1 << 29)
+        envreg.raw("PYPARDIS_LAYOUT_CACHE_MAX", 1 << 29)
     )
     return 2 * cap * k * 4 <= max_bytes
 
@@ -630,7 +626,7 @@ class DBSCAN:
             self._tune_pinned["mode"] = mode
         else:
             mode = "auto"
-        env_dispatch = os.environ.get("PYPARDIS_DISPATCH")
+        env_dispatch = envreg.raw("PYPARDIS_DISPATCH")
         if env_dispatch and env_dispatch != "auto":
             self._tune_pinned["dispatch"] = env_dispatch
         self.auto = bool(auto)
@@ -841,7 +837,7 @@ class DBSCAN:
         self._tune_stats = None
         if self.auto and len(points):
             dispatch_token = self._plan_auto(points)
-        ckpt_path = resume or os.environ.get("PYPARDIS_CKPT")
+        ckpt_path = resume or envreg.raw("PYPARDIS_CKPT")
         if ckpt_path:
             from .utils.jobstate import JobState, fit_meta
 
@@ -1934,7 +1930,7 @@ class DBSCAN:
             self.mode = cfg["mode"]
         token = None
         if cfg.get("dispatch") and "dispatch" not in self._tune_pinned:
-            token = os.environ.get("PYPARDIS_DISPATCH", "")
+            token = envreg.raw("PYPARDIS_DISPATCH", "")
             os.environ["PYPARDIS_DISPATCH"] = str(cfg["dispatch"])
         get_logger().info(
             "auto-tune plan: %s", "; ".join(

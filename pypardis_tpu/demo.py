@@ -17,8 +17,6 @@ from __future__ import annotations
 import argparse
 import sys
 
-import numpy as np
-
 
 def make_demo_data(n: int = 750, seed: int = 0):
     """The reference's de-facto correctness baseline dataset."""

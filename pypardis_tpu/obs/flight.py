@@ -48,6 +48,7 @@ from typing import Dict, List, Optional
 
 from .recorder import current
 from .trace import _jsonable
+from ..utils import envreg
 
 FLIGHT_SCHEMA = "pypardis_tpu/flight@1"
 
@@ -78,7 +79,7 @@ class FlightRecorder:
         self.path = path
         if flush_interval_s is None:
             flush_interval_s = float(
-                os.environ.get("PYPARDIS_FLIGHT_FLUSH_S", _FLUSH_DEFAULT_S)
+                envreg.raw("PYPARDIS_FLIGHT_FLUSH_S", _FLUSH_DEFAULT_S)
             )
         self._flush_every = max(float(flush_interval_s), 0.0)
         self._f = open(path, "a", encoding="utf-8")
@@ -242,7 +243,7 @@ def open_flight(spec=None) -> Optional[FlightRecorder]:
     meanings; unset/empty disables).
     """
     if spec is None:
-        spec = os.environ.get("PYPARDIS_FLIGHT")
+        spec = envreg.raw("PYPARDIS_FLIGHT")
     if not spec:
         return None
     spec = str(spec)
@@ -292,7 +293,7 @@ def heartbeat(stage: str, done: int, total: int, t0_s: float) -> None:
     fl = getattr(current(), "flight", None)
     if fl is not None:
         fl.heartbeat(stage, done, total, eta)
-    env = os.environ.get("PYPARDIS_HEARTBEAT")
+    env = envreg.raw("PYPARDIS_HEARTBEAT")
     if not env or env in ("0", "false"):
         return
     try:

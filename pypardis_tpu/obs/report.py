@@ -21,6 +21,7 @@ from typing import Dict, Optional
 
 from .recorder import RunRecorder
 from .registry import _py
+from ..utils import envreg
 
 REPORT_SCHEMA = "pypardis_tpu/run_report@1"
 
@@ -90,9 +91,7 @@ _PEAK_FLOPS_DEFAULT = 1e12
 
 def _peak_flops():
     """(peak_flops, source) for the current default backend's chips."""
-    import os
-
-    env = os.environ.get("PYPARDIS_PEAK_FLOPS")
+    env = envreg.raw("PYPARDIS_PEAK_FLOPS")
     if env:
         return float(env), "env"
     try:

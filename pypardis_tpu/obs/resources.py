@@ -25,6 +25,7 @@ from __future__ import annotations
 import os
 import threading
 from typing import Optional
+from ..utils import envreg
 
 _INTERVAL_DEFAULT_S = 0.2
 _THREAD_NAME = "pypardis-resource-sampler"
@@ -81,7 +82,7 @@ def rss_soft_limit() -> int:
     """The host-RSS soft watermark in bytes (``PYPARDIS_RSS_SOFT_LIMIT``;
     0 = disabled)."""
     try:
-        return int(float(os.environ.get("PYPARDIS_RSS_SOFT_LIMIT", 0)))
+        return int(float(envreg.raw("PYPARDIS_RSS_SOFT_LIMIT", 0)))
     except (TypeError, ValueError):
         return 0
 
@@ -114,7 +115,7 @@ class ResourceSampler:
     def __init__(self, recorder, interval_s: Optional[float] = None):
         if interval_s is None:
             interval_s = float(
-                os.environ.get(
+                envreg.raw(
                     "PYPARDIS_RESOURCE_INTERVAL_S", _INTERVAL_DEFAULT_S
                 )
             )

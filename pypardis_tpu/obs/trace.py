@@ -92,6 +92,8 @@ class Tracer:
                 import jax
 
                 for dev in jax.devices():
+                    # graftlint: disable=device-put-aliasing -- scalar
+                    # transfer barrier; no host buffer involved
                     jax.device_put(0, dev).block_until_ready()
             sp.dur_s = time.perf_counter() - sp.t0_s
             self._keep(sp)

@@ -23,11 +23,12 @@ sets to a fixed capacity and pass a validity mask.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from ..utils import envreg
 
 _INT_INF = jnp.iinfo(jnp.int32).max
 # Finite stand-in for +/-inf in tile bounding boxes: differences of two
@@ -631,7 +632,7 @@ def live_tile_pairs(
 # iteration count dominates runtime (the 5M north-star's 666.5s
 # compute wall) and the one-time compile is noise.
 PAIR_DISPATCH_MIN_TILES = int(
-    os.environ.get("PYPARDIS_PAIR_DISPATCH_TILES", 2048)
+    envreg.raw("PYPARDIS_PAIR_DISPATCH_TILES", 2048)
 )
 
 
@@ -651,7 +652,7 @@ def pair_dispatch_enabled(nt: int | None = None) -> bool:
     affects programs compiled afterwards (tests call
     ``jax.clear_caches()`` around a flip).
     """
-    env = os.environ.get("PYPARDIS_DISPATCH", "auto")
+    env = envreg.raw("PYPARDIS_DISPATCH", "auto")
     if env == "dense":
         return False
     if env == "pair":
@@ -1088,7 +1089,7 @@ def sweep_max_edges() -> int:
     bytes/edge).  Past it the sweep degrades label-safely to
     per-config refits instead of allocating an unbounded slab — the
     graph is an amortization, never a correctness requirement."""
-    return int(os.environ.get("PYPARDIS_SWEEP_MAX_PAIRS", str(1 << 26)))
+    return int(envreg.raw("PYPARDIS_SWEEP_MAX_PAIRS", str(1 << 26)))
 
 
 def sweep_emission_route() -> str:
@@ -1102,7 +1103,7 @@ def sweep_emission_route() -> str:
     ``device`` spelling is what lets CPU CI exercise the device
     route's exact-total edge-budget ladder (the PR 13 NOTE debt).
     """
-    env = os.environ.get("PYPARDIS_SWEEP_EMISSION", "auto")
+    env = envreg.raw("PYPARDIS_SWEEP_EMISSION", "auto")
     if env in ("host", "device"):
         return env
     return "host" if jax.default_backend() == "cpu" else "device"
@@ -1120,7 +1121,7 @@ def default_edge_budget(n: int) -> int:
     slab (budget * 12 bytes).  Overflow is signalled exactly (the
     returned total is the true count), so one retry always suffices.
     """
-    env = os.environ.get("PYPARDIS_SWEEP_EDGE_BUDGET")
+    env = envreg.raw("PYPARDIS_SWEEP_EDGE_BUDGET")
     if env:
         return max(1, int(env))
     return max(1 << 16, 96 * n)

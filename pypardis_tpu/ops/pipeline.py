@@ -41,7 +41,9 @@ import jax.numpy as jnp
 
 import numpy as np
 
+from ..utils import envreg
 from .labels import dbscan_fixed_size
+from .precision import PAIR_STATS_WIDTH
 
 # Shapes/configs whose stage-2 programs have already been compiled —
 # see dbscan_device_pipeline for why the first call must sync between
@@ -354,13 +356,11 @@ def unpack_pipeline_result(packed):
     FLOP-model ``passes`` term), and the mixed-precision band
     telemetry (zeros on non-mixed fits).
     """
-    body = packed[:-5]
+    body = packed[:-PAIR_STATS_WIDTH]
     roots = (body & 0x3FFFFFFF) - 1
     core = (body >> 30) > 0
-    return (
-        roots, core, int(packed[-5]), int(packed[-4]), int(packed[-3]),
-        int(packed[-2]), int(packed[-1]),
-    )
+    stats = tuple(int(v) for v in packed[-PAIR_STATS_WIDTH:])
+    return (roots, core) + stats
 
 
 @functools.partial(
@@ -402,13 +402,13 @@ def _pipeline_cluster(
 # PYPARDIS_STEP_THRESHOLD=<points> (stepping trades one fused
 # execution for per-round dispatch latency, so small fits stay fused).
 STEP_THRESHOLD = int(
-    __import__("os").environ.get("PYPARDIS_STEP_THRESHOLD", 1 << 25)
+    envreg.raw("PYPARDIS_STEP_THRESHOLD", 1 << 25)
 )
 MAX_ROUNDS = 64
 # Propagation rounds fused per stepped device call (see
 # _cluster_stepped): divides the per-call sync latency by the batch.
 ROUND_BATCH = int(
-    __import__("os").environ.get("PYPARDIS_ROUND_BATCH", 8)
+    envreg.raw("PYPARDIS_ROUND_BATCH", 8)
 )
 
 
@@ -452,9 +452,7 @@ def _step_overlap_enabled() -> bool:
     probe discipline).  PYPARDIS_STEP_OVERLAP=1 opts in on deployments
     without that failure mode; =0 forces the serial loop anywhere.
     """
-    import os
-
-    env = os.environ.get("PYPARDIS_STEP_OVERLAP")
+    env = envreg.raw("PYPARDIS_STEP_OVERLAP")
     if env is not None:
         return env == "1"
     import jax as _jax

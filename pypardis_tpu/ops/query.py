@@ -68,6 +68,9 @@ def axis_sq_dists(q, c):
     acc = diff * diff
     for a in range(1, q.shape[1]):
         diff = q[:, a, None] - c[None, :, a]
+        # graftlint: disable=seal-f32 -- this IS the reference: numpy
+        # ufuncs never FMA-contract, and this exact rounding sequence
+        # defines the bit pattern the sealed device twin replays
         acc = acc + diff * diff
     return acc
 

@@ -76,7 +76,6 @@ re-measure).
 from __future__ import annotations
 
 import functools
-import os
 from typing import Optional
 
 import jax
@@ -100,8 +99,9 @@ from ..ops.labels import (
     pair_dispatch,
     resolve_backend,
 )
+from ..ops.precision import PAIR_STATS_WIDTH
 from ..partition import morton_range_split
-from ..utils import clamp_block, faults, round_up, validate_params
+from ..utils import clamp_block, envreg, faults, round_up, validate_params
 from ..utils.budget import run_ladders
 from ..utils.retry import (
     Retrier,
@@ -180,7 +180,7 @@ def _segbreak_skip(m, k, block, eps) -> bool:
     the fused engine's)."""
     return bool(
         m == 0 or eps is None or m < 4 * block or k > 64
-        or os.environ.get("PYPARDIS_GM_SEGBREAK", "1") == "0"
+        or envreg.raw("PYPARDIS_GM_SEGBREAK", "1") == "0"
     )
 
 
@@ -400,7 +400,10 @@ def build_morton_shards_streaming(points, n_shards, block, sharding,
             # uncommitted default_device array migrates back to
             # device 0 and breaks the single-device assembly);
             # committed operands then pin every .at[].set there.
+            # graftlint: disable=device-put-aliasing -- commits fresh
+            # jnp allocations to the device; no host buffer exists
             ow = jax.device_put(jnp.zeros((cap, k), jnp.float32), dev)
+            # graftlint: disable=device-put-aliasing -- same as ow
             gd = jax.device_put(jnp.full((cap,), n, jnp.int32), dev)
             for off, ids, rows in split.iter_range_rows(
                 s, chunk=1 << 19
@@ -877,6 +880,14 @@ def _gm_boundary_tiles(arrays, eps, *, mesh, axis, block, btcap, base,
         return (bnd, bmsk, bgid), baux
     n_dev = mesh.devices.size
     cap = arrays[0].shape[1]
+    if btcap is None:
+        # The exhaustion messages below have always named
+        # PYPARDIS_GM_BTCAP as the remedy; until graftlint's env
+        # registry audit (R4) nothing actually read it.  An env-set
+        # cap is a user contract exactly like an explicit argument.
+        env_btcap = envreg.raw("PYPARDIS_GM_BTCAP")
+        if env_btcap:
+            btcap = int(env_btcap)
     # Exchange granularity == the kernel block: finer exchange tiles
     # were measured to INCREASE live tile pairs (each kernel tile then
     # unions several senders' boxes), and the row-exact retention mask
@@ -1101,11 +1112,14 @@ def _gm_fixpoint(home_label, core_g, bgid, b_glab, *, mesh, axis,
     import time as _time
 
     rep = NamedSharding(mesh, P())
+    # graftlint: disable=device-put-aliasing -- fresh np.arange
     lab_map = jax.device_put(np.arange(n_points + 1, dtype=np.int32), rep)
     rounds = 0
     if jobstate is not None:
         saved = jobstate.gm_restore(int(budget_tag), n_points + 1)
         if saved is not None:
+            # graftlint: disable=device-put-aliasing -- fresh array
+            # deserialized from the checkpoint npz
             lab_map = jax.device_put(saved[0], rep)
             rounds = min(int(saved[1]), max(merge_rounds - 1, 0))
             obs_event("jobstate_restore", route="gm_fixpoint",
@@ -1488,7 +1502,7 @@ def _gm_chained_dbscan(
                     )
             t_exec_cell[0] = _time.perf_counter() - t_exec
             pstats = np.stack(pstats_rows) if pstats_rows else (
-                np.zeros((1, 5), np.int32)
+                np.zeros((1, PAIR_STATS_WIDTH), np.int32)
             )
             out = (home_label, core_full[:n],
                    np.concatenate(halo_gids) if halo_gids
@@ -1643,7 +1657,7 @@ def global_morton_dbscan(
     if stream is None:
         stream = isinstance(points, np.memmap)
     if chain is None:
-        chain = int(os.environ.get("PYPARDIS_GM_CHAIN", "0") or 0)
+        chain = int(envreg.raw("PYPARDIS_GM_CHAIN", "0") or 0)
     if n_shards == 1 and int(chain) > 1:
         import time as _time
 
@@ -1706,7 +1720,7 @@ def global_morton_dbscan(
     # slab's dispatch decision (the combined slab is never smaller, so
     # its oc_extract resolves the compacted path whenever this does).
     overlap = (
-        os.environ.get("PYPARDIS_GM_OVERLAP", "1") != "0"
+        envreg.raw("PYPARDIS_GM_OVERLAP", "1") != "0"
         and n_shards > 1
         and (owned_kind == "pallas"
              or pair_dispatch(metric, cap // block))
@@ -1723,7 +1737,7 @@ def global_morton_dbscan(
             "gm_owned", dispatch_tag(cap // block), (n_shards, cap, k),
             block, precision, float(eps), metric,
         )
-        pb_env = os.environ.get("PYPARDIS_PAIR_BUDGET")
+        pb_env = envreg.raw("PYPARDIS_PAIR_BUDGET")
         pb_owned = (
             int(pb_env) if pb_env
             else (pair_budget if pair_budget is not None
@@ -1962,6 +1976,7 @@ def global_morton_dbscan(
                     core_np, dstats = _overlap_core(pb, b2)
                     out = _gm_cluster_step(
                         owned, omsk, ogid, bnd, bmsk, bgid,
+                        # graftlint: disable=device-put-aliasing -- fresh _overlap_core host array
                         jax.device_put(core_np, sharding),
                         eps=float(eps), min_samples=int(min_samples),
                         metric=metric, block=block, mesh=mesh,

@@ -63,7 +63,7 @@ from ..ops.labels import (
     oc_propagate_banded,
 )
 from ..partition import spatial_order
-from ..utils import clamp_block, faults, round_up
+from ..utils import clamp_block, envreg, faults, round_up
 from ..utils.budget import run_ladders
 from ..utils.retry import Retrier, is_degradable_error, note_degraded
 from . import staging
@@ -285,6 +285,9 @@ def build_owned_shards_streaming(points, partitioner, eps, block, mesh):
             if p < p_real:
                 _fill_slab(ow, ms, gd, 0, points, part_idx[p], center)
             for piece, host in zip(pieces, (ow, ms, gd)):
+                # graftlint: disable=device-put-aliasing -- ow/ms/gd
+                # are freshly np.zeros-allocated per partition and
+                # del'd right after the put; never pool-borrowed
                 piece.append(jax.device_put(host, devices[d]))
             del ow, ms, gd
         for buf, piece in zip(bufs, pieces):
@@ -478,7 +481,10 @@ def _ring_build_cached(points, partitioner, eps, n_shards, block, sharding):
     exp_lo, exp_hi = _pad_inverted_boxes(exp_lo, exp_hi, p_total)
     args = (
         *arrays_o,
+        # graftlint: disable=device-put-aliasing -- fresh padded box
+        # metadata from _pad_inverted_boxes, never pool-borrowed
         jax.device_put(exp_lo, sharding),
+        # graftlint: disable=device-put-aliasing -- same as exp_lo
         jax.device_put(exp_hi, sharding),
     )
     return args, dict(o_stats), bufs
@@ -821,9 +827,7 @@ def _overlap_enabled(overlap) -> bool:
     the PYPARDIS_CHAINED_OVERLAP env kill-switch, default on."""
     if overlap is not None:
         return bool(overlap)
-    import os
-
-    return os.environ.get("PYPARDIS_CHAINED_OVERLAP", "1") != "0"
+    return envreg.raw("PYPARDIS_CHAINED_OVERLAP", "1") != "0"
 
 
 def _put_slab(a, dev):
@@ -1658,6 +1662,8 @@ def _oc_host_tables(
         counts_band_np = np.asarray(counts_band).reshape(-1, 2)
     else:
         own_core = np.asarray(own_core)
+        # graftlint: disable=device-put-aliasing -- own_core is a
+        # fresh np.asarray copy made one line up, never pool-borrowed
         own_core_dev = jax.device_put(
             own_core, NamedSharding(mesh, P(axis))
         )
@@ -1674,6 +1680,8 @@ def _oc_host_tables(
     halo_core = core_full[np.clip(hg_np, 0, n)] & (hg_np < n)
     sharding = NamedSharding(mesh, P(axis))
     own_glab, halo_glab, pstats = _oc_cluster_step(
+        # graftlint: disable=device-put-aliasing -- halo_core is a
+        # fresh fancy-indexing product of this function
         *arrays, own_core_dev, jax.device_put(halo_core, sharding),
         eps=float(eps), metric=metric, block=block, mesh=mesh, axis=axis,
         precision=precision, backend=backend, pair_budget=pair_budget,
@@ -2549,6 +2557,8 @@ def sharded_dbscan_device(
     )
     sharding = NamedSharding(mesh, P(axis))
     args = tuple(
+        # graftlint: disable=device-put-aliasing -- re-shards the
+        # caller's device-resident jnp arrays; no host pool buffer
         jax.device_put(a, sharding)
         for a in (owned, msk, gid, exp_lo, exp_hi)
     )

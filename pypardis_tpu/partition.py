@@ -33,6 +33,7 @@ from typing import Dict, Optional
 import numpy as np
 
 from .geometry import BoundingBox, BoxStack
+from .utils import envreg
 
 _VALID_SPLIT_METHODS = ("min_var", "rotation", "mean_var", "median_search")
 _VALID_BUILDERS = ("auto", "level", "legacy")
@@ -720,7 +721,7 @@ def morton_range_split_streaming(
     # -- splitters from a uniform sample -------------------------------
     rec_bytes = 8 * n_words + 8 + 4 * k
     if bucket_bytes is None:
-        bucket_bytes = int(float(os.environ.get(
+        bucket_bytes = int(float(envreg.raw(
             "PYPARDIS_STREAM_BUCKET_MB", 32)) * 1e6)
     n_buckets = int(min(max(1, -(-n * rec_bytes // max(bucket_bytes, 1))),
                         512))
@@ -744,7 +745,7 @@ def morton_range_split_streaming(
         spl_cols = None
 
     # -- pass 2: bucket-append spill -----------------------------------
-    base_dir = spill_dir or os.environ.get("PYPARDIS_SPILL_DIR")
+    base_dir = spill_dir or envreg.raw("PYPARDIS_SPILL_DIR")
     sdir = tempfile.mkdtemp(prefix="pypardis_gm_spill_", dir=base_dir)
     rec = np.dtype([("w", "<u8", (n_words,)), ("id", "<i8"),
                     ("x", "<f4", (k,))])

@@ -444,6 +444,9 @@ class ReplicatedQueryEngine(QueryEngine):
 
             rep = NamedSharding(self.mesh, PartitionSpec())
             self._rep_arrays = tuple(
+                # graftlint: disable=device-put-aliasing -- replicates
+                # the index's own host mirrors (caller-owned, never
+                # pooled); the epoch in _rep_key invalidates on update
                 jax.device_put(np.asarray(a), rep)
                 for a in (idx.coords, idx.labels, idx.blo, idx.bhi)
             )
@@ -526,14 +529,18 @@ class ReplicatedQueryEngine(QueryEngine):
 
         coords, labels, blo, bhi = self._replicated_arrays()
         fn = self._rep_fn(self.index.block, self.index.nb, self.precision)
+        # graftlint: disable=device-put-aliasing -- each put ships a
+        # fresh np.ascontiguousarray copy made in the call itself
         q_d = jax.device_put(
             np.ascontiguousarray(qbuf[perm]),
             NamedSharding(self.mesh, PS("p", None, None)),
         )
+        # graftlint: disable=device-put-aliasing -- same as q_d
         qm_d = jax.device_put(
             np.ascontiguousarray(qmask[perm]),
             NamedSharding(self.mesh, PS("p", None)),
         )
+        # graftlint: disable=device-put-aliasing -- same as q_d
         tl_d = jax.device_put(
             np.ascontiguousarray(tile_leaf[perm]),
             NamedSharding(self.mesh, PS("p")),

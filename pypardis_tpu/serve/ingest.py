@@ -60,11 +60,12 @@ from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
+from ..utils import envreg
 
 
 def _env_int(name: str, default: int) -> int:
     try:
-        return int(os.environ.get(name, default))
+        return int(envreg.raw(name, default))
     except (TypeError, ValueError):
         return int(default)
 

@@ -35,6 +35,7 @@ import json
 import os
 from dataclasses import asdict, dataclass, field
 from typing import Dict, List, Optional
+from ..utils import envreg
 
 CORPUS_SCHEMA = "pypardis_tpu/tuning_corpus@1"
 
@@ -273,7 +274,7 @@ def local_corpus_path() -> Optional[str]:
     feedback loop entirely (auto fits then plan from the committed
     archives and heuristics alone).
     """
-    env = os.environ.get("PYPARDIS_TUNE_CORPUS")
+    env = envreg.raw("PYPARDIS_TUNE_CORPUS")
     if env is not None:
         if env in ("", "0"):
             return None
@@ -359,7 +360,7 @@ def harvest_corpus(
     """
     if roots is None:
         roots = [os.getcwd()]
-        env_root = os.environ.get("PYPARDIS_TUNE_ROOT")
+        env_root = envreg.raw("PYPARDIS_TUNE_ROOT")
         if env_root:
             roots.append(env_root)
     files: List[str] = []

@@ -27,12 +27,12 @@ independent of n.
 
 from __future__ import annotations
 
-import os
 import time
 from dataclasses import asdict, dataclass, field
 from typing import Dict, List, Optional
 
 import numpy as np
+from ..utils import envreg
 
 _DENSITY_ROWS = 1024
 # Relative half-width of the mixed-precision rescore band around
@@ -156,7 +156,7 @@ def probe_dataset(
     t0 = time.perf_counter()
     n, k = points.shape
     if sample_rows is None:
-        env = os.environ.get("PYPARDIS_TUNE_SAMPLE")
+        env = envreg.raw("PYPARDIS_TUNE_SAMPLE")
         if env:
             sample_rows = int(env)
         else:

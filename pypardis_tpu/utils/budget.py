@@ -16,6 +16,7 @@ from __future__ import annotations
 import numpy as np
 
 from .hints import PAIR_BUDGET_HINTS
+from . import envreg
 from .shaping import round_up
 
 
@@ -88,8 +89,6 @@ def run_ladders(run_step, hint_key, pair_budget, merge_rounds):
     plus its pair stats, so drivers can surface live-pair volume and
     kernel passes (the achieved-FLOP/s model) without a second fetch.
     """
-    import os
-
     from .log import get_logger
 
     this_pair = pair_budget
@@ -97,7 +96,7 @@ def run_ladders(run_step, hint_key, pair_budget, merge_rounds):
         # Operator knob: a known-dense deployment can pin the budget
         # process-wide and skip the overflow-rerun (and its recompile)
         # on every cold fit.
-        env = os.environ.get("PYPARDIS_PAIR_BUDGET")
+        env = envreg.raw("PYPARDIS_PAIR_BUDGET")
         if env:
             this_pair = int(env)
     pair_attempts = 2  # exact-total retry: one is always enough

@@ -1,0 +1,247 @@
+"""The single registry of every ``PYPARDIS_*`` environment variable.
+
+Before this module existed the project had ~40 ``PYPARDIS_*`` knobs
+read at 37 sites with no central declaration: a typo'd name silently
+fell back to its default (the reader can't tell "unset" from
+"misspelled"), new knobs documented themselves only in CHANGES.md
+prose, and one knob (``PYPARDIS_GM_BTCAP``) was *named in an error
+message as the remedy* while nothing ever read it.  The graftlint R4
+rule (``env-registry``) now fails CI on any ``PYPARDIS_*`` literal not
+declared here, and the README "Environment variables" table is
+generated from this registry (``scripts/graftlint.py --envdocs``) so
+the docs cannot drift from the code.
+
+Trace-time semantics (the R3 ``trace-env-read`` contract)
+---------------------------------------------------------
+
+:func:`raw` reads the LIVE process environment at call time.  When the
+calling function runs inside a ``jax.jit`` / ``shard_map`` / ``pjit``
+trace (directly or transitively — e.g. the ``PYPARDIS_DISPATCH`` read
+in ``ops.distances.pair_dispatch_enabled``), the value read is **baked
+into the compiled program**: flipping the variable afterwards does NOT
+change already-compiled programs, only ones traced later (callers must
+``jax.clear_caches()`` to re-resolve — the PR 11 dispatch lesson).
+Routing every such read through this module is what lets graftlint
+R3 distinguish a *documented* trace-time read from an accidental one:
+direct ``os.environ`` reads inside jit-reachable functions fail lint.
+
+The registry is parsed STATICALLY by the analysis package
+(``pypardis_tpu.analysis.envmodel``) — keep every :class:`EnvVar`
+field a literal (no computed names, defaults, or docs).
+"""
+
+from __future__ import annotations
+
+import difflib
+import os
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class EnvVar:
+    """One declared knob: its name, value type, default, and one-line doc.
+
+    ``default`` is the *documented* default rendered in the README
+    table — a human-readable spelling (``"auto"``, ``"0 (off)"``,
+    ``"~/.cache/pypardis_tpu/xla"``), not necessarily the literal the
+    reading site passes to :func:`raw` (sites keep their exact
+    historical parsing so the migration is value-identical).
+    """
+
+    name: str
+    type: str  # str | int | float | bool | path | spec
+    default: str
+    doc: str
+
+
+# Declaration order is the README table order: grouped by subsystem,
+# alphabetical within a group.  Every field must stay a literal — the
+# static checker reads this file with ast, it never imports it.
+_DECLARATIONS: Tuple[EnvVar, ...] = (
+    # -- kernels / dispatch -------------------------------------------
+    EnvVar("PYPARDIS_DISPATCH", "str", "auto",
+           "Kernel tile-pair dispatch: `auto` compacts past the tile "
+           "threshold, `pair` forces the live-pair list, `dense` the "
+           "T² scan (read at TRACE time; flip needs "
+           "`jax.clear_caches()`)."),
+    EnvVar("PYPARDIS_PAIR_DISPATCH_TILES", "int", "2048",
+           "Tile count past which `dispatch=auto` compacts to the "
+           "live tile-pair list."),
+    EnvVar("PYPARDIS_PAIR_BUDGET", "int", "unset (auto ladder)",
+           "Process-wide live tile-pair budget pin; skips the "
+           "overflow-rerun recompile on known-dense deployments."),
+    EnvVar("PYPARDIS_STEP_THRESHOLD", "int", "33554432",
+           "Point count past which the fused single-shard route "
+           "switches to host-stepped propagation rounds."),
+    EnvVar("PYPARDIS_ROUND_BATCH", "int", "8",
+           "Propagation rounds per host-stepped dispatch batch."),
+    EnvVar("PYPARDIS_STEP_OVERLAP", "bool", "auto (off on TPU)",
+           "Speculative next-batch dispatch on the stepped route; "
+           "queued re-execution poisons tunneled TPU workers."),
+    # -- distributed execution ----------------------------------------
+    EnvVar("PYPARDIS_CHAINED_OVERLAP", "bool", "1",
+           "Double-buffered host build/ship overlap on the 1-device "
+           "chained route."),
+    EnvVar("PYPARDIS_GM_BTCAP", "int", "unset (auto ladder)",
+           "Explicit global-Morton boundary-tile send capacity per "
+           "device; unset uses the metadata plan + doubling ladder."),
+    EnvVar("PYPARDIS_GM_CHAIN", "int", "0",
+           "On a 1-device mesh, chain this many global-Morton ranges "
+           "through the single chip."),
+    EnvVar("PYPARDIS_GM_OVERLAP", "bool", "1",
+           "Hide global-Morton ring rounds behind the owned-prefix "
+           "counts pass."),
+    EnvVar("PYPARDIS_GM_SEGBREAK", "bool", "1",
+           "Segment-break padding of global-Morton shard slabs (off "
+           "leaks live pairs vs KD boxes)."),
+    # -- out-of-core / streaming builds -------------------------------
+    EnvVar("PYPARDIS_SPILL_DIR", "path", "system tempdir",
+           "Parent directory for the external sample-sort's "
+           "tempdir-scoped spill files."),
+    EnvVar("PYPARDIS_STREAM_BUCKET_MB", "float", "32",
+           "Target spill-bucket size for the streaming Morton build "
+           "(<= 512 buckets)."),
+    # -- sweeps -------------------------------------------------------
+    EnvVar("PYPARDIS_SWEEP_EDGE_BUDGET", "int", "unset (96/row)",
+           "Neighbor-pair graph edge capacity for `DBSCAN.sweep`; "
+           "seeds the exact-total retry ladder."),
+    EnvVar("PYPARDIS_SWEEP_EMISSION", "str", "auto",
+           "Sweep-graph pair-emission route: `host`, `device`, or "
+           "`auto` (host on CPU, device elsewhere)."),
+    EnvVar("PYPARDIS_SWEEP_MAX_PAIRS", "int", "67108864",
+           "Hard cap on the sweep graph slab in edges; past it the "
+           "sweep degrades label-safely to per-config refits."),
+    # -- caches -------------------------------------------------------
+    EnvVar("PYPARDIS_COMPILE_CACHE", "path", "~/.cache/pypardis_tpu/xla",
+           "Persistent XLA compilation cache directory; empty "
+           "disables."),
+    EnvVar("PYPARDIS_LAYOUT_CACHE", "bool", "1",
+           "Single-shard device layout cache (warm refits skip "
+           "staging + Morton sort)."),
+    EnvVar("PYPARDIS_LAYOUT_CACHE_MAX", "int", "536870912",
+           "Per-entry byte ceiling for the layout cache."),
+    # -- checkpoint / resume ------------------------------------------
+    EnvVar("PYPARDIS_CKPT", "path", "unset",
+           "Checkpoint-resume npz path for fits (same as "
+           "`train(resume=...)`)."),
+    EnvVar("PYPARDIS_CKPT_EVERY_S", "float", "0",
+           "Minimum seconds between phase-boundary checkpoint "
+           "snapshots (0 = every boundary)."),
+    # -- ingest / compaction ------------------------------------------
+    EnvVar("PYPARDIS_COMPACT_DELTAS", "int", "512",
+           "Compact once this many write deltas landed since the "
+           "last index generation swap."),
+    EnvVar("PYPARDIS_COMPACT_SLAB_BYTES", "int", "67108864",
+           "Compact once the index's appended slabs hold this many "
+           "bytes."),
+    # -- fault tolerance ----------------------------------------------
+    EnvVar("PYPARDIS_FAULTS", "spec", "unset",
+           "Deterministic fault-injection plan: "
+           "`site[:occurrence]=kind[(arg)]`, comma-separated."),
+    EnvVar("PYPARDIS_RETRY_DEADLINE_S", "float", "unset",
+           "Wall-clock deadline across a retry ladder's attempts."),
+    # -- observability ------------------------------------------------
+    EnvVar("PYPARDIS_FLIGHT", "path", "unset",
+           "Flight-recorder JSONL file (or directory for one file "
+           "per fit); unset disables."),
+    EnvVar("PYPARDIS_FLIGHT_FLUSH_S", "float", "0.25",
+           "Flight-recorder flush interval (spans/events flush "
+           "eagerly regardless)."),
+    EnvVar("PYPARDIS_HEARTBEAT", "float", "0 (off)",
+           "Minimum gap between heartbeat log lines with ETA; "
+           "0/unset logs none (flight records always carry them)."),
+    EnvVar("PYPARDIS_PEAK_FLOPS", "float", "per-backend table",
+           "Chip peak FLOP/s override for the MFU gauge."),
+    EnvVar("PYPARDIS_RESOURCE_INTERVAL_S", "float", "0.2",
+           "Resource-watermark sampler period."),
+    EnvVar("PYPARDIS_RSS_SOFT_LIMIT", "int", "0 (off)",
+           "Host-RSS soft watermark in bytes; crossing it flips "
+           "`merge='auto'` to the host-spill rung preemptively."),
+    # -- validation ---------------------------------------------------
+    EnvVar("PYPARDIS_SKIP_FINITE_CHECK", "bool", "0",
+           "Skip the NaN/inf input scan for trusted pipelines."),
+    # -- auto-tuning --------------------------------------------------
+    EnvVar("PYPARDIS_TUNE_CORPUS", "path",
+           "~/.cache/pypardis_tpu/tuning_corpus.jsonl",
+           "Local auto-fit telemetry corpus JSONL; `0`/empty "
+           "disables the feedback loop."),
+    EnvVar("PYPARDIS_TUNE_ROOT", "path", "unset",
+           "Extra directory scanned for committed benchmark archives "
+           "when harvesting the tuning corpus."),
+    EnvVar("PYPARDIS_TUNE_SAMPLE", "int", "unset (adaptive)",
+           "Auto-tune probe sample rows; unset picks "
+           "min(32768, max(4096, n/16))."),
+    # -- data ---------------------------------------------------------
+    EnvVar("PYPARDIS_DATA_DIR", "path", "~/.cache/pypardis_tpu/data",
+           "Cache directory for checksum-verified real-dataset "
+           "downloads."),
+    # -- bench / CI harness -------------------------------------------
+    EnvVar("PYPARDIS_BENCH_DIFF_THR", "float", "0.05",
+           "bench_diff regression threshold on the best-of-N delta "
+           "between disjoint sample ranges."),
+    EnvVar("PYPARDIS_PROBE_DEVICES", "int", "8",
+           "Faked CPU-mesh device count the probe scripts "
+           "configure."),
+    EnvVar("PYPARDIS_PROBE_PLATFORM", "str", "unset",
+           "`native` makes probe scripts leave the ambient JAX "
+           "platform alone (hardware runs)."),
+    EnvVar("PYPARDIS_TEST_PLATFORM", "str", "unset",
+           "`native` makes the test harness leave the ambient JAX "
+           "platform alone (`make tpu-smoke`)."),
+)
+
+REGISTRY: Dict[str, EnvVar] = {v.name: v for v in _DECLARATIONS}
+assert len(REGISTRY) == len(_DECLARATIONS), "duplicate EnvVar declaration"
+
+
+class UnregisteredEnvVar(KeyError):
+    """A ``PYPARDIS_*`` read of a name not declared in the registry."""
+
+
+def _require(name: str) -> None:
+    if name in REGISTRY:
+        return
+    hint = difflib.get_close_matches(name, REGISTRY, n=1)
+    raise UnregisteredEnvVar(
+        f"{name} is not declared in pypardis_tpu.utils.envreg"
+        + (f" — did you mean {hint[0]}?" if hint else "")
+    )
+
+
+def raw(name: str, default: Optional[str] = None) -> Optional[str]:
+    """``os.environ.get(name, default)`` for a REGISTERED knob.
+
+    The one sanctioned read path: byte-identical to the direct read it
+    replaces (callers keep their historical parsing of the returned
+    string), plus the registration check that makes a typo'd name fail
+    loudly instead of silently meaning "unset".  See the module
+    docstring for the trace-time contract when called under a jit
+    trace.
+    """
+    _require(name)
+    return os.environ.get(name, default)
+
+
+def declared_names() -> Tuple[str, ...]:
+    """Registered names, declaration order."""
+    return tuple(v.name for v in _DECLARATIONS)
+
+
+def render_markdown() -> str:
+    """The README "Environment variables" table body.
+
+    ``scripts/graftlint.py --envdocs`` prints this; the R4 lint run
+    fails when the committed README section differs, the same way
+    ``check_bench_json`` pins the telemetry schema.
+    """
+    lines = [
+        "| Variable | Type | Default | Meaning |",
+        "| --- | --- | --- | --- |",
+    ]
+    for v in _DECLARATIONS:
+        doc = " ".join(v.doc.split())
+        lines.append(
+            f"| `{v.name}` | {v.type} | `{v.default}` | {doc} |"
+        )
+    return "\n".join(lines) + "\n"

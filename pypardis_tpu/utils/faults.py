@@ -51,6 +51,9 @@ very machinery a real fault would exercise):
 ``gm.exchange``        global-Morton boundary-tile exchange
 ``gm.ring_round``      each boundary-tile ppermute ring round
 ``gm.fixpoint_round``  each cross-device pmin fixpoint round
+``gm.execute``         global-Morton cluster/execute dispatches
+``gm.chained_range``   1-device chained global-Morton per-range
+                       dispatches (counts + propagation)
 ``serve.drain``        :meth:`QueryEngine.drain`
 ``ingest.batch``       batched writes (``LiveModel.insert_batch`` /
                        ``delete_batch`` — fired BEFORE any state
@@ -70,12 +73,34 @@ rows).
 from __future__ import annotations
 
 import contextlib
-import os
 import re
 import time
 from typing import Dict, List, Optional, Tuple
+from . import envreg
 
 _KINDS = ("transfer_error", "oom", "error", "hang")
+
+# The machine-readable site registry (the docstring table above is the
+# prose twin).  graftlint's fault-site rule (R6) fails CI on any
+# maybe_fail/transfer/plan literal not declared here AND on any entry
+# here with no surviving injection site — this tuple can neither rot
+# nor drift the way the prose table once silently missed
+# ``gm.execute`` / ``gm.chained_range``.
+KNOWN_SITES = (
+    "staging.device_put",
+    "pipeline.cluster",
+    "stepped.batch",
+    "chained.partition",
+    "sharded.execute",
+    "gm.exchange",
+    "gm.ring_round",
+    "gm.fixpoint_round",
+    "gm.execute",
+    "gm.chained_range",
+    "serve.drain",
+    "ingest.batch",
+    "compact.phase",
+)
 
 _ENTRY_RE = re.compile(
     r"^(?P<site>[a-z0-9_.]+?)(?::(?P<occ>\*|\d+))?="
@@ -205,7 +230,7 @@ _PLAN: Optional[FaultPlan] = None
 
 def _init_from_env() -> None:
     global _PLAN
-    spec = os.environ.get("PYPARDIS_FAULTS")
+    spec = envreg.raw("PYPARDIS_FAULTS")
     if spec:
         _PLAN = FaultPlan.parse(spec)
 

@@ -47,6 +47,7 @@ import time
 from typing import Dict, Optional, Tuple
 
 import numpy as np
+from . import envreg
 
 SCHEMA = "pypardis_tpu/jobstate@1"
 
@@ -118,7 +119,7 @@ class JobState:
         if every_s is None:
             try:
                 every_s = float(
-                    os.environ.get("PYPARDIS_CKPT_EVERY_S", 0.0)
+                    envreg.raw("PYPARDIS_CKPT_EVERY_S", 0.0)
                 )
             except (TypeError, ValueError):
                 every_s = 0.0

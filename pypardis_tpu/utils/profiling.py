@@ -21,7 +21,8 @@ from __future__ import annotations
 import contextlib
 from typing import Dict
 
-from ..obs import MetricsRegistry, RunRecorder, Tracer  # noqa: F401 — re-export
+# graftlint: disable=unused-import -- back-compat re-export surface
+from ..obs import MetricsRegistry, RunRecorder, Tracer
 
 
 class PhaseTimer:

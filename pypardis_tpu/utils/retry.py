@@ -36,10 +36,10 @@ faults exercise exactly the production classification.
 
 from __future__ import annotations
 
-import os
 import random
 import time
 from typing import Callable, Optional, Sequence
+from . import envreg
 
 # The historical transient ladder (ops.pipeline round-3): immediate
 # retry, then two backed-off ones — a crashed tunnel worker needs tens
@@ -160,7 +160,7 @@ class Retrier:
             )
         self.jitter = float(jitter)
         if deadline_s is None:
-            env = os.environ.get("PYPARDIS_RETRY_DEADLINE_S")
+            env = envreg.raw("PYPARDIS_RETRY_DEADLINE_S")
             deadline_s = float(env) if env else None
         self.deadline_s = deadline_s
 

@@ -1,0 +1,108 @@
+#!/usr/bin/env python3
+"""graftlint CLI — the repo's AST-level invariant gate (`make lint`).
+
+Checks every source file in ``pypardis_tpu/``, ``scripts/``,
+``bench.py`` and ``benchdata.py`` against the named invariant rules
+(R1 tracer constants, R2 device_put aliasing, R3 trace-time env reads,
+R4 env-var registry + README table, R5 seal_f32 discipline, R6
+fault-site/magic-width hygiene, R7 unused imports).  Exit 1 on any
+non-baselined error finding.
+
+Usage::
+
+    python scripts/graftlint.py                # full repo
+    python scripts/graftlint.py path.py ...    # just these files
+    python scripts/graftlint.py --envdocs      # README env table
+    python scripts/graftlint.py --list-rules
+    python scripts/graftlint.py --rules env-registry,fault-site
+    python scripts/graftlint.py --write-baseline   # grandfather now
+
+The analysis package is stdlib-only; to keep this CLI sub-second we
+load ``pypardis_tpu.analysis`` through a stub parent package so
+``pypardis_tpu/__init__.py`` (which imports jax and configures the
+compile cache) never runs.  In-process consumers (tests) import
+``pypardis_tpu.analysis`` normally instead.
+"""
+
+import argparse
+import importlib
+import importlib.machinery
+import os
+import sys
+
+_ROOT = os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))
+)
+
+
+def _load_analysis():
+    if "pypardis_tpu" not in sys.modules:
+        spec = importlib.machinery.ModuleSpec(
+            "pypardis_tpu", None, is_package=True
+        )
+        stub = importlib.util.module_from_spec(spec)
+        stub.__path__ = [os.path.join(_ROOT, "pypardis_tpu")]
+        sys.modules["pypardis_tpu"] = stub
+    return importlib.import_module("pypardis_tpu.analysis")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*",
+                    help="restrict to these files (default: the "
+                         "enforced fileset)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule names to run")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--envdocs", action="store_true",
+                    help="print the README env-var table and exit")
+    ap.add_argument("--baseline",
+                    default=os.path.join(
+                        _ROOT, "scripts", "graftlint_baseline.json"
+                    ))
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="grandfather every current finding into the "
+                         "baseline file")
+    ap.add_argument("--verbose", "-v", action="store_true")
+    args = ap.parse_args(argv)
+
+    analysis = _load_analysis()
+    envmodel = importlib.import_module(
+        "pypardis_tpu.analysis.envmodel"
+    )
+    report = importlib.import_module("pypardis_tpu.analysis.report")
+    baseline_mod = importlib.import_module(
+        "pypardis_tpu.analysis.baseline"
+    )
+
+    if args.envdocs:
+        sys.stdout.write(
+            envmodel.parse_env_registry(_ROOT).render_markdown()
+        )
+        return 0
+    if args.list_rules:
+        print(report.render_rules())
+        return 0
+
+    rules = (
+        [r.strip() for r in args.rules.split(",") if r.strip()]
+        if args.rules else None
+    )
+    paths = [os.path.abspath(p) for p in args.paths] or None
+    result = analysis.run_lint(
+        _ROOT, paths=paths, rules=rules,
+        baseline_path=args.baseline,
+    )
+    if args.write_baseline:
+        baseline_mod.write(args.baseline, result.raw_pairs)
+        print(
+            f"graftlint: wrote {len(result.raw_pairs)} baseline "
+            f"entries to {args.baseline}"
+        )
+        return 0
+    print(report.render(result, verbose=args.verbose))
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

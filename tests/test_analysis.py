@@ -1,0 +1,504 @@
+"""graftlint (ISSUE 15): paired fire/pass fixtures per rule,
+suppression parsing, baseline round-trip, the whole-repo zero-findings
+gate, the < 10s runtime gate, and env-registry/README sync.
+
+Fixture runs build a minimal tmp repo (the real ``envreg.py`` /
+``faults.py`` copied in, plus the snippet under test at a controlled
+relative path) so rule scoping by path works without touching the real
+tree.  Deleting any rule's implementation makes its "must fire" test
+here fail — that is the acceptance contract.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from pypardis_tpu import analysis
+from pypardis_tpu.analysis import baseline as baseline_mod
+from pypardis_tpu.analysis import envmodel
+from pypardis_tpu.utils import envreg, faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def make_repo(tmp_path, files, copy_registries=True):
+    """A minimal lintable tree: registries + the snippet files."""
+    root = str(tmp_path)
+    os.makedirs(os.path.join(root, "pypardis_tpu", "utils"),
+                exist_ok=True)
+    if copy_registries:
+        for rel in ("pypardis_tpu/utils/envreg.py",
+                    "pypardis_tpu/utils/faults.py"):
+            shutil.copyfile(os.path.join(REPO, rel),
+                            os.path.join(root, rel))
+    paths = []
+    for rel, text in files.items():
+        p = os.path.join(root, rel)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        with open(p, "w", encoding="utf-8") as f:
+            f.write(textwrap.dedent(text))
+        paths.append(p)
+    return root, paths
+
+
+def lint(tmp_path, files, **kw):
+    root, paths = make_repo(tmp_path, files)
+    return analysis.run_lint(root, paths=paths, **kw)
+
+
+def rules_of(result):
+    return [f.rule for f in result.findings]
+
+
+# -- whole-repo gate ---------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def repo_result():
+    return analysis.run_lint(REPO)
+
+
+def test_whole_repo_zero_findings(repo_result):
+    assert repo_result.findings == [], "\n".join(
+        f"{f.location()}: [{f.rule}] {f.message}"
+        for f in repo_result.findings
+    )
+
+
+def test_whole_repo_runtime_gate(repo_result):
+    # The lint gate must never become the slow step of verify /
+    # bench-smoke (ISSUE 15 satellite: < 10s on the CI container).
+    assert repo_result.elapsed_s < 10.0, repo_result.elapsed_s
+    assert repo_result.files > 80  # really scanned the repo
+
+
+def test_rule_registry_complete():
+    assert set(analysis.RULE_REGISTRY) == {
+        "module-jnp-constant", "device-put-aliasing",
+        "trace-env-read", "env-registry", "seal-f32",
+        "fault-site", "magic-width", "unused-import",
+    }
+
+
+# -- R1 module-jnp-constant --------------------------------------------
+
+
+def test_r1_fires_on_module_jnp_constant(tmp_path):
+    r = lint(tmp_path, {"pypardis_tpu/mod.py": """
+        import jax.numpy as jnp
+        _ZERO = jnp.int32(0)
+    """}, rules=["module-jnp-constant"])
+    assert rules_of(r) == ["module-jnp-constant"]
+
+
+def test_r1_passes_numpy_and_inert(tmp_path):
+    r = lint(tmp_path, {"pypardis_tpu/mod.py": """
+        import jax.numpy as jnp
+        import numpy as np
+        _ZERO = np.int32(0)
+        _INT_INF = jnp.iinfo(jnp.int32).max
+        def f():
+            return jnp.int32(0)  # function scope traces lazily
+    """}, rules=["module-jnp-constant"])
+    assert r.findings == []
+
+
+# -- R2 device-put-aliasing --------------------------------------------
+
+
+def test_r2_fires_on_bare_device_put(tmp_path):
+    r = lint(tmp_path, {"pypardis_tpu/mod.py": """
+        import jax
+        def ship(a, dev):
+            return jax.device_put(a, dev)
+    """}, rules=["device-put-aliasing"])
+    assert rules_of(r) == ["device-put-aliasing"]
+
+
+def test_r2_passes_transfer_wrap_and_give_back(tmp_path):
+    r = lint(tmp_path, {"pypardis_tpu/mod.py": """
+        import jax
+        from .parallel import staging
+        def ship(a, dev):
+            return staging.transfer(lambda: jax.device_put(a, dev))
+        def build(bufs, a, dev):
+            out = jax.device_put(a, dev)
+            staging.give_back_after_put(bufs)
+            return out
+    """}, rules=["device-put-aliasing"])
+    assert r.findings == []
+
+
+# -- R3 trace-env-read -------------------------------------------------
+
+
+def test_r3_fires_via_call_graph(tmp_path):
+    r = lint(tmp_path, {"pypardis_tpu/mod.py": """
+        import os
+        import jax
+        def helper():
+            return os.environ.get("PYPARDIS_DISPATCH", "auto")
+        @jax.jit
+        def kernel(x):
+            mode = helper()
+            return x
+    """}, rules=["trace-env-read"])
+    assert rules_of(r) == ["trace-env-read"]
+
+
+def test_r3_passes_envreg_and_host_reads(tmp_path):
+    r = lint(tmp_path, {"pypardis_tpu/mod.py": """
+        import os
+        import jax
+        from .utils import envreg
+        def helper():
+            return envreg.raw("PYPARDIS_DISPATCH", "auto")
+        @jax.jit
+        def kernel(x):
+            mode = helper()
+            return x
+        def host_only():
+            return os.environ.get("PYPARDIS_CKPT")
+    """}, rules=["trace-env-read"])
+    assert r.findings == []
+
+
+def test_r3_jit_wrap_call_marks_root(tmp_path):
+    # `step = jax.jit(body)` (no decorator) must still mark `body`.
+    r = lint(tmp_path, {"pypardis_tpu/mod.py": """
+        import os
+        import jax
+        def body(x):
+            flag = os.environ.get("PYPARDIS_GM_OVERLAP", "1")
+            return x
+        step = jax.jit(body)
+    """}, rules=["trace-env-read"])
+    assert rules_of(r) == ["trace-env-read"]
+
+
+# -- R4 env-registry ---------------------------------------------------
+
+
+def test_r4_fires_on_unregistered_name_with_hint(tmp_path):
+    r = lint(tmp_path, {"pypardis_tpu/mod.py": """
+        import os
+        FLAG = os.environ.get("PYPARDIS_DISPACH")
+    """}, rules=["env-registry"])
+    assert rules_of(r) == ["env-registry"]
+    assert "PYPARDIS_DISPATCH" in r.findings[0].message  # near-miss
+
+
+def test_r4_passes_registered_and_prefix_refs(tmp_path):
+    r = lint(tmp_path, {"pypardis_tpu/mod.py": '''
+        import os
+        """Docs may reference the PYPARDIS_COMPACT_* watermarks."""
+        FLAG = os.environ.get("PYPARDIS_DISPATCH", "auto")
+    '''}, rules=["env-registry"])
+    assert r.findings == []
+
+
+def test_r4_scratch_file_fails_lint(tmp_path):
+    # The ISSUE acceptance gate: an unregistered PYPARDIS_TYPO literal
+    # in a scratch file makes `scripts/graftlint.py <file>` exit 1.
+    scratch = tmp_path / "scratch.py"
+    scratch.write_text('X = "PYPARDIS_TYPO"\n')
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "graftlint.py"),
+         str(scratch)],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "PYPARDIS_TYPO" in proc.stdout
+    assert "env-registry" in proc.stdout
+
+
+def test_cli_clean_run_exits_zero():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "graftlint.py")],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "graftlint: ok" in proc.stdout
+
+
+# -- R5 seal-f32 -------------------------------------------------------
+
+
+def test_r5_fires_on_unsealed_accumulate(tmp_path):
+    r = lint(tmp_path, {"pypardis_tpu/ops/query.py": """
+        def accum(q, c, acc):
+            diff = q - c
+            return acc + diff * diff
+    """}, rules=["seal-f32"])
+    assert rules_of(r) == ["seal-f32"]
+
+
+def test_r5_passes_sealed_and_out_of_scope(tmp_path):
+    r = lint(tmp_path, {
+        "pypardis_tpu/ops/query.py": """
+            def seal_f32(x, z):
+                return x
+            def accum(q, c, acc, z):
+                diff = q - c
+                e = q  # standalone square below has no add target
+                eps2 = e * e
+                return acc + seal_f32(diff * diff, z)
+        """,
+        # same pattern OUTSIDE the oracle-exact scope: legal
+        "pypardis_tpu/ops/other.py": """
+            def accum(q, c, acc):
+                diff = q - c
+                return acc + diff * diff
+        """,
+    }, rules=["seal-f32"])
+    assert r.findings == []
+
+
+# -- R6 fault-site -----------------------------------------------------
+
+
+def test_r6_fires_on_unregistered_site(tmp_path):
+    r = lint(tmp_path, {"pypardis_tpu/mod.py": """
+        from .utils import faults
+        def go():
+            faults.maybe_fail("gm.exchagne")
+    """}, rules=["fault-site"])
+    assert rules_of(r) == ["fault-site"]
+    assert "gm.exchange" in r.findings[0].message  # near-miss hint
+
+
+def test_r6_passes_registered_sites_and_plan_specs(tmp_path):
+    r = lint(tmp_path, {"pypardis_tpu/mod.py": """
+        from .utils import faults
+        def go():
+            faults.maybe_fail("gm.exchange")
+            with faults.plan("staging.device_put:1=oom"):
+                pass
+    """}, rules=["fault-site"])
+    assert r.findings == []
+
+
+def test_r6_unused_registration_fires_on_full_run(tmp_path):
+    root, _ = make_repo(tmp_path, {
+        "pypardis_tpu/utils/faults.py": """
+            KNOWN_SITES = ("site.used", "site.never_used")
+            def maybe_fail(site):
+                pass
+        """,
+        "pypardis_tpu/mod.py": """
+            from .utils import faults
+            def go():
+                faults.maybe_fail("site.used")
+        """,
+    }, copy_registries=False)
+    shutil.copyfile(
+        os.path.join(REPO, "pypardis_tpu/utils/envreg.py"),
+        os.path.join(root, "pypardis_tpu/utils/envreg.py"),
+    )
+    r = analysis.run_lint(root, rules=["fault-site"])  # full fileset
+    assert rules_of(r) == ["fault-site"]
+    assert "site.never_used" in r.findings[0].message
+
+
+# -- R6 magic-width ----------------------------------------------------
+
+
+def test_r6_magic_width_fires(tmp_path):
+    r = lint(tmp_path, {"pypardis_tpu/ops/pipeline.py": """
+        import numpy as np
+        def unpack(packed):
+            return packed[:-5], int(packed[-5])
+        def empty_stats():
+            pair_stats = np.zeros((1, 5), np.int32)
+            return pair_stats
+    """}, rules=["magic-width"])
+    assert rules_of(r) == ["magic-width"] * 3
+
+
+def test_r6_magic_width_passes_symbolic_width(tmp_path):
+    r = lint(tmp_path, {"pypardis_tpu/ops/pipeline.py": """
+        import numpy as np
+        W = 5  # PAIR_STATS_WIDTH imported in real code
+        def unpack(packed):
+            return packed[:-W], tuple(packed[-W:])
+        def empty_stats():
+            pair_stats = np.zeros((1, W), np.int32)
+            return pair_stats
+        def tree_rows(tree):
+            return np.asarray(tree).reshape(-1, 5)  # not stats
+    """}, rules=["magic-width"])
+    assert r.findings == []
+
+
+# -- R7 unused-import --------------------------------------------------
+
+
+def test_r7_fires_in_package_notes_in_scripts(tmp_path):
+    r = lint(tmp_path, {
+        "pypardis_tpu/mod.py": """
+            import os
+            import json
+            def f():
+                return os.getcwd()
+        """,
+        "scripts/probe.py": """
+            import json
+            print("hi")
+        """,
+    }, rules=["unused-import"])
+    assert rules_of(r) == ["unused-import"]
+    assert r.findings[0].path.endswith("pypardis_tpu/mod.py")
+    assert [n.rule for n in r.notes] == ["unused-import"]  # scripts
+
+
+def test_r7_suppressible_for_side_effect_imports(tmp_path):
+    r = lint(tmp_path, {"pypardis_tpu/mod.py": """
+        # graftlint: disable=unused-import -- imported for side effect
+        import json
+        print("hi")
+    """}, rules=["unused-import"])
+    assert r.findings == []
+    assert r.suppressed == 1
+
+
+# -- suppressions ------------------------------------------------------
+
+
+def test_suppression_requires_reason(tmp_path):
+    r = lint(tmp_path, {"pypardis_tpu/mod.py": """
+        import jax
+        def ship(a, dev):
+            # graftlint: disable=device-put-aliasing
+            return jax.device_put(a, dev)
+    """}, rules=["device-put-aliasing"])
+    # reasonless directive: flagged itself AND suppresses nothing
+    assert sorted(rules_of(r)) == [
+        "bad-suppression", "device-put-aliasing",
+    ]
+
+
+def test_suppression_with_reason_spans_comment_block(tmp_path):
+    r = lint(tmp_path, {"pypardis_tpu/mod.py": """
+        import jax
+        def ship(a, dev):
+            # graftlint: disable=device-put-aliasing -- fresh array,
+            # reason continues on a second comment line
+            return jax.device_put(a, dev)
+    """}, rules=["device-put-aliasing"])
+    assert r.findings == []
+    assert r.suppressed == 1
+
+
+def test_suppression_unknown_rule_flagged(tmp_path):
+    r = lint(tmp_path, {"pypardis_tpu/mod.py": """
+        # graftlint: disable=no-such-rule -- whatever
+        X = 1
+    """})
+    assert "bad-suppression" in rules_of(r)
+
+
+# -- baseline ----------------------------------------------------------
+
+
+def test_baseline_round_trip(tmp_path):
+    files = {"pypardis_tpu/mod.py": """
+        import jax
+        def ship(a, dev):
+            return jax.device_put(a, dev)
+    """}
+    root, paths = make_repo(tmp_path, files)
+    r1 = analysis.run_lint(root, paths=paths,
+                           rules=["device-put-aliasing"])
+    assert len(r1.findings) == 1
+    bl = os.path.join(root, "baseline.json")
+    baseline_mod.write(bl, r1.raw_pairs)
+    data = json.load(open(bl))
+    assert data["format"] == "graftlint_baseline@1"
+    assert len(data["entries"]) == 1
+    r2 = analysis.run_lint(root, paths=paths,
+                           rules=["device-put-aliasing"],
+                           baseline_path=bl)
+    assert r2.findings == []
+    assert r2.baselined == 1
+
+
+def test_committed_baseline_is_empty():
+    data = json.load(open(os.path.join(
+        REPO, "scripts", "graftlint_baseline.json"
+    )))
+    assert data["format"] == "graftlint_baseline@1"
+    assert data["entries"] == []  # zero-entry: nothing grandfathered
+
+
+# -- env registry / docs sync ------------------------------------------
+
+
+def test_static_render_matches_runtime_render():
+    static = envmodel.parse_env_registry(REPO).render_markdown()
+    assert static == envreg.render_markdown()
+
+
+def test_readme_env_table_in_sync():
+    text = open(os.path.join(REPO, "README.md")).read()
+    from pypardis_tpu.analysis.rules_env import (
+        ENVDOCS_BEGIN, ENVDOCS_END,
+    )
+    begin = text.find(ENVDOCS_BEGIN)
+    end = text.find(ENVDOCS_END)
+    assert 0 < begin < end
+    committed = text[begin + len(ENVDOCS_BEGIN):end].strip("\n")
+    assert committed == envreg.render_markdown().strip("\n")
+
+
+def test_every_repo_env_var_is_registered_and_rendered():
+    # Belt and braces over the R4 rule: regex the tree ourselves.
+    import re
+
+    pat = re.compile(r"PYPARDIS_[A-Z0-9_]*[A-Z0-9]")
+    names = set()
+    for base in ("pypardis_tpu", "scripts", "tests"):
+        for dirpath, dirnames, filenames in os.walk(
+            os.path.join(REPO, base)
+        ):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in filenames:
+                if not fn.endswith(".py"):
+                    continue
+                text = open(os.path.join(dirpath, fn)).read()
+                for m in pat.finditer(text):
+                    tail = text[m.end():m.end() + 2]
+                    if tail[:1] == "*" or tail == "_*":
+                        continue  # prefix reference
+                    names.add(m.group(0))
+    names.discard("PYPARDIS_TYPO")   # this file's acceptance fixture
+    names.discard("PYPARDIS_DISPACH")  # this file's typo fixture
+    registered = set(envreg.declared_names())
+    assert names <= registered, sorted(names - registered)
+    table = envreg.render_markdown()
+    for name in registered:
+        assert f"`{name}`" in table
+
+
+def test_envreg_raw_rejects_unregistered():
+    with pytest.raises(envreg.UnregisteredEnvVar):
+        envreg.raw("PYPARDIS_TYPO")
+
+
+def test_known_sites_match_faults_module():
+    sites, _ = envmodel.parse_fault_sites(REPO)
+    assert sites == faults.KNOWN_SITES
+
+
+def test_envdocs_cli_emits_table():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "graftlint.py"),
+         "--envdocs"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 0
+    assert proc.stdout == envreg.render_markdown()
