@@ -7,7 +7,8 @@ PY ?= python
 	partition-probe serve-probe live-probe ingest-probe \
 	global-morton-probe fault-probe bench-diff flight-check \
 	northstar northstar-smoke streammem-probe sort-probe \
-	kernel-probe sweep-probe tune-probe demo clean
+	kernel-probe sweep-probe tune-probe monitor monitor-probe \
+	demo clean
 
 all: native test
 
@@ -62,7 +63,8 @@ bench:
 # level builder's mp-doubling cost ratio exceeds 1.5x).
 bench-smoke: lint partition-probe serve-probe live-probe ingest-probe \
 		global-morton-probe fault-probe bench-diff flight-check \
-		northstar-smoke kernel-probe sweep-probe tune-probe
+		northstar-smoke kernel-probe sweep-probe tune-probe \
+		monitor-probe
 	JAX_PLATFORMS=cpu BENCH_N=2000 BENCH_DIM=4 BENCH_REPS=1 \
 	BENCH_DEV_REPS=1 $(PY) bench.py \
 	| $(PY) scripts/bench_diff.py --annotate --baseline-dir . \
@@ -222,6 +224,29 @@ ingest-probe:
 	JAX_PLATFORMS=cpu \
 	INGEST_N=$${INGEST_N:-4000} INGEST_SECONDS=$${INGEST_SECONDS:-2.0} \
 	$(PY) scripts/ingest_probe.py \
+	| $(PY) scripts/bench_diff.py --annotate --baseline-dir . \
+	| $(PY) scripts/check_bench_json.py --require-diff
+
+# Live run monitor (ISSUE 16): tail a flight file or a directory of
+# them (phase stack, heartbeat ETAs, resource watermarks, latency
+# histogram percentiles).  `make monitor MONITOR_PATH=/path/to/flight`
+# — add MONITOR_ARGS="--once --json" etc. for scripting.
+monitor:
+	@test -n "$(MONITOR_PATH)" || \
+	{ echo "usage: make monitor MONITOR_PATH=<flight .jsonl or dir>"; \
+	exit 2; }
+	$(PY) scripts/monitor.py $(MONITOR_PATH) $(MONITOR_ARGS)
+
+# Live-observability probe (ISSUE 16): fits with the scrape endpoint +
+# snapshot stream enabled and, mid-fit, scrapes /metrics until one
+# OpenMetrics response carries an open span, heartbeat progress, AND a
+# latency-histogram series at once; then the serving histogram over a
+# fresh endpoint, the snapshot stream, and a scripts/monitor.py render
+# — one schema'd monitor@1 row through the bench_diff cross-round
+# gate.  MONITOR_N sizes the fit (doubles on its own when the fit
+# outruns the scraper).
+monitor-probe:
+	MONITOR_N=$${MONITOR_N:-40000} $(PY) scripts/monitor_probe.py \
 	| $(PY) scripts/bench_diff.py --annotate --baseline-dir . \
 	| $(PY) scripts/check_bench_json.py --require-diff
 

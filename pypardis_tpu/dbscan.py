@@ -915,6 +915,12 @@ class DBSCAN:
                 n_devices=int(n_devices if sharded else 1),
                 backend=jax_backend_name(),
             )
+        # Live export plane (opt-in via PYPARDIS_METRICS_PORT /
+        # PYPARDIS_METRICS_SNAPSHOT): the fit's registry, heartbeats,
+        # open spans, and resource watermarks become scrapeable /
+        # snapshotted WHILE the fit runs.  Attached after the flight
+        # sink so the exporter fanout tees the same record stream.
+        exporters = obs.attach_exporters(rec)
         sampler = obs.ResourceSampler(rec).start()
         try:
             with obs.use_recorder(rec), ctx:
@@ -963,6 +969,8 @@ class DBSCAN:
             raise
         finally:
             sampler.stop()
+            if exporters is not None:
+                exporters.close()
             if dispatch_token is not None:
                 # The planned dispatch rode in PYPARDIS_DISPATCH for
                 # the fit body only; restore the ambient value so a

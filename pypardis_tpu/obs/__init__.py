@@ -30,7 +30,17 @@ schema or an export path.  This package is the single replacement:
   device live bytes / staging-pool bytes);
 * :func:`heartbeat` — opt-in per-round progress + ETA lines
   (``PYPARDIS_HEARTBEAT``) on the stepped / chained / global-Morton
-  round loops.
+  round loops;
+* :class:`~pypardis_tpu.obs.export.Histogram` /
+  :func:`attach_exporters` — the live plane: bounded log-bucket latency
+  histograms with windowed p50/p99 (what sustained serving tracks
+  latency on), a periodic JSONL snapshot emitter
+  (``PYPARDIS_METRICS_SNAPSHOT``), and an opt-in OpenMetrics scrape
+  endpoint (``PYPARDIS_METRICS_PORT``) live during fits and load runs;
+* :class:`~pypardis_tpu.obs.fleet.FleetReplay` — N per-process flight
+  files aligned onto one timeline: per-host Chrome-trace lanes, a
+  merged JSONL, a fleet-level partial report (``replay()`` on a
+  directory dispatches here); ``scripts/monitor.py`` live-tails either.
 
 Key schema: lowercase dotted segments ``[a-z0-9_]+(.[a-z0-9_]+)*``.
 Reserved prefixes: ``phase.`` (timings, seconds), ``events.`` (counters,
@@ -43,6 +53,7 @@ from .recorder import RunRecorder, current, event, span, use_recorder
 from .registry import MetricsRegistry
 from .report import REPORT_SCHEMA, build_run_report, format_summary
 from .trace import Tracer
+from .export import Histogram, attach_exporters, last_http_port
 from .flight import (
     FLIGHT_SCHEMA,
     FlightRecorder,
@@ -52,6 +63,7 @@ from .flight import (
     open_flight,
     replay,
 )
+from .fleet import FleetReplay, fleet_replay
 from .resources import ResourceSampler
 
 __all__ = [
@@ -68,6 +80,11 @@ __all__ = [
     "FLIGHT_SCHEMA",
     "FlightRecorder",
     "FlightReplay",
+    "FleetReplay",
+    "fleet_replay",
+    "Histogram",
+    "attach_exporters",
+    "last_http_port",
     "flight_note",
     "heartbeat",
     "open_flight",

@@ -1,0 +1,261 @@
+"""Fleet flight aggregation: N per-process flight files, one timeline.
+
+A multi-process run — ``sustained_load`` harness subprocesses today,
+``jax.distributed`` pod-scale fits next (ROADMAP item 4) — leaves one
+flight JSONL per process/host (``PYPARDIS_FLIGHT=<dir>`` already names
+them ``flight-<pid>-<stamp>-<seq>.jsonl``).  Each file's timestamps are
+relative to its *own* tracer epoch, so the files cannot be compared
+directly: this module aligns them onto one shared timeline and merges.
+
+Alignment: every header record carries ``t_unix``, the wall-clock stamp
+written at (relative) t≈0 — the one wall-clock anchor in the stream
+(heartbeat/span records are deliberately epoch-relative).  Member ``i``
+is shifted by ``offset_i = t_unix_i - min_j t_unix_j``; a member whose
+header was lost (killed before the first flush — the same truncation
+single-file replay tolerates) gets offset 0 and is flagged.  Heartbeat
+records then line up across hosts for free, which is what the monitor
+and the merged trace lean on.
+
+Determinism contract (pinned by tests): for a given input set the merge
+is **byte-identical** across runs — members are ordered by a stable key
+(header wall-clock, then pid, then file name), all serialization uses
+sorted keys and fixed separators, and nothing samples a live clock.
+
+Surfaces mirror :class:`~pypardis_tpu.obs.flight.FlightReplay` (which
+handles one file): :meth:`to_chrome_trace` (one lane per host),
+:meth:`write_merged` (one aligned JSONL), :meth:`report` /
+:meth:`summary` (fleet-level partial report).  ``obs.replay(path)``
+dispatches here when ``path`` is a directory.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Dict, List, Sequence, Union
+
+from .flight import FlightReplay
+from .registry import MetricsRegistry
+
+FLEET_SCHEMA = "pypardis_tpu/fleet_report@1"
+
+
+def _member_paths(path_or_paths: Union[str, Sequence[str]]) -> List[str]:
+    if isinstance(path_or_paths, (list, tuple)):
+        return [str(p) for p in path_or_paths]
+    root = str(path_or_paths)
+    if os.path.isdir(root):
+        return sorted(glob.glob(os.path.join(root, "*.jsonl")))
+    return [root]
+
+
+class FleetReplay:
+    """N flight files replayed and aligned onto one fleet timeline.
+
+    ``hosts`` holds one descriptor per member, in the merge order that
+    also assigns the Chrome-trace lane ``pid``s: ``{host, path, pid,
+    t_unix, offset_s, records, bad_lines, complete, status, last_t_s,
+    open_spans}``.
+    """
+
+    def __init__(self, path: Union[str, Sequence[str]]):
+        self.path = path if isinstance(path, str) else None
+        paths = _member_paths(path)
+        if not paths:
+            raise FileNotFoundError(
+                f"no flight files under {path!r} (expected *.jsonl)"
+            )
+        loaded = [(p, FlightReplay(p)) for p in paths]
+        # Stable fleet order: wall-clock anchor first (headerless
+        # members sort last), then pid, then file name — deterministic
+        # for a given input set regardless of directory listing order.
+        loaded.sort(
+            key=lambda pr: (
+                pr[1].header.get("t_unix") is None,
+                float(pr[1].header.get("t_unix") or 0.0),
+                int(pr[1].header.get("pid") or 0),
+                os.path.basename(pr[0]),
+            )
+        )
+        self.members: List[FlightReplay] = [r for _, r in loaded]
+        anchors = [
+            float(r.header["t_unix"])
+            for r in self.members
+            if r.header.get("t_unix") is not None
+        ]
+        t0 = min(anchors) if anchors else 0.0
+        self.hosts: List[Dict] = []
+        for i, (p, r) in enumerate(loaded):
+            t_unix = r.header.get("t_unix")
+            off = (float(t_unix) - t0) if t_unix is not None else 0.0
+            self.hosts.append(
+                {
+                    "host": i,
+                    "path": p,
+                    "pid": r.header.get("pid"),
+                    "t_unix": t_unix,
+                    "offset_s": round(off, 6),
+                    "aligned": t_unix is not None,
+                    "records": r.records,
+                    "bad_lines": r.bad_lines,
+                    "complete": r.complete,
+                    "status": r.status,
+                    "last_t_s": round(r.last_t_s, 6),
+                    "open_spans": [s["name"] for s in r.open_spans],
+                }
+            )
+        self.records = sum(h["records"] for h in self.hosts)
+        self.bad_lines = sum(h["bad_lines"] for h in self.hosts)
+        self.complete = all(h["complete"] for h in self.hosts)
+        self.last_t_s = max(
+            (h["offset_s"] + h["last_t_s"] for h in self.hosts),
+            default=0.0,
+        )
+
+    # -- merged surfaces ---------------------------------------------------
+
+    def _lane_label(self, i: int) -> str:
+        h = self.hosts[i]
+        pid = h["pid"]
+        return f"host{i}" + (f" pid={pid}" if pid is not None else "")
+
+    def to_chrome_trace(self) -> dict:
+        """One Chrome trace, one lane (``pid``) per host, every event
+        shifted onto the shared timeline."""
+        meta: List[dict] = []
+        xs: List[dict] = []
+        for i, member in enumerate(self.members):
+            tr = member.recorder.tracer.to_chrome_trace(
+                pid=i, label=self._lane_label(i),
+                offset_s=self.hosts[i]["offset_s"],
+            )
+            for ev in tr["traceEvents"]:
+                (meta if ev.get("ph") == "M" else xs).append(ev)
+        xs.sort(
+            key=lambda e: (e.get("ts", 0.0), e.get("pid", 0),
+                           str(e.get("name", "")))
+        )
+        return {"traceEvents": meta + xs, "displayTimeUnit": "ms"}
+
+    def export_chrome_trace(self, path: str) -> str:
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(
+                json.dumps(self.to_chrome_trace(), sort_keys=True,
+                           separators=(",", ":"))
+            )
+            f.write("\n")
+        return path
+
+    def merged_records(self) -> List[Dict]:
+        """Every parseable record of every member, stamped with its
+        ``host`` index, ``t`` shifted onto the shared timeline, ordered
+        by (aligned time, host, original position)."""
+        out: List[tuple] = []
+        for i, h in enumerate(self.hosts):
+            off = h["offset_s"]
+            seq = 0
+            with open(h["path"], "r", encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        r = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue  # same tolerance as single-file replay
+                    if not isinstance(r, dict):
+                        continue
+                    t = float(r.get("t", 0.0) or 0.0)
+                    r["t"] = round(t + off, 6)
+                    r["host"] = i
+                    out.append((r["t"], i, seq, r))
+                    seq += 1
+        out.sort(key=lambda x: x[:3])
+        return [r for _, _, _, r in out]
+
+    def write_merged(self, path: str) -> str:
+        """The aligned fleet stream as one JSONL file — byte-identical
+        for a given input set."""
+        with open(path, "w", encoding="utf-8") as f:
+            for r in self.merged_records():
+                f.write(json.dumps(r, sort_keys=True,
+                                   separators=(",", ":")))
+                f.write("\n")
+        return path
+
+    # -- fleet report ------------------------------------------------------
+
+    def merged_metrics(self) -> MetricsRegistry:
+        """All members' registries pooled (counters add, timings and
+        histograms merge samples; gauges last-member-wins)."""
+        reg = MetricsRegistry()
+        for member in self.members:
+            reg.merge(member.recorder.metrics)
+        return reg
+
+    def heartbeats(self) -> Dict[str, Dict]:
+        """Last heartbeat per stage per host, keyed
+        ``"<stage>@host<i>"`` on the aligned clock."""
+        out: Dict[str, Dict] = {}
+        for i, member in enumerate(self.members):
+            off = self.hosts[i]["offset_s"]
+            for stage, hb in member.heartbeats.items():
+                hb = dict(hb)
+                hb["t_s"] = round(hb["t_s"] + off, 6)
+                hb["host"] = i
+                out[f"{stage}@host{i}"] = hb
+        return out
+
+    def report(self) -> Dict:
+        """Fleet-level partial report: per-host status plus the pooled
+        registry — the multi-process analogue of
+        :meth:`FlightReplay.report`."""
+        reg = self.merged_metrics()
+        return {
+            "schema": FLEET_SCHEMA,
+            "hosts": len(self.hosts),
+            "aligned_hosts": sum(1 for h in self.hosts if h["aligned"]),
+            "records": self.records,
+            "bad_lines": self.bad_lines,
+            "complete": self.complete,
+            "partial": not self.complete,
+            "last_t_s": round(self.last_t_s, 6),
+            "per_host": self.hosts,
+            "heartbeats": self.heartbeats(),
+            "registry": reg.as_dict(),
+        }
+
+    def summary(self) -> str:
+        """One short text block: fleet header + one line per host."""
+        rep = self.report()
+        lines = [
+            "pypardis_tpu fleet: %d hosts, %d records%s, span %.3fs%s"
+            % (
+                rep["hosts"],
+                rep["records"],
+                (", %d bad lines" % rep["bad_lines"])
+                if rep["bad_lines"] else "",
+                rep["last_t_s"],
+                "" if rep["complete"] else " — PARTIAL",
+            )
+        ]
+        for h in self.hosts:
+            status = h["status"] or (
+                "killed" if not h["complete"] else "?"
+            )
+            inside = (
+                " inside " + ",".join(h["open_spans"])
+                if h["open_spans"] else ""
+            )
+            lines.append(
+                "  host%d pid=%s +%.3fs: %d records, %s%s"
+                % (h["host"], h["pid"], h["offset_s"], h["records"],
+                   status, inside)
+            )
+        return "\n".join(lines)
+
+
+def fleet_replay(path: Union[str, Sequence[str]]) -> FleetReplay:
+    """Aggregate a directory (or explicit list) of flight files."""
+    return FleetReplay(path)

@@ -32,10 +32,11 @@ File format (one JSON object per line, format version
 ``pypardis_tpu/flight@1``): ``k`` discriminates the record kind —
 ``header`` (schema/pid/params), ``so``/``sc`` (span open/close by
 ``id``), ``sx`` (pre-measured complete span), ``ev`` (recorder event),
-``g``/``c``/``tm`` (gauge/counter/timing write), ``rs`` (resource
-sample), ``hb`` (heartbeat), ``note`` (staging and other annotations),
-``fin`` (run end).  All ``t`` fields are seconds relative to the run
-recorder's tracer epoch.
+``g``/``c``/``tm`` (gauge/counter/timing write), ``h`` (bounded
+latency-histogram snapshot, rate-limited per key; the last one per key
+wins on replay), ``rs`` (resource sample), ``hb`` (heartbeat), ``note``
+(staging and other annotations), ``fin`` (run end).  All ``t`` fields
+are seconds relative to the run recorder's tracer epoch.
 """
 
 from __future__ import annotations
@@ -88,6 +89,8 @@ class FlightRecorder:
         self._last_flush = 0.0
         self._finished = False
         self.records = 0
+        self._hists: Dict[str, object] = {}
+        self._hist_last_emit: Dict[str, float] = {}
 
     # -- wiring ------------------------------------------------------------
 
@@ -211,12 +214,38 @@ class FlightRecorder:
         self._emit({"k": "note", "kind": kind, "t": self._t(),
                     **self._attrs(fields)})
 
+    def hist(self, key: str, value_ms: float) -> None:
+        """One latency observation on the ``key`` histogram.
+
+        Per-observation records would put the O(requests) cost this
+        metric type exists to kill back on disk, so the recorder
+        aggregates into its own bounded histogram and emits a compact
+        ``h`` snapshot record at most once per flush interval per key
+        (plus a final snapshot from :meth:`finish`).
+        """
+        from .export import Histogram
+
+        h = self._hists.get(key)
+        if h is None:
+            h = self._hists[key] = Histogram()
+        h.observe(value_ms)
+        now = time.monotonic()
+        gap = max(self._flush_every, 0.05)
+        if now - self._hist_last_emit.get(key, 0.0) < gap:
+            return
+        self._hist_last_emit[key] = now
+        self._emit({"k": "h", "key": key, "t": self._t(),
+                    "snap": h.snapshot()})
+
     def finish(self, status: str, **fields) -> None:
         """Terminal record — first call wins (the error path writes
         ``status="error"`` before the generic close writes ``"ok"``)."""
         if self._finished:
             return
         self._finished = True
+        for key, h in self._hists.items():
+            self._emit({"k": "h", "key": key, "t": self._t(),
+                        "snap": h.snapshot()})
         self._emit(
             {"k": "fin", "status": status, "t": self._t(),
              **self._attrs(fields)},
@@ -339,10 +368,12 @@ class FlightReplay:
         self.records = 0
         self.bad_lines = 0
         self.open_spans: List[Dict] = []
+        self.heartbeats: Dict[str, Dict] = {}
         rec = RunRecorder()
         rec.tracer.epoch_s = 0.0
         self.recorder = rec
         open_map: Dict[int, Dict] = {}
+        hist_last: Dict[str, Dict] = {}
         last_t = 0.0
         with open(path, "r", encoding="utf-8") as f:
             lines = f.read().splitlines()
@@ -397,9 +428,26 @@ class FlightReplay:
                         rec.metrics.inc(r["key"], r.get("v", 1))
                 elif k == "tm":
                     rec.metrics.observe(r["key"], float(r.get("s", 0.0)))
+                elif k == "h":
+                    # Histogram snapshots supersede each other (each
+                    # carries the full lifetime counts) — keep the last
+                    # per key, installed at end-of-parse below.
+                    hist_last[str(r["key"])] = r.get("snap") or {}
+                elif k == "hb":
+                    self.heartbeats[str(r.get("stage"))] = {
+                        "done": int(r.get("done", 0) or 0),
+                        "total": int(r.get("total", 0) or 0),
+                        "eta_s": float(r.get("eta_s", -1.0) or 0.0),
+                        "t_s": t,
+                    }
                 elif k == "fin":
                     self.complete = True
                     self.status = r.get("status")
+            except (KeyError, TypeError, ValueError):
+                self.bad_lines += 1
+        for key, snap in hist_last.items():
+            try:
+                rec.metrics.load_hist(key, snap)
             except (KeyError, TypeError, ValueError):
                 self.bad_lines += 1
         self.last_t_s = last_t
@@ -478,7 +526,15 @@ class FlightReplay:
         return s
 
 
-def replay(path: str) -> FlightReplay:
+def replay(path: str):
     """Reconstruct a run's observable state from its flight file — the
-    post-mortem path for killed runs (``make flight-check``)."""
+    post-mortem path for killed runs (``make flight-check``).
+
+    A directory dispatches to :class:`~pypardis_tpu.obs.fleet.FleetReplay`
+    over every ``flight-*.jsonl``/``*.jsonl`` member — the multi-process
+    post-mortem (one file per host/process)."""
+    if os.path.isdir(path):
+        from .fleet import FleetReplay
+
+        return FleetReplay(path)
     return FlightReplay(path)

@@ -11,6 +11,8 @@ from __future__ import annotations
 import re
 from typing import Dict, Union
 
+from .export import Histogram
+
 _KEY_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)*$")
 
 Number = Union[int, float]
@@ -63,6 +65,7 @@ class MetricsRegistry:
         self._counters: Dict[str, Number] = {}
         self._gauges: Dict[str, object] = {}
         self._timings: Dict[str, Dict[str, float]] = {}
+        self._hists: Dict[str, Histogram] = {}
         # Optional streaming sink (obs.flight.FlightRecorder): every
         # write also lands in the JSONL file, so a killed run's gauges
         # and phase timings are recoverable from disk.
@@ -97,6 +100,45 @@ class MetricsRegistry:
             t["total_s"] += s
             t["min_s"] = min(t["min_s"], s)
             t["max_s"] = max(t["max_s"], s)
+        # Timings double as histograms (ms) so exporters can show
+        # windowed phase-latency percentiles mid-run.  No sink forward:
+        # the tm record above already carries the sample to the flight.
+        h = self._hists.get(key)
+        if h is None:
+            h = self._hists[key] = Histogram()
+        h.observe(s * 1e3)
+
+    def hist(self, key: str, window_s: float = None) -> Histogram:
+        """Get-or-create the bounded histogram under ``key`` (unit: ms).
+
+        This is the structure sustained serving migrates its latency
+        tracking onto — O(buckets) memory forever, windowed p50/p99.
+        """
+        h = self._hists.get(key)
+        if h is None:
+            validate_key(key)
+            h = self._hists[key] = Histogram(window_s=window_s)
+        return h
+
+    def observe_ms(self, key: str, value_ms: float) -> None:
+        """Record one latency sample (milliseconds) into the histogram
+        under ``key`` and forward it to the sink's ``hist`` channel."""
+        self.hist(key).observe(value_ms)
+        if self.sink is not None:
+            hs = getattr(self.sink, "hist", None)
+            if hs is not None:
+                hs(key, float(value_ms))
+
+    def load_hist(self, key: str, snap: dict) -> None:
+        """Install a histogram rebuilt from a snapshot dict (flight
+        replay / fleet merge), pooling into any existing one."""
+        validate_key(key)
+        h = Histogram.from_snapshot(snap)
+        mine = self._hists.get(key)
+        if mine is None:
+            self._hists[key] = h
+        else:
+            mine.merge_from(h)
 
     # -- read surface -----------------------------------------------------
 
@@ -130,11 +172,18 @@ class MetricsRegistry:
                 mine["total_s"] += t["total_s"]
                 mine["min_s"] = min(mine["min_s"], t["min_s"])
                 mine["max_s"] = max(mine["max_s"], t["max_s"])
+        for k, h in other._hists.items():
+            mine_h = self._hists.get(k)
+            if mine_h is None:
+                self._hists[k] = h.clone()
+            else:
+                mine_h.merge_from(h)
         return self
 
     def as_dict(self) -> Dict[str, dict]:
         """One json-serializable dump: ``{"counters", "gauges",
-        "timings"}`` — timings carry count/total/min/max/mean seconds."""
+        "timings", "hists"}`` — timings carry count/total/min/max/mean
+        seconds; hists are :meth:`Histogram.snapshot` dicts."""
         timings = {}
         for k, t in self._timings.items():
             d = dict(t)
@@ -144,4 +193,5 @@ class MetricsRegistry:
             "counters": dict(self._counters),
             "gauges": dict(self._gauges),
             "timings": timings,
+            "hists": {k: h.snapshot() for k, h in self._hists.items()},
         }

@@ -397,7 +397,8 @@ def build_run_report(
         "metrics": (
             recorder.metrics.as_dict()
             if recorder is not None
-            else {"counters": {}, "gauges": {}, "timings": {}}
+            else {"counters": {}, "gauges": {}, "timings": {},
+                  "hists": {}}
         ),
     }
     if stepped:
@@ -416,6 +417,19 @@ def build_run_report(
     # block on live_* rows.
     if live:
         report["live"] = live
+    # Live-export destinations (obs.export.attach_exporters leaves its
+    # gauges in the registry): where the run's metrics could be / still
+    # can be scraped.  Absent on runs with no exporter attached.
+    export: Dict = {}
+    if recorder is not None:
+        http_port = recorder.metrics.gauge("metrics.http_port")
+        snap_path = recorder.metrics.gauge("metrics.snapshot_path")
+        if http_port is not None:
+            export["http_port"] = int(http_port)
+        if snap_path:
+            export["snapshot_path"] = str(snap_path)
+    if export:
+        report["export"] = export
     return _clean(report)
 
 
@@ -605,6 +619,25 @@ def format_summary(report: Dict) -> str:
         if plan.get("fallback_reason"):
             bits.append("heuristic fallback")
         lines.append("  tune: " + ", ".join(bits))
+    exp = report.get("export")
+    if exp:
+        dests = []
+        if exp.get("http_port") is not None:
+            dests.append(f"scrape 127.0.0.1:{exp['http_port']}/metrics")
+        if exp.get("snapshot_path"):
+            dests.append(f"snapshots {exp['snapshot_path']}")
+        hists = report.get("metrics", {}).get("hists") or {}
+        hist_bit = ""
+        for key in ("serving.latency_ms", *sorted(hists)):
+            h = hists.get(key)
+            if h and h.get("count"):
+                hist_bit = (
+                    f"; {key} p50 {h.get('p50_ms', 0):.2f}ms "
+                    f"p99 {h.get('p99_ms', 0):.2f}ms "
+                    f"({h.get('window_count', 0)} in window)"
+                )
+                break
+        lines.append("  live-metrics: " + ", ".join(dests) + hist_bit)
     res = report.get("resources") or {}
     if res.get("samples", 0) > 0:
         pool = res.get("staging_pool_bytes", 0)

@@ -133,17 +133,24 @@ class Tracer:
 
     # -- Chrome trace export ---------------------------------------------
 
-    def to_chrome_trace(self) -> dict:
+    def to_chrome_trace(self, pid: int = 0,
+                        label: str = "pypardis_tpu driver",
+                        offset_s: float = 0.0) -> dict:
         """``{"traceEvents": [...]}`` — complete ("X") events in
         microseconds relative to the tracer epoch; loads in
-        chrome://tracing and ui.perfetto.dev."""
+        chrome://tracing and ui.perfetto.dev.
+
+        ``pid``/``label`` name the trace lane (the fleet merge gives
+        each host its own); ``offset_s`` shifts every timestamp (fleet
+        clock-offset alignment onto the shared timeline).
+        """
         events = [
             {
                 "name": "process_name",
                 "ph": "M",
-                "pid": 0,
+                "pid": int(pid),
                 "tid": 0,
-                "args": {"name": "pypardis_tpu driver"},
+                "args": {"name": str(label)},
             }
         ]
         for sp in self.spans:
@@ -153,9 +160,9 @@ class Tracer:
                 {
                     "name": sp.name,
                     "ph": "X",
-                    "pid": 0,
+                    "pid": int(pid),
                     "tid": 0,
-                    "ts": (sp.t0_s - self.epoch_s) * 1e6,
+                    "ts": (sp.t0_s - self.epoch_s + offset_s) * 1e6,
                     "dur": sp.dur_s * 1e6,
                     "args": {k: _jsonable(v) for k, v in sp.attrs.items()},
                 }

@@ -145,7 +145,12 @@ class QueryEngine:
         self.recorder = RunRecorder()
         self._pending: deque = deque()
         self._pending_rows = 0
-        self._lat_ms: deque = deque(maxlen=8192)
+        # Latency tracking lives on a bounded log-bucket histogram
+        # (obs.export.Histogram via the registry) — O(buckets) memory
+        # under sustained traffic where the old per-request list grew
+        # O(requests), and p50/p99 answer over a sliding window
+        # (PYPARDIS_HIST_WINDOW_S) instead of the run lifetime.
+        self._lat_hist = self.recorder.metrics.hist("serving.latency_ms")
         self.queries = 0
         self.batches = 0
         self._busy_s = 0.0
@@ -331,7 +336,9 @@ class QueryEngine:
             t.d2 = d2[s:s + t.n]
             t.latency_ms = (now - t._t_submit) * 1e3
             t._q = None
-            self._lat_ms.append(t.latency_ms)
+            self.recorder.metrics.observe_ms(
+                "serving.latency_ms", t.latency_ms
+            )
             s += t.n
         self._fill_num += int(round(fl.fill * fl.n_rows))
         self._fill_den += fl.n_rows
@@ -360,11 +367,8 @@ class QueryEngine:
     def serving_stats(self) -> Dict:
         """Finite-by-construction serving gauges (the ``serving`` block
         of ``DBSCAN.report()``)."""
-        lat = np.asarray(self._lat_ms, np.float64)
-        p50, p99 = (
-            (float(np.percentile(lat, 50)), float(np.percentile(lat, 99)))
-            if len(lat) else (0.0, 0.0)
-        )
+        p50 = self._lat_hist.percentile(50)
+        p99 = self._lat_hist.percentile(99)
         from ..parallel import staging
 
         st = self.index.stats
@@ -403,6 +407,10 @@ class QueryEngine:
             "index_delta_bytes": int(
                 staging.route_delta_nbytes("serve_index_delta")
             ),
+            # Full bounded-histogram snapshot (pypardis_tpu/hist@1):
+            # windowed percentiles + lifetime bucket counts, what the
+            # scrape endpoint and the monitor render.
+            "latency_hist": self._lat_hist.snapshot(),
         }
 
 

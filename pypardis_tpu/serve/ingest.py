@@ -60,6 +60,7 @@ from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
+from ..obs.export import Histogram
 from ..utils import envreg
 
 
@@ -144,6 +145,10 @@ class IngestQueue:
         self.shed = 0
         self.failed_batches = 0
         self._batch_rows: deque = deque(maxlen=256)
+        # Write-latency distribution (submit -> resolved at flush) on
+        # the bounded windowed histogram — same structure the query
+        # engine tracks read latency on.
+        self.lat_hist = Histogram()
 
     def _enqueue(self, t: WriteTicket) -> WriteTicket:
         from .engine import QueueFull
@@ -230,6 +235,7 @@ class IngestQueue:
                     t.error = e
             for t in tickets:
                 t.latency_ms = (now() - t._t_submit) * 1e3
+                self.lat_hist.observe(t.latency_ms)
                 t._payload = None
                 self._pending_rows -= t.rows
                 resolved.append(t)
@@ -246,6 +252,9 @@ class IngestQueue:
             "mean_batch_rows": (
                 round(sum(br) / len(br), 2) if br else 0.0
             ),
+            "write_p50_ms": self.lat_hist.percentile(50),
+            "write_p99_ms": self.lat_hist.percentile(99),
+            "latency_hist": self.lat_hist.snapshot(),
         }
 
 

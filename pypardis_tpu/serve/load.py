@@ -36,12 +36,32 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from typing import Callable, Dict, Optional
 
 import numpy as np
 
+from ..obs.export import Histogram, attach_exporters
 
-def sustained_load(
+
+def sustained_load(engine, **kw) -> Dict:
+    """Run the harness; returns the schema'd stats dict (see
+    :func:`_sustained_load` for every knob).
+
+    This wrapper owns the live export plane: when
+    ``PYPARDIS_METRICS_PORT`` / ``PYPARDIS_METRICS_SNAPSHOT`` are set,
+    the engine's registry — the serving latency histogram included —
+    is scrapeable/snapshotted for the duration of the run.
+    """
+    exporters = attach_exporters(getattr(engine, "recorder", None))
+    try:
+        return _sustained_load(engine, **kw)
+    finally:
+        if exporters is not None:
+            exporters.close()
+
+
+def _sustained_load(
     engine,
     *,
     clients: int = 4,
@@ -134,15 +154,53 @@ def sustained_load(
     # swap: when a compactor rides along, its lock IS the harness lock,
     # so the swap's drain-then-replace is atomic against every client.
     lock = compactor.lock if compactor is not None else threading.Lock()
-    tickets: list = []
-    wtickets: list = []
-    visible_ms: list = []
+    # Resolved tickets fold into bounded histograms at each pump sweep
+    # and are discarded — the harness holds O(in-flight) tickets, never
+    # O(requests), and the reported percentiles are windowed.
+    pending: deque = deque()
+    hist_all = Histogram()
+    hist_in = Histogram()   # reads completing inside a compaction cycle
+    hist_out = Histogram()
+    hist_vis = Histogram()  # update-visible round trips
+    n_tickets = [0]
+    n_queries = [0]
+    n_failed = [0]
     errors: list = []
     stop = threading.Event()
     t_start = time.perf_counter()
     deadline = t_start + float(duration_s)
     n_writes = [0]
     n_shed = [0]
+    # Start of the compaction cycle currently in flight (None outside
+    # one): completed cycles land in compactor.windows, but a read
+    # finishing DURING the cycle must classify as inside before the
+    # window closes.
+    cycle_t0: list = [None]
+
+    def _inside_compaction(done_at: float) -> bool:
+        windows = getattr(compactor, "windows", ()) or ()
+        if any(a <= done_at <= b for a, b in windows):
+            return True
+        t0 = cycle_t0[0]
+        return t0 is not None and done_at >= t0
+
+    def _sweep_resolved() -> None:
+        """Fold resolved read tickets into the histograms and drop
+        them (caller holds the lock)."""
+        for _ in range(len(pending)):
+            t = pending.popleft()
+            if not t.done:
+                pending.append(t)
+                continue
+            if t.failed:
+                n_failed[0] += 1
+            else:
+                n_queries[0] += t.n
+            if t.latency_ms is not None:
+                hist_all.observe(t.latency_ms)
+                done_at = t._t_submit + t.latency_ms / 1e3
+                (hist_in if _inside_compaction(done_at)
+                 else hist_out).observe(t.latency_ms)
 
     def client(cid: int) -> None:
         rng = np.random.default_rng(seed * 1000 + cid)
@@ -158,7 +216,7 @@ def sustained_load(
                     with lock:
                         ids = live.insert(q)
                         labs = engine.predict(q)
-                    visible_ms.append(
+                    hist_vis.observe(
                         (time.perf_counter() - t0) * 1e3
                     )
                     del ids, labs
@@ -166,11 +224,12 @@ def sustained_load(
                 else:
                     q = np.asarray(query_sampler(rng, batch_rows))
                     with lock:
-                        tickets.append(
+                        pending.append(
                             engine.submit(
                                 q, timeout_s=submit_timeout_s
                             )
                         )
+                        n_tickets[0] += 1
             except QueueFull:
                 # Shed load: the bounded queue refused this request —
                 # the open-loop client drops it and keeps its arrival
@@ -209,14 +268,13 @@ def sustained_load(
                     take = min(len(own_ids), int(write_batch_rows))
                     ids = [own_ids.pop() for _ in range(take)]
                     with lock:
-                        wtickets.append(ingest.submit_delete(ids))
+                        ingest.submit_delete(ids)
                 else:
                     q = np.asarray(
                         write_sampler(rng, int(write_batch_rows))
                     )
                     with lock:
                         t = ingest.submit_insert(q)
-                    wtickets.append(t)
                     mine.append(t)
                 n_writes[0] += 1
             except QueueFull:
@@ -250,7 +308,8 @@ def sustained_load(
                         )
                         probed = True
                     t.visible_ms = (now - t._t_submit) * 1e3
-                    visible_ms.append(t.visible_ms)
+                    hist_vis.observe(t.visible_ms)
+            _sweep_resolved()
         if compactor is not None:
             elapsed = time.perf_counter() - t_start
             due = (
@@ -262,6 +321,11 @@ def sustained_load(
                 compact_started[0] = True
             elif compactor.maybe_compact():
                 compact_started[0] = True
+            if compactor.running:
+                if cycle_t0[0] is None:
+                    cycle_t0[0] = time.perf_counter()
+            else:
+                cycle_t0[0] = None
 
     def drainer() -> None:
         while not stop.is_set():
@@ -292,40 +356,28 @@ def sustained_load(
     pump.join()
     if compactor is not None and compactor._thread is not None:
         compactor.join()  # the swap lands; its error (if any) raises
+    cycle_t0[0] = None  # completed cycles are in compactor.windows now
     with lock:
         engine.drain()  # resolve any straggler tickets
         if ingest is not None:
             ingest.flush()
+        _sweep_resolved()
     wall = time.perf_counter() - t_start
     if errors:
         raise errors[0]
 
-    lat = np.asarray(
-        [t.latency_ms for t in tickets if t.latency_ms is not None],
-        np.float64,
-    )
-    queries = int(sum(t.n for t in tickets if t.done and not t.failed))
-    failed = int(sum(1 for t in tickets if t.failed))
-    dropped = int(sum(1 for t in tickets if not t.done))
-    vis = np.asarray(visible_ms, np.float64)
-
-    def _pct(a, q):
-        return round(float(np.percentile(a, q)), 3) if len(a) else 0.0
-
-    # Compaction-overlap classification: a read whose completion fell
-    # inside a compactor cycle window degraded (or not) under the
-    # background refit — the never-stop-the-world gauge.
-    windows = list(getattr(compactor, "windows", ()) or ())
-    lat_in, lat_out = [], []
-    for t in tickets:
-        if t.latency_ms is None:
-            continue
-        done_at = t._t_submit + t.latency_ms / 1e3
-        inside = any(a <= done_at <= b for a, b in windows)
-        (lat_in if inside else lat_out).append(t.latency_ms)
-    lat_in = np.asarray(lat_in, np.float64)
-    lat_out = np.asarray(lat_out, np.float64)
-    p99_in, p99_out = _pct(lat_in, 99), _pct(lat_out, 99)
+    # Tickets still unresolved after the final drain (the zero-dropped
+    # contract) — everything resolved was folded into the histograms
+    # and discarded at sweep time.
+    dropped = len(pending)
+    # Compaction-overlap classification happened at sweep time (a read
+    # completing inside a live cycle classifies before the window
+    # closes); p99s here are lifetime — each side's window may have
+    # expired by end of run.
+    p99_in = hist_in.percentile(99, window=False) \
+        if hist_in.count else 0.0
+    p99_out = hist_out.percentile(99, window=False) \
+        if hist_out.count else 0.0
 
     stats = engine.serving_stats()
     return {
@@ -333,22 +385,26 @@ def sustained_load(
         "clients": int(clients),
         "duration_s": round(wall, 3),
         "rate_hz": float(rate_hz),
-        "requests": len(tickets) + int(n_writes[0]),
-        "queries": queries,
+        "requests": int(n_tickets[0]) + int(n_writes[0]),
+        "queries": int(n_queries[0]),
         "writes": int(n_writes[0]),
         "write_fraction": float(write_fraction),
-        "qps": round(queries / wall, 1) if wall > 0 else 0.0,
-        "p50_ms": _pct(lat, 50),
-        "p99_ms": _pct(lat, 99),
+        "qps": round(n_queries[0] / wall, 1) if wall > 0 else 0.0,
+        # Windowed percentiles (PYPARDIS_HIST_WINDOW_S): how serving is
+        # doing NOW, not averaged over the whole run.
+        "p50_ms": hist_all.percentile(50),
+        "p99_ms": hist_all.percentile(99),
+        "latency_hist": hist_all.snapshot(),
         "batch_fill": stats.get("batch_fill", 0.0),
-        "update_visible_p50_ms": _pct(vis, 50),
-        "update_visible_p99_ms": _pct(vis, 99),
+        "update_visible_p50_ms": hist_vis.percentile(50),
+        "update_visible_p99_ms": hist_vis.percentile(99),
+        "visible_hist": hist_vis.snapshot(),
         "index_epoch": stats.get("index_epoch", 0),
         # Fault-mode telemetry: queue-full refusals seen by the open-
         # loop clients, and tickets that missed their deadline (both 0
         # on a clean run with no timeout).
         "shed": int(n_shed[0]),
-        "deadline_failures": failed,
+        "deadline_failures": int(n_failed[0]),
         "submit_timeout_s": (
             float(submit_timeout_s) if submit_timeout_s else 0.0
         ),

@@ -151,6 +151,17 @@ _DECLARATIONS: Tuple[EnvVar, ...] = (
     EnvVar("PYPARDIS_HEARTBEAT", "float", "0 (off)",
            "Minimum gap between heartbeat log lines with ETA; "
            "0/unset logs none (flight records always carry them)."),
+    EnvVar("PYPARDIS_HIST_WINDOW_S", "float", "60",
+           "Sliding-window width for latency-histogram percentiles "
+           "(serving/load/ingest p50/p99 answer over this window)."),
+    EnvVar("PYPARDIS_METRICS_PORT", "int", "unset (off)",
+           "OpenMetrics scrape endpoint port on 127.0.0.1 "
+           "(`/metrics`); `0` binds an ephemeral port."),
+    EnvVar("PYPARDIS_METRICS_SNAPSHOT", "path", "unset",
+           "Periodic JSONL metrics-snapshot file appended during "
+           "fits and load harnesses; unset disables."),
+    EnvVar("PYPARDIS_METRICS_SNAPSHOT_S", "float", "0.5",
+           "Metrics-snapshot emit interval in seconds."),
     EnvVar("PYPARDIS_PEAK_FLOPS", "float", "per-backend table",
            "Chip peak FLOP/s override for the MFU gauge."),
     EnvVar("PYPARDIS_RESOURCE_INTERVAL_S", "float", "0.2",

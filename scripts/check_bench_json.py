@@ -24,6 +24,50 @@ def fail(msg: str) -> None:
     sys.exit(1)
 
 
+def check_hist(block, where: str) -> None:
+    """Validate one bounded-histogram snapshot
+    (``pypardis_tpu/hist@1``): the windowed-percentile latency block
+    serving/load/ingest rows carry."""
+    if not isinstance(block, dict):
+        fail(f"{where} is {block!r}, expected a hist@1 dict")
+    if block.get("schema") != "pypardis_tpu/hist@1":
+        fail(f"{where}.schema is {block.get('schema')!r}")
+    for key in ("count", "window_count", "overflow"):
+        v = block.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            fail(f"{where}.{key} is {v!r}, expected a non-negative int")
+    for key in ("p50_ms", "p99_ms", "sum_ms", "max_ms"):
+        v = block.get(key)
+        if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                or v != v or v in (float("inf"), float("-inf")):
+            fail(f"{where}.{key} is {v!r}, expected a finite number")
+    buckets = block.get("buckets")
+    if not isinstance(buckets, list):
+        fail(f"{where}.buckets is {buckets!r}, expected a list")
+    prev_le = float("-inf")
+    total = 0
+    for i, b in enumerate(buckets):
+        if (
+            not isinstance(b, list) or len(b) != 2
+            or not isinstance(b[0], (int, float))
+            or not isinstance(b[1], int) or isinstance(b[1], bool)
+            or b[1] < 0
+        ):
+            fail(f"{where}.buckets[{i}] is {b!r}, expected [le, count]")
+        if b[0] <= prev_le:
+            fail(
+                f"{where}.buckets[{i}] le {b[0]!r} not ascending "
+                f"(prev {prev_le!r})"
+            )
+        prev_le = b[0]
+        total += b[1]
+    if total + block["overflow"] != block["count"]:
+        fail(
+            f"{where} bucket counts sum to "
+            f"{total + block['overflow']}, count says {block['count']}"
+        )
+
+
 def main() -> None:
     args = [a for a in sys.argv[1:] if a != "--require-diff"]
     require_diff = "--require-diff" in sys.argv[1:]
@@ -322,6 +366,10 @@ def main() -> None:
             if not isinstance(v, (int, float)) or isinstance(v, bool) \
                     or v != v or v in (float("inf"), float("-inf")):
                 fail(f"load.{key} is {v!r}, expected a finite number")
+        # ISSUE 16: the percentiles must come from the bounded windowed
+        # histogram, and the row must carry the histogram itself so a
+        # diff can compare full latency shapes, not two scalars.
+        check_hist(load.get("latency_hist"), "load.latency_hist")
     if str(row["metric"]) == "live_replicated_speedup":
         v = row.get("value")
         if not isinstance(v, (int, float)) or v != v or v <= 0:
@@ -388,8 +436,30 @@ def main() -> None:
             if not isinstance(v, (int, float)) or isinstance(v, bool) \
                     or v != v or v in (float("inf"), float("-inf")):
                 fail(f"load.{key} is {v!r}, expected a finite number")
+        check_hist(load.get("latency_hist"), "load.latency_hist")
         if "live" not in tel:
             fail("ingest_mixed_load row without telemetry.live block")
+
+    # Live-observability contract (ISSUE 16): a monitor row proves the
+    # export plane actually answered DURING the fit — the probe must
+    # have scraped the OpenMetrics endpoint mid-run (>= 1 scrape with
+    # parseable families including >= 1 histogram series), collected
+    # >= 1 periodic JSONL snapshot line, rendered the flight stream
+    # through scripts/monitor.py, and carry the serving histogram it
+    # scraped so the claim is a measured artifact, not prose.
+    if str(row["metric"]).startswith("monitor"):
+        if row.get("schema") != "pypardis_tpu/monitor@1":
+            fail(f"monitor row schema is {row.get('schema')!r}")
+        for key in ("scrapes", "families", "hist_series",
+                    "snapshot_lines"):
+            v = row.get(key)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+                fail(f"monitor row.{key} is {v!r}, expected int >= 1")
+        for key in ("openmetrics_ok", "monitor_render_ok"):
+            if row.get(key) is not True:
+                fail(f"monitor row.{key} is {row.get(key)!r}, "
+                     f"expected True")
+        check_hist(row.get("serving_hist"), "monitor row.serving_hist")
 
     # North-star contract (ISSUE 10 / ROADMAP item 1): a northstar row
     # is the measured 100M-trajectory artifact — it must decompose the

@@ -98,13 +98,21 @@ def main() -> None:
         return
     tmp = tempfile.mkdtemp(prefix="flight_check_")
     path = os.path.join(tmp, "flight.jsonl")
+    snap_path = os.path.join(tmp, "metrics_snapshot.jsonl")
     env = dict(os.environ)
+    # ISSUE 16: the kill also lands with the live export plane attached
+    # — the periodic JSONL snapshot stream must degrade exactly like
+    # the flight file does (every line but at worst the last parses).
+    env["PYPARDIS_METRICS_SNAPSHOT"] = snap_path
+    env["PYPARDIS_METRICS_SNAPSHOT_S"] = "0.1"
     deadline = time.time() + float(os.environ.get("FLIGHT_TIMEOUT_S", 300))
     proc = None
     killed_mid_span = False
     for attempt in range(5):
         if os.path.exists(path):
             os.unlink(path)
+        if os.path.exists(snap_path):
+            os.unlink(snap_path)
         proc = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), "--child", path],
             env=env,
@@ -171,6 +179,26 @@ def main() -> None:
         and isinstance(
             report["resources"]["peak_host_rss_bytes"], int
         ),
+    )
+    snap_ok = snap_bad = 0
+    with open(snap_path, "r", encoding="utf-8") as f:
+        lines = [ln for ln in f.read().split("\n") if ln.strip()]
+    for i, line in enumerate(lines):
+        try:
+            r = json.loads(line)
+            if r.get("schema") == "pypardis_tpu/metrics_snapshot@1":
+                snap_ok += 1
+            else:
+                snap_bad += 1
+        except json.JSONDecodeError:
+            # SIGKILL may truncate the line being written — but ONLY
+            # that one: every earlier line was flushed whole.
+            if i == len(lines) - 1:
+                continue
+            snap_bad += 1
+    check(
+        f"metrics-snapshot stream survives the kill ({snap_ok} lines, "
+        f"{snap_bad} bad)", snap_ok >= 1 and snap_bad == 0,
     )
     print(rep.summary())
     print(f"flight-check OK: post-mortem at {path}")

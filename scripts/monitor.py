@@ -1,0 +1,372 @@
+#!/usr/bin/env python
+"""Live run monitor: tail a flight file (or directory of them) and
+render the run's current state (``make monitor MONITOR_PATH=...``).
+
+This is the console you keep open during the 100M north-star run: it
+follows the flight JSONL stream(s) a fit (``PYPARDIS_FLIGHT=...``) or
+a multi-process harness writes, and redraws, once per interval,
+
+* the phase stack each process is currently inside (open spans),
+* per-round progress + ETA from the heartbeat records (global-Morton
+  ring / fixpoint rounds, stepped propagation batches, chained loop),
+* resource watermarks (host RSS / device bytes / staging pool),
+* current latency-histogram percentiles (``h`` records: serving /
+  ingest / phase latencies on the bounded windowed histograms),
+* terminal status (``fin``) or staleness (seconds since the file last
+  grew — a wedged run shows up as a stale RUNNING).
+
+Deliberately **stdlib-only and pypardis-free**: the monitor must start
+instantly on any host that can read the file — no JAX import, no mesh
+configuration, no dependence on the library version that wrote the
+stream.  Directory mode tails every ``*.jsonl`` member (one per
+process/host, the layout ``PYPARDIS_FLIGHT=<dir>`` produces and
+``obs.fleet`` merges post-hoc).
+
+``--once`` renders a single frame and exits (CI / scripting);
+``--json`` emits the frame as one machine-readable JSON object.
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+
+
+def _fmt_bytes(n):
+    try:
+        n = float(n)
+    except (TypeError, ValueError):
+        return "?"
+    for unit in ("B", "KB", "MB", "GB"):
+        if n < 1024 or unit == "GB":
+            return ("%d%s" % (int(n), unit)) if unit == "B" \
+                else ("%.1f%s" % (n, unit))
+        n /= 1024
+    return "%.1fGB" % n
+
+
+def _bar(done, total, width=20):
+    if total <= 0:
+        return "?" * width
+    frac = min(max(done / total, 0.0), 1.0)
+    filled = int(round(frac * width))
+    return "#" * filled + "." * (width - filled)
+
+
+class Tail:
+    """Incremental single-file tail: parse only the bytes appended
+    since the last poll, fold them into the run-state machine."""
+
+    def __init__(self, path):
+        self.path = path
+        self.offset = 0
+        self.partial = ""  # trailing bytes with no newline yet
+        self.header = {}
+        self.open_spans = {}   # id -> {name, t, depth}
+        self.heartbeats = {}   # stage -> {done,total,eta_s,t}
+        self.resources = {}    # last rs record fields
+        self.res_peaks = {}    # max over rs records
+        self.hists = {}        # key -> last h snapshot
+        self.phase_s = {}      # tm aggregates: key -> total seconds
+        self.events = 0
+        self.records = 0
+        self.bad_lines = 0
+        self.last_t = 0.0
+        self.finished = None   # fin status
+        self.last_growth = time.time()
+
+    def poll(self):
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return
+        if size < self.offset:
+            # Truncated/rotated underneath us: start over.
+            self.offset = 0
+            self.partial = ""
+        if size == self.offset:
+            return
+        with open(self.path, "r", encoding="utf-8",
+                  errors="replace") as f:
+            f.seek(self.offset)
+            chunk = f.read()
+            self.offset = f.tell()
+        self.last_growth = time.time()
+        buf = self.partial + chunk
+        lines = buf.split("\n")
+        self.partial = lines.pop()  # "" when chunk ended on a newline
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                r = json.loads(line)
+            except ValueError:
+                self.bad_lines += 1
+                continue
+            if not isinstance(r, dict):
+                self.bad_lines += 1
+                continue
+            self._fold(r)
+
+    def _fold(self, r):
+        self.records += 1
+        k = r.get("k")
+        try:
+            t = float(r.get("t", self.last_t) or 0.0)
+        except (TypeError, ValueError):
+            t = self.last_t
+        self.last_t = max(self.last_t, t)
+        try:
+            if k == "header":
+                self.header = r
+            elif k == "so":
+                self.open_spans[int(r["id"])] = {
+                    "name": r.get("name", "?"), "t": t,
+                    "depth": int(r.get("depth", 0) or 0),
+                }
+            elif k == "sc":
+                self.open_spans.pop(int(r["id"]), None)
+                self.last_t = max(
+                    self.last_t, t + float(r.get("dur", 0.0) or 0.0)
+                )
+            elif k == "sx":
+                self.last_t = max(
+                    self.last_t, t + float(r.get("dur", 0.0) or 0.0)
+                )
+            elif k == "hb":
+                self.heartbeats[str(r.get("stage"))] = {
+                    "done": int(r.get("done", 0) or 0),
+                    "total": int(r.get("total", 0) or 0),
+                    "eta_s": float(r.get("eta_s", -1.0) or 0.0),
+                    "t": t,
+                }
+            elif k == "rs":
+                for key, v in r.items():
+                    if key in ("k", "t"):
+                        continue
+                    if isinstance(v, (int, float)):
+                        self.resources[key] = v
+                        if v > self.res_peaks.get(key, float("-inf")):
+                            self.res_peaks[key] = v
+            elif k == "h":
+                snap = r.get("snap")
+                if isinstance(snap, dict):
+                    self.hists[str(r.get("key"))] = snap
+            elif k == "tm":
+                key = str(r.get("key"))
+                self.phase_s[key] = (
+                    self.phase_s.get(key, 0.0)
+                    + float(r.get("s", 0.0) or 0.0)
+                )
+            elif k == "ev":
+                self.events += 1
+            elif k == "fin":
+                self.finished = str(r.get("status"))
+        except (KeyError, TypeError, ValueError):
+            self.bad_lines += 1
+
+    # -- frame -------------------------------------------------------------
+
+    def state(self):
+        spans = sorted(
+            self.open_spans.values(),
+            key=lambda s: (s["depth"], s["t"]),
+        )
+        return {
+            "path": self.path,
+            "pid": self.header.get("pid"),
+            "records": self.records,
+            "bad_lines": self.bad_lines,
+            "last_t_s": round(self.last_t, 3),
+            "stale_s": round(time.time() - self.last_growth, 1),
+            "finished": self.finished,
+            "phase_stack": [s["name"] for s in spans],
+            "heartbeats": self.heartbeats,
+            "resources": dict(self.resources),
+            "resource_peaks": dict(self.res_peaks),
+            "hists": {
+                key: {
+                    "p50_ms": s.get("p50_ms"),
+                    "p99_ms": s.get("p99_ms"),
+                    "count": s.get("count"),
+                    "window_count": s.get("window_count"),
+                }
+                for key, s in self.hists.items()
+            },
+            "phase_s": {
+                key: round(v, 3) for key, v in self.phase_s.items()
+            },
+            "events": self.events,
+        }
+
+    def render(self):
+        st = self.state()
+        if st["finished"] is not None:
+            status = "FINISHED %s" % st["finished"]
+        elif st["stale_s"] > 5.0:
+            status = "RUNNING (stale %.0fs)" % st["stale_s"]
+        else:
+            status = "RUNNING"
+        who = "pid=%s" % st["pid"] if st["pid"] is not None else "?"
+        out = [
+            "%s  [%s]  t=%.1fs  %d records%s"
+            % (
+                os.path.basename(st["path"]), status, st["last_t_s"],
+                st["records"],
+                (", %d bad" % st["bad_lines"]) if st["bad_lines"]
+                else "",
+            ),
+            "  %s  phase: %s"
+            % (who, " > ".join(st["phase_stack"]) or "(idle)"),
+        ]
+        for stage in sorted(st["heartbeats"]):
+            hb = st["heartbeats"][stage]
+            eta = hb["eta_s"]
+            out.append(
+                "  %-24s [%s] %d/%d rounds%s"
+                % (
+                    stage, _bar(hb["done"], hb["total"]),
+                    hb["done"], hb["total"],
+                    ("  eta %.1fs" % eta) if eta >= 0 else "",
+                )
+            )
+        pk = st["resource_peaks"]
+        if pk:
+            bits = []
+            for key, label in (
+                ("rss", "rss"), ("dev", "dev"), ("pool", "pool"),
+            ):
+                if key in pk:
+                    bits.append("%s %s" % (label, _fmt_bytes(pk[key])))
+            for key in sorted(pk):
+                if key not in ("rss", "dev", "pool"):
+                    bits.append("%s %s" % (key, _fmt_bytes(pk[key])))
+            out.append("  resources(peak): " + ", ".join(bits))
+        for key in sorted(st["hists"]):
+            h = st["hists"][key]
+            out.append(
+                "  %-24s p50 %.2fms  p99 %.2fms  (%s obs, %s in window)"
+                % (
+                    key, h.get("p50_ms") or 0.0, h.get("p99_ms") or 0.0,
+                    h.get("count"), h.get("window_count"),
+                )
+            )
+        top = sorted(
+            st["phase_s"].items(), key=lambda kv: -kv[1]
+        )[:4]
+        if top:
+            out.append(
+                "  timings: "
+                + " | ".join("%s %.2fs" % kv for kv in top)
+            )
+        return "\n".join(out)
+
+
+class Monitor:
+    """One or many tails (directory mode picks up new members live)."""
+
+    def __init__(self, path):
+        self.path = path
+        self.tails = {}
+        self._refresh_members()
+        if not self.tails:
+            raise FileNotFoundError(
+                "no flight file(s) at %r (expected a .jsonl file or a "
+                "directory of them)" % path
+            )
+
+    def _refresh_members(self):
+        if os.path.isdir(self.path):
+            members = sorted(glob.glob(
+                os.path.join(self.path, "*.jsonl")
+            ))
+        elif os.path.exists(self.path):
+            members = [self.path]
+        else:
+            members = []
+        for m in members:
+            if m not in self.tails:
+                self.tails[m] = Tail(m)
+
+    def poll(self):
+        self._refresh_members()
+        for t in self.tails.values():
+            t.poll()
+
+    def frame(self):
+        return "\n\n".join(
+            t.render() for _, t in sorted(self.tails.items())
+        )
+
+    def state(self):
+        return {
+            "schema": "pypardis_tpu/monitor_frame@1",
+            "path": self.path,
+            "hosts": [
+                t.state() for _, t in sorted(self.tails.items())
+            ],
+        }
+
+    def all_finished(self):
+        return all(
+            t.finished is not None for t in self.tails.values()
+        )
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="live-tail pypardis_tpu flight file(s)"
+    )
+    ap.add_argument(
+        "path",
+        help="flight .jsonl file, or a directory of them "
+             "(PYPARDIS_FLIGHT=<dir> layout)",
+    )
+    ap.add_argument(
+        "--interval", type=float, default=1.0,
+        help="redraw interval in seconds (default 1.0)",
+    )
+    ap.add_argument(
+        "--once", action="store_true",
+        help="render a single frame and exit (CI / scripting)",
+    )
+    ap.add_argument(
+        "--json", action="store_true",
+        help="emit the frame as one JSON object instead of text",
+    )
+    ap.add_argument(
+        "--no-clear", action="store_true",
+        help="append frames instead of clearing the screen",
+    )
+    ap.add_argument(
+        "--follow-until-fin", action="store_true",
+        help="exit once every tailed file has a terminal fin record",
+    )
+    args = ap.parse_args(argv)
+
+    mon = Monitor(args.path)
+    while True:
+        mon.poll()
+        if args.json:
+            frame = json.dumps(mon.state(), sort_keys=True)
+        else:
+            frame = mon.frame()
+        if args.once:
+            print(frame)
+            return 0
+        if not args.no_clear and sys.stdout.isatty():
+            sys.stdout.write("\x1b[2J\x1b[H")
+        print(frame, flush=True)
+        if args.follow_until_fin and mon.all_finished():
+            return 0
+        try:
+            time.sleep(max(args.interval, 0.05))
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

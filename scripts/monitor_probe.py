@@ -1,0 +1,264 @@
+#!/usr/bin/env python
+"""Live-observability probe (``make monitor-probe``): prove the export
+plane answers DURING a fit, not just after it.
+
+Runs a global-Morton fit on the faked 8-device CPU mesh with the
+scrape endpoint (``PYPARDIS_METRICS_PORT=0``) and periodic JSONL
+snapshots enabled, and — from this process, while the fit thread is
+still inside device work — scrapes ``/metrics`` until one response
+carries all three live families at once:
+
+* an open phase span (``pypardis_open_span``),
+* per-round heartbeat progress (``pypardis_heartbeat_done`` — the
+  global-Morton ring / fixpoint rounds),
+* at least one latency histogram series (``..._bucket{le="..."}``).
+
+Every scrape must be well-formed OpenMetrics (``# EOF`` terminated).
+If the fit outruns the scraper the probe retries with 2x the points.
+Afterwards it drives the query engine, re-attaches the exporter to the
+serving recorder, scrapes the ``serving.latency_ms`` histogram,
+counts the snapshot lines that parse, renders the fit's flight stream
+through ``scripts/monitor.py`` (``--json --once`` and text), and emits
+one schema'd row::
+
+    {"metric": "monitor_live_scrape", "value": <scrapes>,
+     "unit": "scrapes", "schema": "pypardis_tpu/monitor@1",
+     "scrapes": ..., "families": ..., "hist_series": ...,
+     "openmetrics_ok": true, "snapshot_lines": ...,
+     "monitor_render_ok": true, "serving_hist": {...hist@1...},
+     "telemetry": {...run_report@1...}}
+
+validated by ``scripts/check_bench_json.py`` (the ``monitor``
+contract) under ``make monitor-probe`` / ``bench-smoke``.
+
+Env knobs: MONITOR_N (fit points, default 40000), MONITOR_DIM
+(default 8), MONITOR_Q (serving queries, default 2048),
+MONITOR_TIMEOUT_S (overall deadline, default 300).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _force_cpu_mesh() -> None:
+    # Same discipline as tests/conftest.py: the deployment image's
+    # sitecustomize may pre-import jax pinned to another platform, so
+    # env vars alone can be too late — override via jax.config too.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if "jax_num_cpu_devices" in jax.config._value_holders:
+        jax.config.update("jax_num_cpu_devices", 8)
+
+
+def _scrape(port: int, timeout: float = 2.0) -> str:
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=timeout
+    ) as resp:
+        return resp.read().decode("utf-8")
+
+
+def _families(body: str) -> int:
+    return sum(1 for ln in body.splitlines() if ln.startswith("# TYPE "))
+
+
+def _hist_series(body: str) -> int:
+    return sum(1 for ln in body.splitlines() if '_bucket{le="' in ln)
+
+
+def check(msg: str, ok: bool) -> None:
+    status = "ok" if ok else "FAILED"
+    print(f"monitor-probe: {msg}: {status}", file=sys.stderr)
+    if not ok:
+        sys.exit(1)
+
+
+def main() -> None:
+    tmp = tempfile.mkdtemp(prefix="monitor_probe_")
+    flight_path = os.path.join(tmp, "flight.jsonl")
+    snap_path = os.path.join(tmp, "metrics_snapshot.jsonl")
+    # The fit's own train path reads these and attaches the exporters —
+    # the probe only ever talks to the endpoint from outside, exactly
+    # like a scrape agent would.
+    os.environ["PYPARDIS_METRICS_PORT"] = "0"
+    os.environ["PYPARDIS_METRICS_SNAPSHOT"] = snap_path
+    os.environ["PYPARDIS_METRICS_SNAPSHOT_S"] = "0.1"
+
+    _force_cpu_mesh()
+    import numpy as np
+
+    from pypardis_tpu import DBSCAN
+    from pypardis_tpu.obs import export as obs_export
+
+    n = int(os.environ.get("MONITOR_N", 40000))
+    dim = int(os.environ.get("MONITOR_DIM", 8))
+    n_q = int(os.environ.get("MONITOR_Q", 2048))
+    deadline = time.time() + float(
+        os.environ.get("MONITOR_TIMEOUT_S", 300)
+    )
+
+    scrapes = 0
+    families = hist_series = 0
+    openmetrics_ok = True
+    live_ok = False
+    model = None
+    for attempt in range(4):
+        rng = np.random.default_rng(attempt)
+        X = rng.normal(size=(n, dim)).astype(np.float32) * 3.0
+        model = DBSCAN(
+            eps=0.5, min_samples=5, block=256,
+            mode="global_morton", flight=flight_path,
+        )
+        # New binds append to the port log — watch for growth rather
+        # than a changed value (the OS may reuse an ephemeral port).
+        ports_before = len(obs_export._LAST_HTTP_PORT)
+        err: list = []
+
+        def _fit():
+            try:
+                model.fit(X)
+            except Exception as e:  # surfaced below, not swallowed
+                err.append(e)
+
+        th = threading.Thread(target=_fit, name="monitor-probe-fit")
+        th.start()
+        saw_span = saw_hb = saw_hist = False
+        while th.is_alive() and time.time() < deadline:
+            new_ports = obs_export._LAST_HTTP_PORT[ports_before:]
+            if not new_ports:
+                time.sleep(0.01)
+                continue
+            try:
+                body = _scrape(new_ports[-1])
+            except OSError:
+                time.sleep(0.02)  # fit finished; server already down
+                continue
+            scrapes += 1
+            if not body.rstrip().endswith("# EOF"):
+                openmetrics_ok = False
+            fams, hists = _families(body), _hist_series(body)
+            has_span = "pypardis_open_span{" in body
+            has_hb = "pypardis_heartbeat_done{" in body
+            saw_span |= has_span
+            saw_hb |= has_hb
+            saw_hist |= hists > 0
+            # The row reports a genuinely live frame: prefer the scrape
+            # where all three families were present at once.
+            if has_span and has_hb and hists > 0:
+                families, hist_series = fams, hists
+                live_ok = True
+            time.sleep(0.05)  # a scrape agent's cadence, not a spin
+        th.join()
+        if err:
+            raise err[0]
+        if live_ok:
+            break
+        print(
+            f"monitor-probe: attempt {attempt}: fit outran the scraper "
+            f"(scrapes={scrapes} span={saw_span} hb={saw_hb} "
+            f"hist={saw_hist}); retrying with n={n * 2}",
+            file=sys.stderr,
+        )
+        n *= 2
+    check(
+        f"mid-fit scrape saw open span + heartbeat + histogram "
+        f"({scrapes} scrapes, {families} families, {hist_series} "
+        f"hist series)", live_ok and scrapes >= 1,
+    )
+    check("every scrape was # EOF-terminated OpenMetrics",
+          openmetrics_ok)
+
+    # -- serving histogram over the live endpoint --------------------------
+    engine = model.query_engine()
+    lo, hi = X.min(axis=0), X.max(axis=0)
+    rng = np.random.default_rng(1)
+    queries = rng.uniform(lo, hi, size=(n_q, dim)).astype(np.float32)
+    tickets = []
+    for s in range(0, n_q, 256):
+        tickets.append(engine.submit(queries[s:s + 256]))
+        if len(tickets) % 8 == 0:
+            engine.drain()
+    engine.drain()
+    for t in tickets:
+        t.result()
+    stack = obs_export.attach_exporters(engine.recorder, port=0)
+    try:
+        body = _scrape(stack.http_port)
+    finally:
+        stack.close()
+    check(
+        "serving latency histogram scrapes post-fit",
+        "pypardis_serving_latency_ms_bucket{" in body
+        and body.rstrip().endswith("# EOF"),
+    )
+    serving_hist = engine.serving_stats()["latency_hist"]
+
+    # -- snapshot stream ---------------------------------------------------
+    snap_lines = 0
+    with open(snap_path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            r = json.loads(line)
+            if r.get("schema") == obs_export.SNAPSHOT_SCHEMA:
+                snap_lines += 1
+    check(f"snapshot stream parses ({snap_lines} lines)",
+          snap_lines >= 1)
+
+    # -- monitor renders the flight stream ---------------------------------
+    mon = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "monitor.py")
+    out = subprocess.run(
+        [sys.executable, mon, flight_path, "--once", "--json"],
+        capture_output=True, text=True, timeout=60,
+    )
+    frame = json.loads(out.stdout) if out.returncode == 0 else {}
+    render_ok = (
+        out.returncode == 0
+        and frame.get("schema") == "pypardis_tpu/monitor_frame@1"
+        and frame.get("hosts")
+        and frame["hosts"][0]["records"] > 0
+    )
+    txt = subprocess.run(
+        [sys.executable, mon, flight_path, "--once"],
+        capture_output=True, text=True, timeout=60,
+    )
+    render_ok = bool(
+        render_ok and txt.returncode == 0 and "records" in txt.stdout
+    )
+    check("scripts/monitor.py renders the flight stream", render_ok)
+
+    row = {
+        "metric": "monitor_live_scrape",
+        "value": scrapes,
+        "unit": "scrapes",
+        "schema": "pypardis_tpu/monitor@1",
+        "scrapes": scrapes,
+        "families": families,
+        "hist_series": hist_series,
+        "openmetrics_ok": openmetrics_ok,
+        "snapshot_lines": snap_lines,
+        "monitor_render_ok": render_ok,
+        "serving_hist": serving_hist,
+        "telemetry": model.report(),
+    }
+    print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,272 @@
+"""Live export plane (pypardis_tpu.obs.export, ISSUE 16).
+
+Unit: the bounded windowed histogram (log bucketing, sliding-window
+percentiles with lifetime fallback, merge/clone, snapshot round-trip,
+fixed footprint), the registry's histogram integration, and the
+OpenMetrics text rendering.  Integration: ``attach_exporters`` on a
+live recorder — a mid-span HTTP scrape, the periodic JSONL snapshot
+stream, and exact sink-seam restoration on close (including an
+attached flight recorder riding the same seam).
+"""
+
+import json
+import math
+import time
+import urllib.request
+
+import pytest
+
+from pypardis_tpu.obs import RunRecorder
+from pypardis_tpu.obs.export import (
+    HIST_SCHEMA,
+    SNAPSHOT_SCHEMA,
+    Histogram,
+    LiveState,
+    attach_exporters,
+    render_openmetrics,
+)
+from pypardis_tpu.obs.flight import FlightRecorder
+from pypardis_tpu.obs.registry import MetricsRegistry
+
+
+# ---------------------------------------------------------------------------
+# Histogram
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_percentiles_order_and_range():
+    h = Histogram(window_s=60)
+    for i in range(1, 101):
+        h.observe(float(i))  # 1..100 ms
+    p50, p99 = h.percentile(50), h.percentile(99)
+    assert 0 < p50 <= p99
+    # Log-bucket resolution is ~33%/bucket: generous envelopes.
+    assert 30 <= p50 <= 80
+    assert 70 <= p99 <= 140
+    assert h.count == 100
+    assert h.max_ms == 100.0
+    assert h.sum_ms == pytest.approx(5050.0)
+
+
+def test_histogram_window_expiry_and_lifetime_fallback():
+    h = Histogram(window_s=8)  # chunk_s = 1
+    old = time.monotonic() - 1000.0
+    for _ in range(10):
+        h.observe(1.0, now_s=old)
+    # All observations expired from the window: windowed percentile
+    # falls back to lifetime instead of answering 0.
+    assert h.window_count == 0
+    assert h.percentile(50) == pytest.approx(1.0, rel=0.4)
+    snap = h.snapshot()
+    assert snap["window_count"] == 0 and snap["count"] == 10
+    # Fresh observations are two decades up: the window sees ONLY them.
+    for _ in range(5):
+        h.observe(100.0)
+    assert h.window_count == 5
+    assert h.percentile(50) == pytest.approx(100.0, rel=0.4)
+    # Lifetime still dominated by the old 1ms points.
+    assert h.percentile(50, window=False) == pytest.approx(1.0, rel=0.4)
+
+
+def test_histogram_footprint_never_grows():
+    h = Histogram()
+    before = h.nbytes
+    for i in range(50_000):
+        h.observe((i % 977) / 7.0)
+    assert h.nbytes == before  # the memory-bound contract
+    assert h.count == 50_000
+
+
+def test_histogram_nan_and_overflow():
+    h = Histogram()
+    h.observe(float("nan"))
+    assert h.count == 0
+    h.observe(1e9)  # 1e6 s: beyond the last edge -> overflow bucket
+    snap = h.snapshot()
+    assert snap["overflow"] == 1 and snap["buckets"] == []
+    # Overflow percentile clamps to the max seen, not an edge.
+    assert h.percentile(99) == pytest.approx(1e9)
+
+
+def test_histogram_merge_clone_snapshot_roundtrip():
+    a, b = Histogram(window_s=60), Histogram(window_s=60)
+    for v in (0.5, 2.0, 8.0):
+        a.observe(v)
+    for v in (32.0, 128.0):
+        b.observe(v)
+    c = a.clone()
+    c.merge_from(b)
+    assert c.count == 5 and a.count == 3  # clone is independent
+    assert c.max_ms == 128.0
+    assert c.sum_ms == pytest.approx(a.sum_ms + b.sum_ms)
+
+    snap = c.snapshot()
+    assert snap["schema"] == HIST_SCHEMA and snap["unit"] == "ms"
+    assert sum(cnt for _, cnt in snap["buckets"]) == 5
+    les = [le for le, _ in snap["buckets"]]
+    assert les == sorted(les)
+    back = Histogram.from_snapshot(json.loads(json.dumps(snap)))
+    assert back.snapshot()["buckets"] == snap["buckets"]
+    assert back.count == 5
+    assert back.sum_ms == pytest.approx(snap["sum_ms"])
+    assert back.percentile(50, window=False) == pytest.approx(
+        c.percentile(50, window=False)
+    )
+
+
+# ---------------------------------------------------------------------------
+# registry integration
+# ---------------------------------------------------------------------------
+
+
+def test_registry_observe_feeds_histogram():
+    reg = MetricsRegistry()
+    reg.observe("phase.cluster", 0.004)  # seconds -> 4ms
+    reg.observe("phase.cluster", 0.016)
+    d = reg.as_dict()
+    snap = d["hists"]["phase.cluster"]
+    assert snap["count"] == 2
+    assert 3.0 <= snap["p50_ms"] <= 20.0
+    # timings and hists stay in lockstep
+    assert d["timings"]["phase.cluster"]["count"] == 2
+
+
+def test_registry_observe_ms_and_load_hist():
+    reg = MetricsRegistry()
+    reg.observe_ms("serving.latency_ms", 2.5)
+    assert reg.hist("serving.latency_ms").count == 1
+    donor = Histogram()
+    donor.observe(40.0)
+    reg.load_hist("serving.latency_ms", donor.snapshot())
+    assert reg.hist("serving.latency_ms").count == 2
+
+    other = MetricsRegistry()
+    other.observe_ms("serving.latency_ms", 9.0)
+    reg.merge(other)
+    assert reg.hist("serving.latency_ms").count == 3
+
+
+# ---------------------------------------------------------------------------
+# OpenMetrics rendering
+# ---------------------------------------------------------------------------
+
+
+def test_render_openmetrics_families():
+    reg = MetricsRegistry()
+    reg.inc("events.compile")
+    reg.set("metrics.http_port", 9200)
+    reg.observe_ms("serving.latency_ms", 3.0)
+    state = LiveState()
+    state.span_open(1, "cluster", 0.0, 0, {})
+    state.span_close(2, "gm.ring_round", 0.0, 0.012, {})
+    state.heartbeat("gm.ring", 3, 7, 1.5)
+    state.sample(rss=12345.0)
+    body = render_openmetrics(reg.as_dict(), state)
+    assert body.endswith("# EOF\n")
+    assert "pypardis_events_compile_total 1" in body
+    assert "pypardis_metrics_http_port 9200" in body
+    assert 'pypardis_serving_latency_ms_bucket{le="' in body
+    # Span closes feed LIVE histograms (the mid-fit scrape contract:
+    # latency distributions exist before the profiling accumulator
+    # observes anything at fit end).
+    assert 'pypardis_span_gm_ring_round_bucket{le="' in body
+    assert 'pypardis_open_span{name="cluster",depth="0"}' in body
+    assert 'pypardis_heartbeat_done{stage="gm.ring"} 3' in body
+    assert 'pypardis_heartbeat_total{stage="gm.ring"} 7' in body
+    assert "pypardis_resource_rss 12345" in body
+    assert "pypardis_run_finished 0" in body
+    # bucket series are cumulative and finite
+    for ln in body.splitlines():
+        if "_bucket{" in ln:
+            assert math.isfinite(float(ln.rsplit(" ", 1)[1]))
+
+
+# ---------------------------------------------------------------------------
+# attach_exporters
+# ---------------------------------------------------------------------------
+
+
+def test_attach_exporters_off_is_none(monkeypatch):
+    monkeypatch.delenv("PYPARDIS_METRICS_PORT", raising=False)
+    monkeypatch.delenv("PYPARDIS_METRICS_SNAPSHOT", raising=False)
+    assert attach_exporters(RunRecorder()) is None
+    assert attach_exporters(None) is None
+
+
+def test_http_scrape_mid_span_and_seam_restore():
+    rec = RunRecorder()
+    stack = attach_exporters(rec, port=0)
+    assert stack is not None and stack.http_port
+    try:
+        with rec.span("unit.scrape_phase"):
+            rec.metrics.observe_ms("serving.latency_ms", 1.5)
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{stack.http_port}/metrics", timeout=5
+            ) as resp:
+                ctype = resp.headers.get("Content-Type", "")
+                body = resp.read().decode("utf-8")
+            assert "openmetrics-text" in ctype
+            assert body.rstrip().endswith("# EOF")
+            assert 'pypardis_open_span{name="unit.scrape_phase"' in body
+            assert 'pypardis_serving_latency_ms_bucket{le="' in body
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{stack.http_port}/state.json",
+                timeout=5,
+            ) as resp:
+                st = json.loads(resp.read())
+            assert st["schema"] == SNAPSHOT_SCHEMA
+            assert "unit.scrape_phase" in st["open_spans"]
+        assert rec.metrics.gauge("metrics.http_port") == stack.http_port
+    finally:
+        stack.close()
+    # seam restored exactly: no fanout left behind
+    assert rec.flight is None
+    assert rec.tracer.sink is None
+    assert rec.metrics.sink is None
+
+
+def test_snapshot_stream_lines_parse(tmp_path):
+    rec = RunRecorder()
+    path = tmp_path / "snap.jsonl"
+    stack = attach_exporters(
+        rec, snapshot_path=str(path), snapshot_interval_s=0.05
+    )
+    try:
+        with rec.span("unit.snap_phase"):
+            rec.metrics.observe_ms("serving.latency_ms", 2.0)
+            time.sleep(0.18)
+    finally:
+        stack.close()
+    lines = [
+        json.loads(ln) for ln in path.read_text().splitlines() if ln
+    ]
+    assert len(lines) >= 2  # immediate first line + final line at close
+    for r in lines:
+        assert r["schema"] == SNAPSHOT_SCHEMA
+        assert "span_hists" in r and "heartbeats" in r
+    assert lines[-1]["hists"]["serving.latency_ms"]["count"] == 1
+    # the span closed before the final line: its live hist is in there
+    assert lines[-1]["span_hists"]["span.unit.snap_phase"]["count"] == 1
+
+
+def test_exporters_tee_with_flight_recorder(tmp_path):
+    rec = RunRecorder()
+    fpath = tmp_path / "flight.jsonl"
+    flight = FlightRecorder(str(fpath), flush_interval_s=0.0)
+    rec.attach_flight(flight)
+    stack = attach_exporters(rec, port=0)
+    try:
+        with rec.span("unit.tee_phase"):
+            pass
+    finally:
+        stack.close()
+    # the flight recorder rode the same seam and saw every record...
+    kinds = [
+        json.loads(ln)["k"]
+        for ln in fpath.read_text().splitlines() if ln
+    ]
+    assert "so" in kinds and "sc" in kinds
+    # ...and close() restored it as THE sink, not a leftover fanout
+    assert rec.flight is flight
+    assert rec.tracer.sink is flight
+    assert rec.metrics.sink is flight

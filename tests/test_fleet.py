@@ -1,0 +1,168 @@
+"""Fleet flight aggregation (pypardis_tpu.obs.fleet, ISSUE 16).
+
+Three synthetic per-host flight files — wall-clock anchors 1000.0 /
+1000.5 / 1001.25s, the third killed mid-span with a truncated final
+line — aggregated via ``obs.replay(<dir>)``: clock-offset alignment,
+one Chrome-trace lane per host, byte-deterministic merged outputs,
+pooled registries/histograms, fleet-level partial report, and the
+stdlib run monitor rendering the same directory.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from pypardis_tpu import obs
+from pypardis_tpu.obs.export import Histogram
+from pypardis_tpu.obs.fleet import FleetReplay
+
+MONITOR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "scripts", "monitor.py",
+)
+
+
+def _hist_snap():
+    h = Histogram(window_s=60)
+    for v in (1.0, 4.0, 16.0):
+        h.observe(v)
+    return h.snapshot()
+
+
+def _write_member(path, t_unix, pid, fin=True, truncate=False):
+    lines = [
+        {"k": "header", "schema": "pypardis_tpu/flight@1",
+         "pid": pid, "t_unix": t_unix},
+        {"k": "so", "id": 0, "name": "fit", "t": 0.01, "depth": 0,
+         "a": {}},
+        {"k": "so", "id": 1, "name": "cluster", "t": 0.02, "depth": 1,
+         "a": {}},
+        {"k": "hb", "stage": "gm.ring", "done": 3, "total": 7,
+         "eta_s": 1.5, "t": 0.05},
+        {"k": "tm", "key": "phase.cluster", "s": 0.2, "t": 0.25},
+        {"k": "h", "key": "serving.latency_ms", "t": 0.3,
+         "snap": _hist_snap()},
+        {"k": "rs", "rss": 1000.0 * pid, "t": 0.3},
+    ]
+    if fin:
+        lines += [
+            {"k": "sc", "id": 1, "name": "cluster", "t": 0.02,
+             "dur": 0.3, "a": {}},
+            {"k": "sc", "id": 0, "name": "fit", "t": 0.01, "dur": 0.4,
+             "a": {}},
+            {"k": "fin", "status": "ok", "t": 0.45},
+        ]
+    txt = "\n".join(json.dumps(r) for r in lines) + "\n"
+    if truncate:
+        txt += '{"k": "rs", "rss": 123'  # SIGKILL mid-write, no newline
+    path.write_text(txt, encoding="utf-8")
+
+
+@pytest.fixture()
+def fleet_dir(tmp_path):
+    d = tmp_path / "runs"
+    d.mkdir()
+    # File names sort AGAINST the wall-clock order on purpose: the
+    # merge must order hosts by their t_unix anchor, not the listing.
+    _write_member(d / "flight-c.jsonl", 1000.0, pid=11)
+    _write_member(d / "flight-a.jsonl", 1000.5, pid=22)
+    _write_member(d / "flight-b.jsonl", 1001.25, pid=33, fin=False,
+                  truncate=True)
+    return d
+
+
+def test_replay_dispatches_directories_to_fleet(fleet_dir):
+    rep = obs.replay(str(fleet_dir))
+    assert isinstance(rep, FleetReplay)
+
+
+def test_clock_offset_alignment_and_host_order(fleet_dir):
+    rep = FleetReplay(str(fleet_dir))
+    assert [h["pid"] for h in rep.hosts] == [11, 22, 33]
+    assert [h["offset_s"] for h in rep.hosts] == [0.0, 0.5, 1.25]
+    assert all(h["aligned"] for h in rep.hosts)
+    assert [h["complete"] for h in rep.hosts] == [True, True, False]
+    assert rep.hosts[2]["open_spans"] == ["fit", "cluster"]
+
+
+def test_fleet_report_partial_and_pooled_hists(fleet_dir):
+    rep = FleetReplay(str(fleet_dir))
+    r = rep.report()
+    assert r["schema"] == "pypardis_tpu/fleet_report@1"
+    assert r["hosts"] == 3 and r["aligned_hosts"] == 3
+    assert r["partial"] is True and r["complete"] is False
+    json.dumps(r)  # serializable end to end
+    # registries pool: 3 hosts x 3 observations each
+    hist = r["registry"]["hists"]["serving.latency_ms"]
+    assert hist["count"] == 9
+    # heartbeats keyed per host on the aligned clock
+    hbs = r["heartbeats"]
+    assert set(hbs) == {
+        "gm.ring@host0", "gm.ring@host1", "gm.ring@host2",
+    }
+    assert hbs["gm.ring@host2"]["t_s"] == pytest.approx(1.3)
+    # summary renders the per-host death site
+    s = rep.summary()
+    assert "PARTIAL" in s
+    assert "killed inside fit,cluster" in s
+
+
+def test_chrome_trace_one_lane_per_host(fleet_dir):
+    doc = FleetReplay(str(fleet_dir)).to_chrome_trace()
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    metas = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert {e["pid"] for e in xs} <= {0, 1, 2}
+    assert {e["args"]["name"] for e in metas} == {
+        "host0 pid=11", "host1 pid=22", "host2 pid=33",
+    }
+    ts = [e["ts"] for e in xs]
+    assert ts == sorted(ts)
+
+
+def test_merged_outputs_byte_deterministic(fleet_dir, tmp_path):
+    a, b = FleetReplay(str(fleet_dir)), FleetReplay(str(fleet_dir))
+    ta, tb = tmp_path / "a.json", tmp_path / "b.json"
+    a.export_chrome_trace(str(ta))
+    b.export_chrome_trace(str(tb))
+    assert ta.read_bytes() == tb.read_bytes()
+    ma, mb = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    a.write_merged(str(ma))
+    b.write_merged(str(mb))
+    assert ma.read_bytes() == mb.read_bytes()
+
+    records = [
+        json.loads(ln) for ln in ma.read_text().splitlines() if ln
+    ]
+    # aligned time order, every record host-stamped, bad line dropped
+    assert all("host" in r for r in records)
+    times = [r["t"] for r in records]
+    assert times == sorted(times)
+    assert len(records) == a.records
+
+
+def test_monitor_renders_fleet_directory(fleet_dir):
+    out = subprocess.run(
+        [sys.executable, MONITOR, str(fleet_dir), "--once", "--json"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out.returncode == 0, out.stderr
+    frame = json.loads(out.stdout)
+    assert frame["schema"] == "pypardis_tpu/monitor_frame@1"
+    assert len(frame["hosts"]) == 3
+    by_pid = {h["pid"]: h for h in frame["hosts"]}
+    assert by_pid[11]["finished"] == "ok"
+    assert by_pid[33]["finished"] is None
+    assert by_pid[33]["phase_stack"] == ["fit", "cluster"]
+    assert by_pid[22]["hists"]["serving.latency_ms"]["count"] == 3
+    assert by_pid[22]["heartbeats"]["gm.ring"]["done"] == 3
+
+    txt = subprocess.run(
+        [sys.executable, MONITOR, str(fleet_dir), "--once"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert txt.returncode == 0, txt.stderr
+    assert "FINISHED ok" in txt.stdout
+    assert "gm.ring" in txt.stdout

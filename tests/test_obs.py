@@ -234,7 +234,34 @@ def test_summary_one_screen(fitted_model):
     assert "halo_factor" in s and "pad_waste" in s
     assert "events:" in s
     assert "resources:" in s  # watermark line (ISSUE 6)
+    assert "live-metrics:" not in s  # only rendered when exporting
     assert len(s.splitlines()) <= 9  # one screen, not a dump
+
+
+def test_summary_live_metrics_line(tmp_path, monkeypatch):
+    """ISSUE 16: a fit run with the export plane attached says WHERE
+    the live metrics went — one extra summary line, still one screen."""
+    from sklearn.datasets import make_blobs
+
+    snap = tmp_path / "snap.jsonl"
+    monkeypatch.setenv("PYPARDIS_METRICS_SNAPSHOT", str(snap))
+    monkeypatch.setenv("PYPARDIS_METRICS_SNAPSHOT_S", "0.1")
+    X, _ = make_blobs(
+        n_samples=400, centers=4, n_features=4, cluster_std=0.3,
+        random_state=0,
+    )
+    m = DBSCAN(eps=0.4, min_samples=5, block=64).fit(X)
+    s = m.summary()
+    assert "live-metrics:" in s
+    assert str(snap) in s
+    assert len(s.splitlines()) <= 10  # the one extra line, no more
+    # the stream really was written, and its lines parse
+    lines = [ln for ln in snap.read_text().splitlines() if ln]
+    assert lines
+    assert all(
+        json.loads(ln)["schema"] == "pypardis_tpu/metrics_snapshot@1"
+        for ln in lines
+    )
 
 
 def test_report_compute_and_perf_contract_sections(fitted_model):

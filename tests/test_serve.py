@@ -191,6 +191,35 @@ def test_engine_queue_coalesces_and_reports(fitted, queries):
     assert stats["batch_fill"] <= 1.0
 
 
+def test_serving_stats_memory_bounded_under_sustained_traffic(fitted):
+    """ISSUE 16: the latency structure is a fixed-size histogram — 10x
+    the requests must not grow it by a byte (the old deque grew with
+    every request until its cap, and percentiles scanned it)."""
+    m, _X = fitted
+    engine = QueryEngine(
+        build_index(m, leaves=2, block=32, qblock=32), backend="xla",
+        batch_capacity=64,
+    )
+    q = np.zeros((8, 2), dtype=np.float32)
+
+    def drive(requests):
+        for _ in range(requests):
+            engine.submit(q)
+            engine.drain()
+
+    drive(20)
+    before = engine._lat_hist.nbytes
+    drive(200)  # 10x the traffic
+    assert engine._lat_hist.nbytes == before
+    stats = engine.serving_stats()
+    assert stats["queries"] == 220 * 8
+    hist = stats["latency_hist"]
+    assert hist["schema"] == "pypardis_tpu/hist@1"
+    assert hist["count"] == 220
+    assert stats["p50_ms"] > 0 and stats["p99_ms"] >= stats["p50_ms"]
+    assert sum(c for _, c in hist["buckets"]) + hist["overflow"] == 220
+
+
 def test_engine_queue_is_bounded(fitted):
     m, _X = fitted
     engine = QueryEngine(
