@@ -21,17 +21,32 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-from benchdata import ari_vs_truth, make_blob_data  # noqa: E402
+from benchdata import (  # noqa: E402
+    ari_vs_truth, make_blob_data, make_embedding_data,
+)
 
 
 def main():
     n = int(os.environ.get("BENCH_N", 200_000))
     dim = int(os.environ.get("BENCH_DIM", 16))
     skew = os.environ.get("BENCH_SKEW") or None
-    # 16-D gaussian blobs with sigma=0.4: typical intra-cluster pair
-    # distance is ~sigma*sqrt(2*dim) ~ 2.26, so eps=2.4 recovers blobs.
-    eps, min_samples = 2.4, 10
-    X, truth = make_blob_data(n, dim, n_centers=32, std=0.4, skew=skew)
+    # BENCH_GEOM=embedding swaps the isotropic blobs for the low-rank
+    # + full-rank-noise embedding geometry (benchdata.
+    # make_embedding_data) — the BENCH_DIM axis rows at d in {64, 256,
+    # 1024} that the sketch prefilter targets.  eps=2.0 sits between
+    # the latent intra-cluster spread (~std*sqrt(2*latent_dim) ~ 1.4)
+    # and the thinned 8*std center separation at every benched dim.
+    geom = os.environ.get("BENCH_GEOM", "blob")
+    if geom == "embedding":
+        eps, min_samples = 2.0, 10
+        X, truth = make_embedding_data(n, dim)
+    else:
+        # 16-D gaussian blobs with sigma=0.4: typical intra-cluster
+        # pair distance is ~sigma*sqrt(2*dim) ~ 2.26, so eps=2.4
+        # recovers blobs.
+        eps, min_samples = 2.4, 10
+        X, truth = make_blob_data(n, dim, n_centers=32, std=0.4,
+                                  skew=skew)
 
     from pypardis_tpu import DBSCAN
 
@@ -136,7 +151,13 @@ def main():
         json.dumps(
             {
                 "metric": f"points_per_sec_per_chip_dbscan_{dim}d_{n}pts"
+                + ("_embed" if geom == "embedding" else "")
                 + (f"_{skew}" if skew else ""),
+                # The BENCH_DIM axis as first-class row fields (the
+                # d in {64, 256, 1024} sketch-prefilter sweep groups
+                # on these instead of parsing the metric name).
+                "dim": dim,
+                "geometry": geom,
                 "value": round(pts_per_sec_chip, 1),
                 "unit": "points/sec/chip",
                 "vs_baseline": round(pts_per_sec_chip / sk_pts_per_sec, 3),

@@ -247,6 +247,63 @@ def make_manifold_data(
     return X, assign
 
 
+def make_embedding_data(
+    n: int,
+    dim: int,
+    *,
+    latent_dim: int = 8,
+    n_centers: int = 24,
+    seed: int = 0,
+    spread: float = 10.0,
+    std: float = 0.35,
+    noise: float = 0.02,
+):
+    """High-d embedding-table stand-in for the sketch-prefilter axis
+    (``dim`` in {64, 256, 1024}); returns ``(X, truth)``.
+
+    Low-rank structure plus FULL-RANK ambient noise: cluster geometry
+    lives in a ``latent_dim``-dim random orthonormal subspace (like
+    :func:`make_manifold_data`) but the noise floor here is large
+    enough that every ambient axis carries variance — the regime where
+    axis-aligned tile boxes stop pruning (every per-axis gap is small)
+    while a k-dim sketch still classifies pairs decisively, i.e. the
+    workload the random-projection prefilter exists for.  Centers are
+    min-separation thinned exactly like :func:`make_manifold_data`, so
+    the generating assignment stays a valid oracle at the benchmark
+    eps.  Chunked generation, no n x dim float64 temps.
+    """
+    rng = np.random.default_rng(seed)
+    latent_dim = max(1, min(int(latent_dim), int(dim)))
+    basis = np.linalg.qr(
+        rng.normal(size=(dim, latent_dim))
+    )[0].T.astype(np.float32)  # (latent_dim, dim)
+    min_sep = 8.0 * std
+    picked = []
+    while len(picked) < n_centers:
+        cand = rng.uniform(-spread, spread, size=(4 * n_centers,
+                                                  latent_dim))
+        for c in cand:
+            if len(picked) >= n_centers:
+                break
+            if not picked or np.min(
+                np.linalg.norm(np.asarray(picked) - c, axis=1)
+            ) >= min_sep:
+                picked.append(c)
+    centers = np.asarray(picked, dtype=np.float32)
+    assign = rng.integers(0, n_centers, size=n, dtype=np.int32)
+    X = np.empty((n, dim), np.float32)
+    for s in range(0, n, _CHUNK):
+        e = min(s + _CHUNK, n)
+        latent = centers[assign[s:e]] + rng.normal(
+            size=(e - s, latent_dim)
+        ).astype(np.float32) * np.float32(std)
+        X[s:e] = latent @ basis
+        X[s:e] += (
+            rng.normal(size=(e - s, dim)) * noise
+        ).astype(np.float32)
+    return X, assign
+
+
 def make_separated_blob_data(
     n: int,
     dim: int,

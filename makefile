@@ -7,8 +7,8 @@ PY ?= python
 	partition-probe serve-probe live-probe ingest-probe \
 	global-morton-probe fault-probe bench-diff flight-check \
 	northstar northstar-smoke streammem-probe sort-probe \
-	kernel-probe sweep-probe tune-probe monitor monitor-probe \
-	demo clean
+	kernel-probe sweep-probe tune-probe sketch-probe monitor \
+	monitor-probe demo clean
 
 all: native test
 
@@ -64,7 +64,7 @@ bench:
 bench-smoke: lint partition-probe serve-probe live-probe ingest-probe \
 		global-morton-probe fault-probe bench-diff flight-check \
 		northstar-smoke kernel-probe sweep-probe tune-probe \
-		monitor-probe
+		sketch-probe monitor-probe
 	JAX_PLATFORMS=cpu BENCH_N=2000 BENCH_DIM=4 BENCH_REPS=1 \
 	BENCH_DEV_REPS=1 $(PY) bench.py \
 	| $(PY) scripts/bench_diff.py --annotate --baseline-dir . \
@@ -103,6 +103,19 @@ sweep-probe:
 # `TUNE_N=1000000 make tune-probe`.
 tune-probe:
 	$(PY) scripts/tune_probe.py \
+	| $(PY) scripts/bench_diff.py --annotate --baseline-dir . \
+	| $(PY) scripts/check_bench_json.py --require-diff
+
+# Sketch-prefilter probe (ISSUE 17): the random-projection certified
+# gate at d in {64, 512} — counts-pass wall sketch ON vs OFF with
+# byte parity per dim, six full fits (fused / KD / global_morton x
+# sketch auto/off) byte-compared, and the GM boundary-bytes invariant
+# (the sketch send gate can only shrink the ring).  Headline win gated
+# at SKETCH_MIN_WIN (1.25 on the CPU mesh); the schema'd sketch@1 row
+# rides the bench_diff cross-round gate.  Acceptance-scale run on TPU:
+# `SKETCH_N=65536 SKETCH_MIN_WIN=3 make sketch-probe`.
+sketch-probe:
+	$(PY) scripts/sketch_probe.py \
 	| $(PY) scripts/bench_diff.py --annotate --baseline-dir . \
 	| $(PY) scripts/check_bench_json.py --require-diff
 

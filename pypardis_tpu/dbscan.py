@@ -369,12 +369,18 @@ def _pad_and_run(
     # directly); the metric is normalized so callable specs share
     # hints with their string spellings.
     from .ops.distances import _norm_metric
+    from .ops.sketch import sketch_dims
     from .utils.budget import run_ladders
     from .utils.hints import dispatch_tag
 
+    # The resolved sketch k is part of the hint key: sketch-space tile
+    # boxes prune differently than full-d boxes, so a budget learned
+    # with the prefilter on must not seed a sketch-off extraction (and
+    # vice versa).
+    sketch_k = sketch_dims(k, _norm_metric(metric))
     budget_key = (
         dispatch_tag(cap // block), (k, cap), block, precision,
-        float(eps), _norm_metric(metric),
+        float(eps), _norm_metric(metric), sketch_k,
     )
 
     def ladder(be):
@@ -434,11 +440,15 @@ def _pad_and_run(
         "kernel_passes": int(passes),
         "kernel_tiles": tiles if tiles > 0 else max(1, cap // eff_block),
         "kernel_block": eff_block,
-        # Mixed-precision band telemetry (zeros off precision="mixed"):
-        # pairs whose fast-pass d^2 landed in the rescore band, and
-        # tile-pair visits re-run at high precision.
+        # Band telemetry (zeros off precision="mixed" and sketch):
+        # pairs whose fast-pass / sketch-gate d^2 landed in the rescore
+        # band, and tile-pair visits re-run at full precision.  With
+        # the sketch prefilter on, the columns are OWNED by the sketch
+        # pass (it replaces the mixed fast pass as the classifier).
         "band_pairs": int(band_pairs),
         "rescored_tiles": int(rescored),
+        # Resolved random-projection prefilter width (0 = off).
+        "sketch_k": int(sketch_k),
         # Layout-cache economy (route "pipeline_layout"): a warm repeat
         # fit reuses the sorted device arrays and ships nothing.
         "staged_bytes_reused": int(reused),
@@ -601,6 +611,7 @@ class DBSCAN:
         flight: Optional[str] = None,
         auto: bool = False,
         tune_corpus: Optional[str] = None,
+        sketch=None,
     ):
         # Auto-tuning (pypardis_tpu.tune): knobs the caller passed
         # explicitly are PINNED — the planner never overrides them;
@@ -629,6 +640,23 @@ class DBSCAN:
         env_dispatch = envreg.raw("PYPARDIS_DISPATCH")
         if env_dispatch and env_dispatch != "auto":
             self._tune_pinned["dispatch"] = env_dispatch
+        # Sketch prefilter knob (int k | "auto" | None).  Label-neutral
+        # for any k (certified gates + exact rescore), so it rides the
+        # PYPARDIS_SKETCH env token for the fit body exactly like the
+        # planned dispatch — no signature threading through the
+        # drivers.  An explicit value (or a non-"auto" env) pins it
+        # against the planner.
+        from .ops.sketch import check_sketch_spec
+
+        self.sketch = (
+            check_sketch_spec(sketch) if sketch is not None else None
+        )
+        if self.sketch is not None:
+            self._tune_pinned["sketch"] = self.sketch
+        else:
+            env_sketch = envreg.raw("PYPARDIS_SKETCH")
+            if env_sketch is not None and env_sketch != "auto":
+                self._tune_pinned["sketch"] = env_sketch
         self.auto = bool(auto)
         # Local corpus override for the auto-fit feedback loop (None
         # defers to PYPARDIS_TUNE_CORPUS / the default archive path).
@@ -834,9 +862,16 @@ class DBSCAN:
         # data, env, and corpus — a resumed auto fit re-plans the same
         # config or the fingerprint rejects it loudly.
         dispatch_token = None
+        sketch_token = None
         self._tune_stats = None
         if self.auto and len(points):
-            dispatch_token = self._plan_auto(points)
+            dispatch_token, sketch_token = self._plan_auto(points)
+        if self.sketch is not None and sketch_token is None:
+            # The constructor pin rides the same env token the planner
+            # uses — the kernels resolve PYPARDIS_SKETCH wherever a
+            # driver doesn't thread the knob explicitly.
+            sketch_token = envreg.raw("PYPARDIS_SKETCH", "")
+            os.environ["PYPARDIS_SKETCH"] = str(self.sketch)
         ckpt_path = resume or envreg.raw("PYPARDIS_CKPT")
         if ckpt_path:
             from .utils.jobstate import JobState, fit_meta
@@ -980,6 +1015,12 @@ class DBSCAN:
                     os.environ.pop("PYPARDIS_DISPATCH", None)
                 else:
                     os.environ["PYPARDIS_DISPATCH"] = prev
+            if sketch_token is not None:
+                # Same discipline for the sketch knob's env token.
+                if sketch_token == "":
+                    os.environ.pop("PYPARDIS_SKETCH", None)
+                else:
+                    os.environ["PYPARDIS_SKETCH"] = sketch_token
             if self._jobstate is not None:
                 # Persist any boundary state the cadence was still
                 # holding (a SIGKILL needs no help — every boundary
@@ -1847,6 +1888,7 @@ class DBSCAN:
                 "mode": self.mode,
                 "flight": self.flight,
                 "auto": self.auto,
+                "sketch": self.sketch,
             },
             n_points=len(self.labels_),
             n_dims=self._fit_info.get("n_dims", 0),
@@ -1901,13 +1943,14 @@ class DBSCAN:
         """Probe the input, harvest the corpus, plan the unpinned
         knobs, and apply the plan to this model's config.
 
-        Returns the previous ``PYPARDIS_DISPATCH`` value (``""`` for
-        unset) when the plan took over the dispatch knob — the caller
-        restores it after the fit — or ``None`` when dispatch was
-        user-pinned.  Every planned knob is label-safe, so the fit's
-        labels are byte-identical to the same explicit config by
-        construction; user-pinned knobs are never overridden
-        (:mod:`pypardis_tpu.tune.planner`).
+        Returns ``(dispatch_token, sketch_token)`` — the previous
+        ``PYPARDIS_DISPATCH`` / ``PYPARDIS_SKETCH`` values (``""`` for
+        unset) when the plan took the corresponding knob over — the
+        caller restores them after the fit — or ``None`` per knob when
+        it was user-pinned or unplanned.  Every planned knob is
+        label-safe, so the fit's labels are byte-identical to the same
+        explicit config by construction; user-pinned knobs are never
+        overridden (:mod:`pypardis_tpu.tune.planner`).
         """
         from .tune import harvest_corpus, plan_fit, probe_dataset
         from .tune.probe import candidate_blocks
@@ -1927,7 +1970,13 @@ class DBSCAN:
             points, float(self.eps), blocks=sorted(cand),
             devices=self._n_devices(),
         )
-        plan = plan_fit(probe, pinned, rows)
+        try:
+            from .ops.distances import _norm_metric
+
+            kmetric = _norm_metric(self.metric)
+        except ValueError:
+            kmetric = "other"
+        plan = plan_fit(probe, pinned, rows, metric=kmetric)
         cfg = plan.config
         self.block = int(cfg.get("block", self.block))
         if cfg.get("precision"):
@@ -1940,10 +1989,17 @@ class DBSCAN:
         if cfg.get("dispatch") and "dispatch" not in self._tune_pinned:
             token = envreg.raw("PYPARDIS_DISPATCH", "")
             os.environ["PYPARDIS_DISPATCH"] = str(cfg["dispatch"])
+        sketch_token = None
+        if cfg.get("sketch") is not None and (
+            "sketch" not in self._tune_pinned
+        ):
+            sketch_token = envreg.raw("PYPARDIS_SKETCH", "")
+            os.environ["PYPARDIS_SKETCH"] = str(cfg["sketch"])
         get_logger().info(
             "auto-tune plan: %s", "; ".join(
                 f"{k}={cfg.get(k)}" for k in
-                ("mode", "block", "precision", "merge", "dispatch")
+                ("mode", "block", "precision", "merge", "dispatch",
+                 "sketch")
             ),
         )
         self._tune_stats = {
@@ -1954,7 +2010,7 @@ class DBSCAN:
             "corpus_rows": len(rows),
             "predicted_phases": dict(plan.predicted),
         }
-        return token
+        return token, sketch_token
 
     def _tune_actual_phases(self) -> Dict[str, float]:
         """The fit's measured build/exchange/compute/merge seconds in

@@ -55,6 +55,11 @@ _SHARDING_KEYS = (
     "boundary_tile_bytes",
     "boundary_tile_caps",
     "sent_tiles",
+    # Sketch-prefiltered send set (ops.sketch): the full-d box-only
+    # twins of sent_tiles / boundary_tile_bytes — equal with sketch
+    # off, an upper bound (sent_tiles <= sent_tiles_box) with it on.
+    "sent_tiles_box",
+    "boundary_bytes_box",
     "ring_rounds",
     "fixpoint_rounds",
     # Streaming external sample-sort build (ISSUE 10): spill-bucket
@@ -200,6 +205,11 @@ def _compute_section(
             round(achieved / (peak / 3.0), 8) if peak > 0 else 0.0
         ),
         "precision_mode": mode,
+        # Resolved sketch-prefilter width of the fit's kernel passes
+        # (0 = off).  With sketch on, band_pairs/band_fraction below
+        # count the SKETCH gate's ambiguous pairs (the stats columns
+        # are shared with mixed precision — ops.sketch).
+        "sketch_k": int(metrics.get("sketch_k", 0) or 0),
         "band_pairs": band_pairs,
         "rescored_pairs": rescored_tiles * block * block,
         "band_fraction": (

@@ -249,6 +249,111 @@ def _tile_adjacency_mixed_t(xi, yj, eps2, c, row_valid, col_valid,
     return (d2 <= eps2) & col_valid[None, :], n_band, resc
 
 
+def _sketch_slab_t(pts, q):
+    """(nt, d, block) tiles x (d, k) projection → (nt, k+1, block)
+    sketch slabs: rows 0..k-1 the HIGHEST-precision projection
+    ``Q^T x``, row k the orthogonal-residual norm ``r = sqrt(|x|^2 -
+    |Q^T x|^2)`` (clamped at 0).  Each slab column depends only on its
+    own point column, so slabs computed by different drivers over
+    different stagings of the same points are interchangeable
+    classification evidence — the mu=0 frame discipline: no internal
+    recentring, the drivers' global centering is what keeps magnitudes
+    (and hence :func:`pypardis_tpu.ops.sketch.sketch_gate_band`)
+    small, and correctness never depends on it."""
+    proj = jax.lax.dot_general(
+        q, pts, (((0,), (1,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    ).transpose(1, 0, 2)
+    full = jnp.sum(pts * pts, axis=1, keepdims=True)
+    res = jnp.sqrt(jnp.maximum(
+        full - jnp.sum(proj * proj, axis=1, keepdims=True), 0.0
+    ))
+    return jnp.concatenate([proj, res], axis=1)
+
+
+def _global_nmax(pts, msk):
+    """Masked Euclidean norm maximum over a whole (nt, d, block) slab."""
+    n2 = jnp.sum(pts * pts, axis=1)
+    return jnp.sqrt(jnp.max(jnp.where(msk, n2, 0.0)))
+
+
+def _sketch_setup(pts, msk, sk, precision):
+    """Shared sketch-pass staging for one kernel invocation: the
+    (d, k) projection (a trace-time numpy constant — seeded, cached,
+    identical on every host), the (nt, k+1, block) slabs, and the
+    certified classification band at the slab's masked norm maximum.
+    Returns ``(slab, band)``."""
+    from .sketch import sketch_gate_band, sketch_matrix
+
+    d = pts.shape[1]
+    q, eta = sketch_matrix(d, sk)
+    slab = _sketch_slab_t(pts, jnp.asarray(q))
+    band = sketch_gate_band(
+        _global_nmax(pts, msk), d, sk, eta,
+        precision=precision, fast_exact=_fast_is_exact(),
+    )
+    return slab, band
+
+
+def _tile_adjacency_sketch_t(
+    xi, yj, si, sj, eps, eps2, band, c, row_valid, col_valid,
+    precision, mixed, collect_stats=True,
+):
+    """Sketch-prefiltered adjacency for one tile pair (euclidean only).
+
+    The (k+1)-dim slab distance ``t2`` LOWER-bounds the full-d ``d2``
+    and ``t2 + 4*ri*rj`` UPPER-bounds it (the residual vectors live in
+    the orthogonal complement of the sketch subspace, so they meet the
+    projected difference at right angles), with every float/
+    orthogonality defect absorbed into ``band``
+    (:func:`pypardis_tpu.ops.sketch.sketch_gate_band`).  Pairs outside
+    ``eps2 +- band`` therefore classify certifiably from the slab
+    alone; a tile containing an in-band valid pair rescores the WHOLE
+    tile with the unchanged full-d kernel arithmetic (the
+    ``precision='mixed'`` machinery when the caller runs mixed —
+    itself byte-identical to ``'high'``).  Non-rescored tiles take the
+    certified in-gate as adjacency.  Labels are byte-identical to the
+    unsketched pass for ANY k — the sketch only decides WHERE the
+    exact arithmetic runs, never what it concludes.
+
+    Returns ``(adj & col_valid, n_band_pairs, rescored)`` shaped like
+    :func:`_tile_adjacency_mixed_t` — the PAIR_STATS band columns are
+    reused wholesale: under sketch they count sketch-band pairs and
+    sketch-rescored tiles.
+    """
+    stat_mask = row_valid[:, None] & col_valid[None, :]
+    t2 = _tile_d2_t(si, sj, "highest")
+    up = t2 + 4.0 * si[-1][:, None] * sj[-1][None, :]
+    sure_in = up <= eps2 - band
+    sure_out = t2 - band > eps2
+    ambig = ~(sure_in | sure_out) & stat_mask
+    if collect_stats:
+        n_band = jnp.sum(ambig, dtype=jnp.int32)
+        need = n_band > 0
+    else:
+        n_band = jnp.int32(0)
+        need = jnp.any(ambig)
+
+    def rescore():
+        if mixed:
+            adj, _nb, _rs = _tile_adjacency_mixed_t(
+                xi, yj, eps2, c, row_valid, col_valid,
+                collect_stats=False,
+            )
+            return adj
+        return (
+            _tile_adjacency_t(xi, yj, eps, "euclidean", precision)
+            & col_valid[None, :]
+        )
+
+    adj = jax.lax.cond(
+        need, rescore, lambda: sure_in & col_valid[None, :]
+    )
+    resc = need.astype(jnp.int32) if collect_stats else jnp.int32(0)
+    return adj, n_band, resc
+
+
 def _tiles_t(points, mask, block, layout):
     """Normalize to transposed tiles: (nt, d, block) + (nt, block) mask."""
     if layout not in ("nd", "dn"):
@@ -662,6 +767,7 @@ def pair_dispatch_enabled(nt: int | None = None) -> bool:
 
 def xla_pair_list(
     points, mask, eps, block: int, layout: str, budget: int | None = None,
+    sketch: int = 0, precision: str = "high",
 ):
     """Live tile-pair list sized to the XLA kernels' OWN tile grid
     (``nt = n / block``) — the twin of
@@ -673,14 +779,33 @@ def xla_pair_list(
     budget])`` with the usual overflow contract: ``total > budget``
     means pairs were dropped and results built from the list are
     INVALID — the drivers' ladder retries with the exact total.
+
+    ``sketch`` (a RESOLVED k — callers resolve the spec once): extract
+    over SKETCH-space tile boxes at the widened gate ``sqrt(eps^2 +
+    band)`` instead of full-d boxes.  At high d axis-aligned full-d
+    boxes go useless (every pair "live"); the (k+1)-dim slab boxes
+    stay tight.  Soundness: a pair with kernel ``d2 <= eps^2`` has
+    slab distance ``t2 <= eps^2 + band`` (the gate-band certification
+    run in reverse), so its boxes lie within the widened gate — a
+    pruned pair provably contributes nothing, the same argument the
+    full-d extraction rides.  ``precision`` only sizes the band (the
+    ``default``-precision kernel needs the wider one).
     """
     layout = _norm_layout(layout)
     nt, pts, msk = _tiles_t(points, mask, block, layout)
-    lo, hi = tile_bounds(pts, msk)
     if budget is None:
         budget = default_pair_budget(nt)
     budget = min(budget, nt * nt)
-    rows, cols, total = live_tile_pairs(lo, hi, eps, budget=budget)
+    if sketch:
+        slab, sband = _sketch_setup(pts, msk, sketch, precision)
+        slo, shi = tile_bounds(slab, msk)
+        eps_gate = jnp.sqrt(jnp.float32(eps) ** 2 + sband)
+        rows, cols, total = live_tile_pairs(
+            slo, shi, eps_gate, budget=budget
+        )
+    else:
+        lo, hi = tile_bounds(pts, msk)
+        rows, cols, total = live_tile_pairs(lo, hi, eps, budget=budget)
     return (rows, cols), jnp.stack([total, jnp.int32(budget)])
 
 
@@ -746,14 +871,18 @@ def _pair_scan_chunks(pairs, nt, per_pair, fold, identity, block):
 
 def _counts_over_pairs(
     pts, msk, lo, hi, pairs, eps, eps2, rt, metric, precision, mixed,
+    slab=None, band=None,
 ):
     """Counts pass driven by a compacted pair list — the XLA analogue
     of the Pallas kernels' pair-list grid.  Padding entries carry row
     ``nt`` and rows past ``rt`` (the owner-computes row restriction)
     skip outright, so the MXU/VPU never visits a pair the boxes
     already ruled out.  Integer adds commute, so counts are
-    byte-identical to the dense scan's.  Returns ``(counts[:rt*block],
-    (2,) band stats)``."""
+    byte-identical to the dense scan's.  ``slab``/``band``: the sketch
+    prefilter's (nt, k+1, block) slabs and certified band — listed
+    pairs then classify in sketch space and only in-band tiles run the
+    full-d arithmetic (:func:`_tile_adjacency_sketch_t`).  Returns
+    ``(counts[:rt*block], (2,) band stats)``."""
     nt, _d, block = pts.shape
     rows, cols = pairs
     centers = 0.5 * (lo + hi)
@@ -766,7 +895,12 @@ def _counts_over_pairs(
         cc = jnp.minimum(c, nt - 1)
         xi, mi = pts[rr], msk[rr]
         yj, mj = pts[cc], msk[cc]
-        if mixed:
+        if slab is not None:
+            adj, n_band, resc = _tile_adjacency_sketch_t(
+                xi, yj, slab[rr], slab[cc], eps, eps2, band,
+                centers[rr][:, None], mi, mj, precision, mixed,
+            )
+        elif mixed:
             adj, n_band, resc = _tile_adjacency_mixed_t(
                 xi, yj, eps2, centers[rr][:, None], mi, mj,
             )
@@ -788,7 +922,7 @@ def _counts_over_pairs(
 
 def _minlab_over_pairs(
     pts, smsk, lab, row_lo, row_hi, pairs, eps, eps2, owned_tiles,
-    metric, precision, mixed,
+    metric, precision, mixed, slab=None, band=None,
 ):
     """Min-label pass over a compacted pair list (see
     :func:`_counts_over_pairs`; min accumulation commutes too).
@@ -809,7 +943,13 @@ def _minlab_over_pairs(
         cc = jnp.minimum(c, nt - 1)
         xi = pts[rr]
         yj, mj, lj = pts[cc], smsk[cc], lab[cc]
-        if mixed:
+        if slab is not None:
+            adj, n_band, resc = _tile_adjacency_sketch_t(
+                xi, yj, slab[rr], slab[cc], eps, eps2, band,
+                centers[rr][:, None], jnp.ones((block,), bool), mj,
+                precision, mixed, collect_stats=False,
+            )
+        elif mixed:
             adj, n_band, resc = _tile_adjacency_mixed_t(
                 xi, yj, eps2, centers[rr][:, None],
                 jnp.ones((block,), bool), mj, collect_stats=False,
@@ -832,7 +972,9 @@ def _minlab_over_pairs(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("metric", "block", "precision", "layout", "row_tiles"),
+    static_argnames=(
+        "metric", "block", "precision", "layout", "row_tiles", "sketch",
+    ),
 )
 def neighbor_counts(
     points: jnp.ndarray,
@@ -844,6 +986,7 @@ def neighbor_counts(
     layout: str = "nd",
     row_tiles: int | None = None,
     pairs=None,
+    sketch: int | str | None = None,
 ) -> jnp.ndarray:
     """Per-point count of valid points within eps (self included).
 
@@ -871,6 +1014,14 @@ def neighbor_counts(
     slots occupy the slab prefix, and their counts need halo columns
     as evidence without ever counting the halo rows themselves.
 
+    ``sketch``: the random-projection prefilter
+    (:mod:`pypardis_tpu.ops.sketch`) — ``None`` resolves
+    ``PYPARDIS_SKETCH`` at TRACE time, an int pins k (0 disables).
+    When active the return widens to ``(counts, band_stats)`` exactly
+    like ``mixed`` (the band columns then count SKETCH-band pairs /
+    rescored tiles); counts stay byte-identical to the unsketched
+    pass for any k.
+
     With ``precision="mixed"`` the return widens to ``(counts,
     band_stats)`` — band_stats a (2,) int32 ``[band_pairs,
     rescored_tiles]`` from the banded single-bf16-pass classification
@@ -878,6 +1029,7 @@ def neighbor_counts(
     ``precision="high"``.
     """
     from .precision import norm_precision_mode
+    from .sketch import resolve_sketch, sketch_dims
 
     metric = _norm_metric(metric)
     layout = _norm_layout(layout)
@@ -888,29 +1040,56 @@ def neighbor_counts(
             "banded pass is a matmul discipline); use 'high'/'highest'"
         )
     nt, pts, msk = _tiles_t(points, mask, block, layout)
+    d = pts.shape[1]
+    sk = (
+        sketch_dims(d, metric) if sketch is None
+        else resolve_sketch(sketch, d, metric)
+    )
     lo, hi = tile_bounds(pts, msk)
     rt = nt if row_tiles is None else min(row_tiles, nt)
     eps2 = jnp.float32(eps) ** 2
+    banded = mixed or sk > 0
+    if sk:
+        slab, sband = _sketch_setup(pts, msk, sk, precision)
+    else:
+        slab = sband = None
 
     if pairs is not None:
         counts, band = _counts_over_pairs(
             pts, msk, lo, hi, pairs, eps, eps2, rt, metric, precision,
-            mixed,
+            mixed, slab=slab, band=sband,
         )
         counts = jnp.where(mask[: rt * block], counts, 0)
-        if not mixed:
+        if not banded:
             return counts
         return counts, band
 
-    def row_tile(xi, mi, lo_i, hi_i):
+    if sk:
+        slo, shi = tile_bounds(slab, msk)
+        eps_gate = jnp.sqrt(eps2 + sband)
+
+    def row_tile(xi, mi, lo_i, hi_i, si=None, slo_i=None, shi_i=None):
         skip = tile_skip_mask(lo_i, hi_i, lo, hi, eps, metric)
+        if sk:
+            # Sketch-space boxes prune independently of the full-d
+            # boxes (each test is sound alone — a live pair has
+            # t2 <= eps2 + band, so its slab boxes lie within the
+            # widened gate); the AND is strictly tighter.
+            skip = skip | tile_skip_mask(
+                slo_i, shi_i, slo, shi, eps_gate, "euclidean"
+            )
         ctr = (0.5 * (lo_i + hi_i))[:, None]
 
         def col_step(carry, jc):
             def compute(c):
                 a, bp, rs = c
                 yj, mj = pts[jc], msk[jc]
-                if mixed:
+                if sk:
+                    adj, n_band, resc = _tile_adjacency_sketch_t(
+                        xi, yj, si, slab[jc], eps, eps2, sband, ctr,
+                        mi, mj, precision, mixed,
+                    )
+                elif mixed:
                     adj, n_band, resc = _tile_adjacency_mixed_t(
                         xi, yj, eps2, ctr, mi, mj,
                     )
@@ -931,11 +1110,12 @@ def neighbor_counts(
         (counts, bp, rs), _ = jax.lax.scan(col_step, acc0, jnp.arange(nt))
         return jnp.where(mi, counts, 0), bp, rs
 
-    counts, bps, rss = jax.lax.map(
-        lambda args: row_tile(*args), (pts[:rt], msk[:rt], lo[:rt], hi[:rt])
-    )
+    ops = (pts[:rt], msk[:rt], lo[:rt], hi[:rt])
+    if sk:
+        ops = ops + (slab[:rt], slo[:rt], shi[:rt])
+    counts, bps, rss = jax.lax.map(lambda args: row_tile(*args), ops)
     counts = counts.reshape(-1)
-    if not mixed:
+    if not banded:
         return counts
     return counts, jnp.stack([jnp.sum(bps), jnp.sum(rss)])
 
@@ -943,7 +1123,7 @@ def neighbor_counts(
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "metric", "block", "precision", "layout", "owned_tiles",
+        "metric", "block", "precision", "layout", "owned_tiles", "sketch",
     ),
 )
 def min_neighbor_label(
@@ -958,6 +1138,7 @@ def min_neighbor_label(
     layout: str = "nd",
     owned_tiles: int | None = None,
     pairs=None,
+    sketch: int | str | None = None,
 ) -> jnp.ndarray:
     """Per-point min label over eps-neighbors drawn from ``src_mask``.
 
@@ -981,11 +1162,17 @@ def min_neighbor_label(
     :func:`neighbor_counts`); the same ``owned_tiles`` skip applies per
     listed entry, so callers share ONE unfiltered list across passes.
 
+    ``sketch``: the random-projection prefilter — same resolution and
+    widened-return contract as :func:`neighbor_counts` (propagation
+    passes skip the band bookkeeping exactly like ``mixed``; the
+    returned stats row is zeros).
+
     With ``precision="mixed"`` the return widens to ``(best,
     band_stats)`` — see :func:`neighbor_counts`; labels are
     byte-identical to ``precision="high"``.
     """
     from .precision import norm_precision_mode
+    from .sketch import resolve_sketch, sketch_dims
 
     metric = _norm_metric(metric)
     layout = _norm_layout(layout)
@@ -996,6 +1183,11 @@ def min_neighbor_label(
             "banded pass is a matmul discipline); use 'high'/'highest'"
         )
     nt, pts, smsk = _tiles_t(points, src_mask, block, layout)
+    d = pts.shape[1]
+    sk = (
+        sketch_dims(d, metric) if sketch is None
+        else resolve_sketch(sketch, d, metric)
+    )
     lab = labels.reshape(nt, block)
     lo, hi = tile_bounds(pts, smsk)
     if row_mask is None:
@@ -1007,18 +1199,36 @@ def min_neighbor_label(
     row_lo, row_hi = tile_bounds(pts, rmsk)
     col_ids = jnp.arange(nt, dtype=jnp.int32)
     eps2 = jnp.float32(eps) ** 2
+    banded = mixed or sk > 0
+    if sk:
+        # Band norm bound over rows AND sources: a tight row/source
+        # mask must not shrink the certified band below the float
+        # error of the other side's highest-norm point.
+        slab, sband = _sketch_setup(pts, smsk | rmsk, sk, precision)
+    else:
+        slab = sband = None
 
     if pairs is not None:
         best, band = _minlab_over_pairs(
             pts, smsk, lab, row_lo, row_hi, pairs, eps, eps2,
             owned_tiles, metric, precision, mixed,
+            slab=slab, band=sband,
         )
-        if not mixed:
+        if not banded:
             return best
         return best, band
 
-    def row_tile(ri, xi, mi, lo_i, hi_i):
+    if sk:
+        slo, shi = tile_bounds(slab, smsk)
+        srow_lo, srow_hi = tile_bounds(slab, rmsk)
+        eps_gate = jnp.sqrt(eps2 + sband)
+
+    def row_tile(ri, xi, mi, lo_i, hi_i, si=None, slo_i=None, shi_i=None):
         skip = tile_skip_mask(lo_i, hi_i, lo, hi, eps, metric)
+        if sk:
+            skip = skip | tile_skip_mask(
+                slo_i, shi_i, slo, shi, eps_gate, "euclidean"
+            )
         if owned_tiles is not None:
             skip = skip | ((ri >= owned_tiles) & (col_ids >= owned_tiles))
         ctr = (0.5 * (lo_i + hi_i))[:, None]
@@ -1027,7 +1237,12 @@ def min_neighbor_label(
             def compute(c):
                 a, bp, rs = c
                 yj, mj, lj = pts[jc], smsk[jc], lab[jc]
-                if mixed:
+                if sk:
+                    adj, n_band, resc = _tile_adjacency_sketch_t(
+                        xi, yj, si, slab[jc], eps, eps2, sband, ctr,
+                        mi, mj, precision, mixed, collect_stats=False,
+                    )
+                elif mixed:
                     # Propagation passes skip the band bookkeeping —
                     # stats are deterministic per pass and the counts
                     # pass already measured them (on lossy backends
@@ -1055,12 +1270,12 @@ def min_neighbor_label(
         (best, bp, rs), _ = jax.lax.scan(col_step, acc0, jnp.arange(nt))
         return best, bp, rs
 
-    best, bps, rss = jax.lax.map(
-        lambda args: row_tile(*args),
-        (jnp.arange(nt, dtype=jnp.int32), pts, rmsk, row_lo, row_hi),
-    )
+    ops = (jnp.arange(nt, dtype=jnp.int32), pts, rmsk, row_lo, row_hi)
+    if sk:
+        ops = ops + (slab, srow_lo, srow_hi)
+    best, bps, rss = jax.lax.map(lambda args: row_tile(*args), ops)
     best = best.reshape(-1)
-    if not mixed:
+    if not banded:
         return best
     return best, jnp.stack([jnp.sum(bps), jnp.sum(rss)])
 

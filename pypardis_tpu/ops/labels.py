@@ -39,17 +39,32 @@ def _band_zeros():
     return jnp.zeros(2, jnp.int32)
 
 
-def _split_band(out, mixed: bool):
+def _split_band(out, banded: bool):
     """Normalize a kernel result to ``(result, band_stats)``.
 
     The kernel entry points return ``(result, (2,) int32)`` under
-    ``precision="mixed"`` and the bare result otherwise; every
-    band-stats consumer in this module goes through this one helper so
-    the convention cannot be half-applied.
+    ``precision="mixed"`` OR an active sketch prefilter and the bare
+    result otherwise; every band-stats consumer in this module goes
+    through this one helper so the convention cannot be half-applied.
     """
-    if mixed:
+    if banded:
         return out
     return out, _band_zeros()
+
+
+def _resolve_sketch(sketch, d: int, metric) -> int:
+    """The labels-layer sketch resolution: ``None`` defers to the
+    ``PYPARDIS_SKETCH`` trace-time policy
+    (:func:`pypardis_tpu.ops.sketch.sketch_dims` — the dispatch-knob
+    discipline: baked into compiled programs, a flip needs
+    ``jax.clear_caches()``); anything else is a pinned spec."""
+    from .distances import _norm_metric
+    from .sketch import resolve_sketch, sketch_dims
+
+    m = _norm_metric(metric)
+    if sketch is None:
+        return sketch_dims(d, m)
+    return resolve_sketch(sketch, d, m)
 
 
 def pair_dispatch(metric, nt: int | None = None) -> bool:
@@ -196,6 +211,7 @@ def dbscan_fixed_size(
     backend: str = "auto",
     layout: str = "nd",
     pair_budget: int | None = None,
+    sketch: int | str | None = None,
 ):
     """Validating entry point for :func:`_dbscan_fixed_size_jit` (the
     jitted body, where ``eps`` may be a tracer and cannot be checked).
@@ -206,14 +222,16 @@ def dbscan_fixed_size(
     from ..utils.validate import (
         check_kernel_backend, check_precision, validate_params,
     )
+    from .sketch import check_sketch_spec
 
     validate_params(eps, min_samples)
     check_precision(precision)
     check_kernel_backend(backend)
+    sketch = check_sketch_spec(sketch)
     return _dbscan_fixed_size_jit(
         points, eps, min_samples, mask, metric=metric, block=block,
         max_rounds=max_rounds, precision=precision, backend=backend,
-        layout=layout, pair_budget=pair_budget,
+        layout=layout, pair_budget=pair_budget, sketch=sketch,
     )
 
 
@@ -228,7 +246,7 @@ dbscan_fixed_size.clear_cache = (  # type: ignore[attr-defined]
     jax.jit,
     static_argnames=(
         "metric", "block", "max_rounds", "precision", "backend", "layout",
-        "pair_budget",
+        "pair_budget", "sketch",
     ),
 )
 def _dbscan_fixed_size_jit(
@@ -243,6 +261,7 @@ def _dbscan_fixed_size_jit(
     backend: str = "auto",
     layout: str = "nd",
     pair_budget: int | None = None,
+    sketch: int | str | None = None,
 ):
     """DBSCAN over a fixed-capacity padded point set.
 
@@ -288,6 +307,12 @@ def _dbscan_fixed_size_jit(
     n = points.shape[0] if layout == "nd" else points.shape[1]
     d = points.shape[1] if layout == "nd" else points.shape[0]
     mixed = _is_mixed(precision)
+    # Sketch resolution happens ONCE per trace and the same k threads
+    # into the pair extraction and every pass — a half-sketched program
+    # (sketch boxes feeding an unsketched kernel) would still be
+    # correct but would silently lose the win.
+    sk = _resolve_sketch(sketch, d, metric)
+    banded = mixed or sk > 0
     if resolve_backend(backend, metric, n, block, d, precision) == "pallas":
         from .pallas_kernels import (
             _check_mosaic_tile,
@@ -314,15 +339,15 @@ def _dbscan_fixed_size_jit(
         # subset (core masks), so sharing is sound.
         pairs, pair_stats = kernel_pair_list(
             points, eps, mask, block, precision, layout,
-            budget=pair_budget,
+            budget=pair_budget, sketch=sk,
         )
         count_fn = functools.partial(
             neighbor_counts_pallas, block=block, precision=precision,
-            layout=layout, pairs=pairs,
+            layout=layout, pairs=pairs, sketch=sk,
         )
         minlab_fn = functools.partial(
             min_neighbor_label_pallas, block=block, precision=precision,
-            layout=layout, pairs=pairs,
+            layout=layout, pairs=pairs, sketch=sk,
         )
     elif pair_dispatch(metric, n // block):
         # Compacted dispatch (auto past PAIR_DISPATCH_MIN_TILES):
@@ -336,24 +361,25 @@ def _dbscan_fixed_size_jit(
         from .distances import xla_pair_list
 
         pairs, pair_stats = xla_pair_list(
-            points, mask, eps, block, layout, budget=pair_budget
+            points, mask, eps, block, layout, budget=pair_budget,
+            sketch=sk, precision=precision,
         )
         count_fn = functools.partial(
             neighbor_counts, metric=metric, block=block, precision=precision,
-            layout=layout, pairs=pairs,
+            layout=layout, pairs=pairs, sketch=sk,
         )
         minlab_fn = functools.partial(
             min_neighbor_label, metric=metric, block=block, precision=precision,
-            layout=layout, pairs=pairs,
+            layout=layout, pairs=pairs, sketch=sk,
         )
     else:
         count_fn = functools.partial(
             neighbor_counts, metric=metric, block=block, precision=precision,
-            layout=layout,
+            layout=layout, sketch=sk,
         )
         minlab_fn = functools.partial(
             min_neighbor_label, metric=metric, block=block, precision=precision,
-            layout=layout,
+            layout=layout, sketch=sk,
         )
         # Dense dispatch (PYPARDIS_DISPATCH=dense, or cityblock — its
         # boxes have no euclidean pair extraction).  Real [total,
@@ -381,7 +407,7 @@ def _dbscan_fixed_size_jit(
                 jnp.int32(0 if pair_budget is None else pair_budget),
             ]
         )
-    counts, band = _split_band(count_fn(points, eps, mask), mixed)
+    counts, band = _split_band(count_fn(points, eps, mask), banded)
     # A valid point always counts itself (distance 0 <= eps), but the
     # f32 |x|^2+|y|^2-2xy expansion can compute the self-pair a few ULP
     # above 0 and miss it once eps^2 sinks below that noise floor
@@ -394,7 +420,7 @@ def _dbscan_fixed_size_jit(
 
     def minlab_band(f):
         return _split_band(
-            minlab_fn(points, f, eps, core, row_mask=mask), mixed
+            minlab_fn(points, f, eps, core, row_mask=mask), banded
         )
 
     def cond(state):
@@ -495,6 +521,7 @@ def _oc_sorted_pairs(pairs, keep, nt):
 def oc_extract(
     points, eps, mask, *, owned, metric, block, precision, backend,
     layout: str = "nd", pair_budget: int | None = None,
+    sketch: int | str | None = None,
 ):
     """Shared pre-pass for the owner-computes kernels.
 
@@ -514,6 +541,7 @@ def oc_extract(
 
     n = points.shape[0] if layout == "nd" else points.shape[1]
     d = points.shape[1] if layout == "nd" else points.shape[0]
+    sk = _resolve_sketch(sketch, d, metric)
     kind = resolve_backend(backend, metric, n, block, d, precision)
     if kind == "pallas":
         from .pallas_kernels import (
@@ -529,14 +557,15 @@ def oc_extract(
         )
         pairs, stats = kernel_pair_list(
             points, eps, mask, block, precision, layout,
-            budget=pair_budget,
+            budget=pair_budget, sketch=sk,
         )
         return "pallas", pairs, stats
     if pair_dispatch(metric, n // block):
         from .distances import xla_pair_list
 
         pairs, stats = xla_pair_list(
-            points, mask, eps, block, layout, budget=pair_budget
+            points, mask, eps, block, layout, budget=pair_budget,
+            sketch=sk, precision=precision,
         )
         return "xla", pairs, stats
     from .pallas_kernels import _norm_precision_mode, effective_tile
@@ -563,11 +592,12 @@ def oc_extract(
 
 def oc_raw_counts(
     points, eps, mask, *, owned, metric, block, precision,
-    kind, pairs, layout: str = "nd",
+    kind, pairs, layout: str = "nd", sketch: int | str | None = None,
 ):
     """Owned-row RAW neighbor counts (no min_samples threshold):
     counts over owned ROWS x all columns, returned as ``(counts,
-    band_stats)`` uniformly (band zeros off ``precision="mixed"``).
+    band_stats)`` uniformly (band zeros off ``precision="mixed"`` and
+    off an active sketch).
 
     Split out of :func:`oc_counts` so the overlapped global-Morton
     route can SUM an owned-slab pass (dispatched before the boundary
@@ -576,13 +606,15 @@ def oc_raw_counts(
     commute, so the sum is byte-identical to the fused counts pass.
     """
     mixed = _is_mixed(precision)
+    n = points.shape[0] if layout == "nd" else points.shape[1]
+    d = points.shape[1] if layout == "nd" else points.shape[0]
+    sk = _resolve_sketch(sketch, d, metric)
+    banded = mixed or sk > 0
     if kind == "pallas":
         from .pallas_kernels import (
             _norm_precision_mode, _pallas_block, neighbor_counts_pallas,
         )
 
-        n = points.shape[0] if layout == "nd" else points.shape[1]
-        d = points.shape[1] if layout == "nd" else points.shape[0]
         pb = _pallas_block(block, n, d, _norm_precision_mode(precision))
         nt, ont = n // pb, owned // pb
         counts, band = _split_band(
@@ -590,8 +622,9 @@ def oc_raw_counts(
                 points, eps, mask, block=block, precision=precision,
                 layout=layout,
                 pairs=_oc_sorted_pairs(pairs, pairs[0] < ont, nt),
+                sketch=sk,
             ),
-            mixed,
+            banded,
         )
         counts = counts[:owned]
     else:
@@ -599,16 +632,16 @@ def oc_raw_counts(
             neighbor_counts(
                 points, eps, mask, metric=metric, block=block,
                 precision=precision, layout=layout,
-                row_tiles=owned // block, pairs=pairs,
+                row_tiles=owned // block, pairs=pairs, sketch=sk,
             ),
-            mixed,
+            banded,
         )
     return counts, band
 
 
 def oc_counts_delta(
     points, eps, mask, *, owned, metric, block, precision,
-    kind, pairs, layout: str = "nd",
+    kind, pairs, layout: str = "nd", sketch: int | str | None = None,
 ):
     """Owned ROWS x boundary COLUMNS (cols >= owned) counts — the
     boundary-evidence delta the overlapped global-Morton counts pass
@@ -624,12 +657,14 @@ def oc_counts_delta(
             "route off under dense dispatch"
         )
     n = points.shape[0] if layout == "nd" else points.shape[1]
+    d = points.shape[1] if layout == "nd" else points.shape[0]
+    sk = _resolve_sketch(sketch, d, metric)
+    banded = mixed or sk > 0
     if kind == "pallas":
         from .pallas_kernels import (
             _norm_precision_mode, _pallas_block, neighbor_counts_pallas,
         )
 
-        d = points.shape[1] if layout == "nd" else points.shape[0]
         pb = _pallas_block(block, n, d, _norm_precision_mode(precision))
         nt, ont = n // pb, owned // pb
         rows, cols = pairs
@@ -640,8 +675,9 @@ def oc_counts_delta(
                 pairs=_oc_sorted_pairs(
                     pairs, (rows < ont) & (cols >= ont), nt
                 ),
+                sketch=sk,
             ),
-            mixed,
+            banded,
         )
         counts = counts[:owned]
     else:
@@ -654,15 +690,16 @@ def oc_counts_delta(
                 pairs=_oc_sorted_pairs(
                     pairs, (rows < ont) & (cols >= ont), nt
                 ),
+                sketch=sk,
             ),
-            mixed,
+            banded,
         )
     return counts, band
 
 
 def oc_counts(
     points, eps, min_samples, mask, *, owned, metric, block, precision,
-    kind, pairs, layout: str = "nd",
+    kind, pairs, layout: str = "nd", sketch: int | str | None = None,
 ):
     """Owned-row core flags: counts over owned ROWS x all columns.
 
@@ -670,16 +707,16 @@ def oc_counts(
     halo columns contribute to the counts (exactness under the 2*eps
     halo) but no halo row is ever counted.  Returns (owned,) bool —
     widened to ``(core, band_stats)`` under ``precision="mixed"`` (the
-    kernel convention, see :func:`neighbor_counts`).
+    kernel convention, see :func:`neighbor_counts`; drivers use
+    :func:`oc_counts_banded`, which also surfaces the sketch
+    telemetry).
     """
     mixed = _is_mixed(precision)
-    counts, band = oc_raw_counts(
-        points, eps, mask, owned=owned, metric=metric, block=block,
-        precision=precision, kind=kind, pairs=pairs, layout=layout,
+    core, band = oc_counts_banded(
+        points, eps, min_samples, mask, owned=owned, metric=metric,
+        block=block, precision=precision, kind=kind, pairs=pairs,
+        layout=layout, sketch=sketch,
     )
-    # Same self-count clamp as dbscan_fixed_size: a valid point is
-    # always within eps of itself, whatever the f32 expansion says.
-    core = (jnp.maximum(counts, 1) >= min_samples) & mask[:owned]
     if mixed:
         return core, band
     return core
@@ -688,6 +725,7 @@ def oc_counts(
 def oc_propagate(
     points, eps, mask, core_all, *, owned, metric, block, precision,
     kind, pairs, max_rounds: int = 64, layout: str = "nd",
+    sketch: int | str | None = None,
 ):
     """Min-label propagation with halo slots as relay-only nodes.
 
@@ -704,12 +742,14 @@ def oc_propagate(
     """
     mixed = _is_mixed(precision)
     n = points.shape[0] if layout == "nd" else points.shape[1]
+    d = points.shape[1] if layout == "nd" else points.shape[0]
+    sk = _resolve_sketch(sketch, d, metric)
+    banded = mixed or sk > 0
     if kind == "pallas":
         from .pallas_kernels import (
             _norm_precision_mode, _pallas_block, min_neighbor_label_pallas,
         )
 
-        d = points.shape[1] if layout == "nd" else points.shape[0]
         pb = _pallas_block(block, n, d, _norm_precision_mode(precision))
         nt, ont = n // pb, owned // pb
         rows, cols = pairs
@@ -718,18 +758,18 @@ def oc_propagate(
         )
         minlab_fn = functools.partial(
             min_neighbor_label_pallas, block=block, precision=precision,
-            layout=layout, pairs=prop_pairs,
+            layout=layout, pairs=prop_pairs, sketch=sk,
         )
     else:
         minlab_fn = functools.partial(
             min_neighbor_label, metric=metric, block=block,
             precision=precision, layout=layout,
-            owned_tiles=owned // block, pairs=pairs,
+            owned_tiles=owned // block, pairs=pairs, sketch=sk,
         )
 
     def minlab_band(f):
         return _split_band(
-            minlab_fn(points, f, eps, core_all, row_mask=mask), mixed
+            minlab_fn(points, f, eps, core_all, row_mask=mask), banded
         )
 
     idx = jnp.arange(n, dtype=jnp.int32)
@@ -763,12 +803,24 @@ def oc_propagate(
     return labels, passes
 
 
-def oc_counts_banded(*args, **kw):
+def oc_counts_banded(
+    points, eps, min_samples, mask, *, owned, metric, block, precision,
+    kind, pairs, layout: str = "nd", sketch: int | str | None = None,
+):
     """:func:`oc_counts` with a UNIFORM ``(core, band_stats)`` return
     on every precision — the distributed drivers call this so their
-    pair-stats rows always carry the (possibly zero) band columns."""
-    out = oc_counts(*args, **kw)
-    return _split_band(out, _is_mixed(kw.get("precision", "high")))
+    pair-stats rows always carry the (possibly zero) band columns
+    (mixed-precision band telemetry, or sketch-band telemetry when the
+    prefilter is on)."""
+    counts, band = oc_raw_counts(
+        points, eps, mask, owned=owned, metric=metric, block=block,
+        precision=precision, kind=kind, pairs=pairs, layout=layout,
+        sketch=sketch,
+    )
+    # Same self-count clamp as dbscan_fixed_size: a valid point is
+    # always within eps of itself, whatever the f32 expansion says.
+    core = (jnp.maximum(counts, 1) >= min_samples) & mask[:owned]
+    return core, band
 
 
 def oc_propagate_banded(*args, **kw):
@@ -804,8 +856,15 @@ def _prepare_extract(points, eps, mask, *, block, precision, layout,
                      pair_budget=None):
     from .pallas_kernels import kernel_pair_list
 
+    # The host-stepped route pins sketch=0: it exists for 10M+-point
+    # LOW-d workloads (watchdog latency, not compute, is its wall) and
+    # its per-round programs are re-dispatched from host state, where a
+    # trace-time env flip mid-loop could desync the extraction's gate
+    # from the rounds' — the fused/distributed drivers carry the
+    # sketch instead.
     return kernel_pair_list(
-        points, eps, mask, block, precision, layout, budget=pair_budget
+        points, eps, mask, block, precision, layout, budget=pair_budget,
+        sketch=0,
     )
 
 
@@ -821,7 +880,7 @@ def _prepare_counts(points, eps, min_samples, mask, pairs, *, block,
     counts, band = _split_band(
         neighbor_counts_pallas(
             points, eps, mask, block=block, precision=precision,
-            layout=layout, pairs=pairs,
+            layout=layout, pairs=pairs, sketch=0,
         ),
         _is_mixed(precision),
     )
@@ -914,6 +973,7 @@ def dbscan_rounds_pallas(
             min_neighbor_label_pallas(
                 points, f, eps, core, block=block, precision=precision,
                 layout=layout, row_mask=mask, pairs=(rows, cols),
+                sketch=0,
             ),
             mixed,
         )
@@ -942,7 +1002,7 @@ def dbscan_border_pallas(
     return _split_band(
         min_neighbor_label_pallas(
             points, f, eps, core, block=block, precision=precision,
-            layout=layout, row_mask=mask, pairs=(rows, cols),
+            layout=layout, row_mask=mask, pairs=(rows, cols), sketch=0,
         ),
         _is_mixed(precision),
     )

@@ -308,6 +308,130 @@ def _minlab_pairs_kernel(rows_ref, cols_ref, eps2_ref, c_ref, x_ref, y_ref,
             ))
 
 
+def _sketch_gates(sx, sy, k, eps2, band, valid):
+    """Sketch-space classification for one Mosaic tile pair.
+
+    ``sx``/``sy``: (skp, block) slab blocks — rows 0..k-1 the
+    projection, row k the orthogonal-residual norm, rows past k zero
+    padding (inert in every sum).  The slab distance ``t2`` (source x
+    output orientation, matching the kernels' dot) LOWER-bounds the
+    full-d d2 and ``t2 + 4*ri*rj`` UPPER-bounds it; ``band`` absorbs
+    every float/orthogonality defect
+    (:func:`pypardis_tpu.ops.sketch.sketch_gate_band`).  Returns
+    ``(sure_in, n_band, need)`` — ``sure_in`` the certified in-gate
+    adjacency for tiles that skip the rescore, ``need`` whether any
+    valid pair landed in the band (the whole tile then reruns the
+    full-d arithmetic).  HIGHEST-precision dot: k is small, so the
+    exact-f32 passes are cheap relative to the (d+2) rescore they
+    replace.
+    """
+    sxx = jnp.sum(sx * sx, axis=0, keepdims=True)  # (1, block)
+    syy = jnp.sum(sy * sy, axis=0, keepdims=True)
+    t2 = (
+        jnp.transpose(syy, (1, 0)) + sxx - 2.0 * _dot_t(sy, sx, "highest")
+    )
+    up = t2 + 4.0 * sy[k][:, None] * sx[k][None, :]
+    sure_in = up <= eps2 - band
+    sure_out = t2 - band > eps2
+    ambig = (~(sure_in | sure_out)) & valid
+    n_band = jnp.sum(ambig, dtype=jnp.int32)
+    return sure_in, n_band, n_band > 0
+
+
+def _count_pairs_sketch_kernel(
+    rows_ref, cols_ref, eps2_ref, c_ref, x_ref, y_ref, sx_ref, sy_ref,
+    m_ref, out_ref, stats_ref, *, mode, nt, k,
+):
+    """Sketch-prefiltered twin of :func:`_count_pairs_kernel`: the
+    (k+1)-row slab blocks classify every pair against ``eps2 +- band``
+    (both prefetched — ``eps2_ref`` is (2,) ``[eps2, band]`` here) and
+    only a tile with an in-band valid pair runs the full-d augmented
+    dot; certified gate verdicts are byte-identical to that dot's, so
+    counts match the unsketched kernel exactly.  ``mode="mixed"``
+    rescores at ``"high"`` — bitwise the mixed contract's output.
+    Stats slots 0/1 carry [sketch-band pairs, rescored tiles]."""
+    eps2 = eps2_ref[0]
+    band = eps2_ref[1]
+    c = c_ref[0]
+    real = rows_ref[pl.program_id(0)] < nt
+    first = _first_visit(rows_ref)
+    _stats_init(stats_ref, out_ref.shape[-1])
+    resc_mode = "high" if mode == "mixed" else mode
+
+    @pl.when(real & first)
+    def _():
+        out_ref[0] = jnp.zeros_like(out_ref[0])
+
+    @pl.when(real)
+    def _():
+        valid_col = jnp.transpose(m_ref[0], (1, 0)) > 0
+        sure_in, n_band, need = _sketch_gates(
+            sx_ref[...], sy_ref[...], k, eps2, band, valid_col
+        )
+        _stats_add(
+            stats_ref, out_ref.shape[-1], n_band, need.astype(jnp.int32)
+        )
+
+        def emit(adj):
+            out_ref[0] += jnp.sum(
+                (adj & valid_col).astype(jnp.int32), axis=0, keepdims=True
+            )
+
+        # The full-d dot only RUNS for tiles with an in-band pair — a
+        # classified tile costs one k-dim HIGHEST dot, not a (d+2) one.
+        @pl.when(need)
+        def _():
+            emit(_dot_t(
+                _aug_src(y_ref[...], c), _aug_out(x_ref[...], c), resc_mode
+            ) <= eps2)
+
+        @pl.when(~need)
+        def _():
+            emit(sure_in)
+
+
+def _minlab_pairs_sketch_kernel(
+    rows_ref, cols_ref, eps2_ref, c_ref, x_ref, y_ref, sx_ref, sy_ref,
+    lab_ref, out_ref, *, mode, nt, k,
+):
+    """Sketch-prefiltered twin of :func:`_minlab_pairs_kernel` (no
+    stats output — the propagation discipline: the counts kernel
+    already measured them; the gate here only routes the rescore)."""
+    eps2 = eps2_ref[0]
+    band = eps2_ref[1]
+    c = c_ref[0]
+    real = rows_ref[pl.program_id(0)] < nt
+    first = _first_visit(rows_ref)
+    resc_mode = "high" if mode == "mixed" else mode
+
+    @pl.when(real & first)
+    def _():
+        out_ref[0] = jnp.full_like(out_ref[0], _INT_INF)
+
+    @pl.when(real)
+    def _():
+        lab_col = jnp.transpose(lab_ref[0], (1, 0))
+        sure_in, _n_band, need = _sketch_gates(
+            sx_ref[...], sy_ref[...], k, eps2, band, lab_col != _INT_INF
+        )
+
+        def emit(adj):
+            cand = jnp.where(adj, lab_col, _INT_INF)
+            out_ref[0] = jnp.minimum(
+                out_ref[0], jnp.min(cand, axis=0, keepdims=True)
+            )
+
+        @pl.when(need)
+        def _():
+            emit(_dot_t(
+                _aug_src(y_ref[...], c), _aug_out(x_ref[...], c), resc_mode
+            ) <= eps2)
+
+        @pl.when(~need)
+        def _():
+            emit(sure_in)
+
+
 def _points_dn(points, layout):
     """The kernels' canonical (d, N) float32 operand layout.
 
@@ -381,9 +505,60 @@ def _centers_dn(pts_dn, mask, nt, block):
     return (0.5 * (lo + hi))[:, :, None]
 
 
+def _round8(v: int) -> int:
+    """Round up to the Mosaic f32 second-minor multiple (8)."""
+    return -(-int(v) // 8) * 8
+
+
+def _sketch_stage(pts_dn, mask, sk, mode):
+    """Stage the random-projection slab for the sketch kernels.
+
+    ``(d, N)`` coordinates → ``((skp, N) slab, band)``: rows 0..sk-1
+    the HIGHEST-precision projection ``Q^T x``, row sk the orthogonal
+    residual norm, rows past that zero padding up to ``skp =
+    round8(sk + 1)`` so the slab blocks satisfy Mosaic's f32
+    second-minor constraint (zero rows are inert in every slab sum).
+    ``band`` is the certified gate half-width
+    (:func:`pypardis_tpu.ops.sketch.sketch_gate_band`) at the masked
+    global norm maximum; ``fast_exact=False`` because the Pallas
+    ``"default"`` dot is single-pass bf16 on hardware (in interpret
+    mode this merely over-widens the band — extra rescores, never a
+    wrong verdict).
+    """
+    from .sketch import sketch_gate_band, sketch_matrix
+
+    d, n = pts_dn.shape
+    q, eta = sketch_matrix(d, sk)
+    proj = jax.lax.dot_general(
+        jnp.asarray(q), pts_dn, (((0,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    )
+    full = jnp.sum(pts_dn * pts_dn, axis=0, keepdims=True)
+    res = jnp.sqrt(jnp.maximum(
+        full - jnp.sum(proj * proj, axis=0, keepdims=True), 0.0
+    ))
+    skp = _round8(sk + 1)
+    parts = [proj, res]
+    if skp > sk + 1:
+        parts.append(jnp.zeros((skp - (sk + 1), n), jnp.float32))
+    slab = jnp.concatenate(parts, axis=0)
+    nmax = jnp.sqrt(jnp.max(jnp.where(mask, full[0], 0.0)))
+    band = sketch_gate_band(nmax, d, sk, eta, precision=mode,
+                            fast_exact=False)
+    return slab, band
+
+
 def _pallas_block(block: int, n: int, d: int, mode: str = "high") -> int:
     """Largest tile that keeps the fp32 distance tile plus operand
     blocks comfortably inside VMEM and divides n.
+
+    Deliberately sketch-independent: callers size pair lists and
+    owner-computes splits from ``(block, n, d, mode)`` alone, so the
+    grid must not shift when the sketch prefilter turns on.  The
+    sketch temps — two (skp <= 72, b) slab blocks and ~3 extra (b, b)
+    gate masks — fit the gap between the 32MB budget and Mosaic's
+    128MB VMEM at every admitted b.
 
     The default bf16_3x mode materializes more than the plain path: the
     hi/lo operand splits (four extra (d+2, b) blocks) and up to three
@@ -515,7 +690,7 @@ CHUNK_PAIRS = 48 * 1024
 
 
 def _pair_call(kernel, nt, d, block, n_extra_in, interpret, identity,
-               combine, band_stats=False):
+               combine, band_stats=False, sketch_dim=0):
     """Common pallas_call plumbing for the two pair-list kernels.
 
     Grid = one program per pair-list entry; the row/col tile index
@@ -528,6 +703,12 @@ def _pair_call(kernel, nt, d, block, n_extra_in, interpret, identity,
     / minimum).  Rows a chunk never visits hold uninitialized memory in
     its partial; the visited-rows mask keeps them out of the merge, and
     rows no chunk visits come back as ``identity``.
+
+    ``sketch_dim`` (the sketch-prefiltered kernels): inserts two
+    (sketch_dim, block) slab blocks after the coordinate tiles, indexed
+    by the same clamped row/col maps off a (sketch_dim, N) slab array
+    the caller appends to ``arrays`` between the coordinates and the
+    int32 blocks.
 
     ``band_stats`` (the ``mode="mixed"`` kernels): adds a second
     (1, 1, block) int32 output whose constant index map keeps the
@@ -571,7 +752,14 @@ def _pair_call(kernel, nt, d, block, n_extra_in, interpret, identity,
             pl.BlockSpec((d, block), rclamp2, memory_space=pltpu.VMEM),
             # source-side coordinate tile (cols), from the (d, N) array
             pl.BlockSpec((d, block), cclamp2, memory_space=pltpu.VMEM),
-        ] + [
+        ] + ([
+            # sketch slab tiles (rows then cols) from the (skp, N) slab
+            # array — same clamped column-block maps as the coordinates
+            pl.BlockSpec((sketch_dim, block), rclamp2,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((sketch_dim, block), cclamp2,
+                         memory_space=pltpu.VMEM),
+        ] if sketch_dim else []) + [
             # per-point int32 rows keyed by the col tile (labels/masks)
             pl.BlockSpec((1, 1, block), cclamp, memory_space=pltpu.VMEM)
         ] * n_extra_in
@@ -651,7 +839,7 @@ def _pair_call(kernel, nt, d, block, n_extra_in, interpret, identity,
 
 def kernel_pair_list(
     points, eps, mask, block: int, precision, layout: str,
-    budget: int | None = None, src_mask=None,
+    budget: int | None = None, src_mask=None, sketch: int = 0,
 ):
     """Live tile-pair list sized to the kernels' OWN tile grid.
 
@@ -664,6 +852,16 @@ def kernel_pair_list(
     ``mask``).  Returns ``(rows, cols), (2,) int32 [total, budget]``;
     ``total > budget`` means the list was truncated and results built
     from it are invalid (retry with ``budget >= total``).
+
+    ``sketch`` (a RESOLVED k, 0 = off): extract over (k+1)-dim slab
+    boxes at the widened gate ``sqrt(eps^2 + band)`` instead of full-d
+    boxes.  Sound standalone: ``d2 <= eps^2`` implies the slab distance
+    ``t2 <= eps^2 + band`` (projection contracts plus the certified
+    float band), so a slab box gap past the gate proves no in-eps pair
+    — and k+1 ~ 17..65 gap dims prune far better per byte than d=512
+    full-d boxes.  NEVER combine full-d and slab gaps additively
+    (each test is only sound alone); the list here uses the slab test
+    alone, which already subsumes most full-d pruning at high d.
     """
     from .distances import default_pair_budget, live_tile_pairs
 
@@ -671,23 +869,47 @@ def kernel_pair_list(
     pb = _pallas_block(block, n, d, _norm_precision_mode(precision))
     nt = n // pb
     pts_dn = _points_dn(points, layout)
-    lo, hi = _bounds_dn(pts_dn, mask, nt, pb)
+    gate = eps
+    if sketch:
+        band_mask = mask if src_mask is None else (mask | src_mask)
+        slab, band = _sketch_stage(
+            pts_dn, band_mask, sketch, _norm_precision_mode(precision)
+        )
+        gate = jnp.sqrt(jnp.asarray(eps, jnp.float32) ** 2 + band)
+        box_src = slab
+    else:
+        box_src = pts_dn
+    lo, hi = _bounds_dn(box_src, mask, nt, pb)
     if src_mask is None:
         lo_col, hi_col = None, None
     else:
-        lo_col, hi_col = _bounds_dn(pts_dn, src_mask, nt, pb)
+        lo_col, hi_col = _bounds_dn(box_src, src_mask, nt, pb)
     if budget is None:
         budget = default_pair_budget(nt)
     budget = min(budget, nt * nt)
     rows, cols, total = live_tile_pairs(
-        lo, hi, eps, lo_col, hi_col, budget=budget
+        lo, hi, gate, lo_col, hi_col, budget=budget
     )
     return (rows, cols), jnp.stack([total, jnp.int32(budget)])
 
 
+def _resolve_sketch_k(sketch, d):
+    """Resolve a sketch spec to a concrete k for the Pallas kernels
+    (Euclidean-only module, so the metric is fixed).  ``None`` defers
+    to the ``PYPARDIS_SKETCH`` env default at TRACE time — the
+    dispatch-knob precedent: the choice bakes into the compiled
+    program, flips need ``jax.clear_caches()``."""
+    from .sketch import resolve_sketch, sketch_dims
+
+    if sketch is None:
+        return sketch_dims(d, "euclidean")
+    return resolve_sketch(sketch, d, "euclidean")
+
+
 @functools.partial(
     jax.jit,
-    static_argnames=("block", "precision", "interpret", "layout"),
+    static_argnames=("block", "precision", "interpret", "layout",
+                     "sketch"),
 )
 def neighbor_counts_pallas(
     points: jnp.ndarray,
@@ -698,6 +920,7 @@ def neighbor_counts_pallas(
     interpret: bool = False,
     layout: str = "nd",
     pairs=None,
+    sketch: int | str | None = None,
 ) -> jnp.ndarray:
     """Pallas analogue of :func:`pypardis_tpu.ops.distances.neighbor_counts`
     (Euclidean only).
@@ -715,10 +938,19 @@ def neighbor_counts_pallas(
     rescored_tiles]``; counts byte-identical to ``precision="high"``
     (the banded-rescore contract, see
     :mod:`pypardis_tpu.ops.precision`).
+
+    ``sketch`` resolves like the dispatch knob (``None`` → env at
+    trace time, see :func:`_resolve_sketch_k`); a resolved ``k > 0``
+    also widens the return to ``(counts, band_stats)``, where the
+    stats now count SKETCH-band pairs and rescored tiles — counts stay
+    byte-identical to the unsketched pass (certified gates, exact
+    rescore).
     """
     n, d = _shape_nd(points, layout)
     mode = _norm_precision_mode(precision)
     mixed = mode == "mixed"
+    sk = _resolve_sketch_k(sketch, d)
+    banded = mixed or sk > 0
     block = _pallas_block(block, n, d, mode)
     _check_mosaic_tile(block, n, interpret)
     nt = n // block
@@ -728,7 +960,7 @@ def neighbor_counts_pallas(
     poison = None
     if pairs is None:
         pairs, stats = kernel_pair_list(
-            points, eps, mask, block, precision, layout
+            points, eps, mask, block, precision, layout, sketch=sk
         )
         poison = stats[0] > stats[1]
     rows, cols = pairs
@@ -739,23 +971,37 @@ def neighbor_counts_pallas(
     # clamped real blocks and skip compute).  No dump-block concats,
     # no masked copy, no tile-transposed copy: the kernel program
     # carries NO dataset-sized temps at all.
-    out = _pair_call(
-        functools.partial(_count_pairs_kernel, mode=mode, nt=nt),
-        nt, d, block, 1, interpret,
-        identity=0, combine=jnp.add, band_stats=mixed,
-    )(rows, cols, eps2, centers, pts_dn, pts_dn, mask_t.astype(jnp.int32))
-    counts, band = out if mixed else (out, None)
+    if sk:
+        slab, sband = _sketch_stage(pts_dn, mask, sk, mode)
+        kern = functools.partial(
+            _count_pairs_sketch_kernel, mode=mode, nt=nt, k=sk
+        )
+        out = _pair_call(
+            kern, nt, d, block, 1, interpret,
+            identity=0, combine=jnp.add, band_stats=True,
+            sketch_dim=slab.shape[0],
+        )(rows, cols, jnp.stack([eps2[0], sband]), centers,
+          pts_dn, pts_dn, slab, slab, mask_t.astype(jnp.int32))
+    else:
+        out = _pair_call(
+            functools.partial(_count_pairs_kernel, mode=mode, nt=nt),
+            nt, d, block, 1, interpret,
+            identity=0, combine=jnp.add, band_stats=mixed,
+        )(rows, cols, eps2, centers, pts_dn, pts_dn,
+          mask_t.astype(jnp.int32))
+    counts, band = out if banded else (out, None)
     counts = jnp.where(mask, counts[:nt].reshape(-1), 0)
     if poison is not None:
         counts = jnp.where(poison, -1, counts)
-    if mixed:
+    if banded:
         return counts, band
     return counts
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("block", "precision", "interpret", "layout"),
+    static_argnames=("block", "precision", "interpret", "layout",
+                     "sketch"),
 )
 def min_neighbor_label_pallas(
     points: jnp.ndarray,
@@ -768,6 +1014,7 @@ def min_neighbor_label_pallas(
     row_mask: jnp.ndarray | None = None,
     layout: str = "nd",
     pairs=None,
+    sketch: int | str | None = None,
 ) -> jnp.ndarray:
     """Pallas analogue of
     :func:`pypardis_tpu.ops.distances.min_neighbor_label` (Euclidean).
@@ -790,11 +1037,14 @@ def min_neighbor_label_pallas(
     :func:`neighbor_counts_pallas` — but the stats here are always
     zeros: band telemetry is deterministic per pass and measured once,
     by the counts kernel; this kernel's in-band test only gates its
-    own tile rescores.
+    own tile rescores.  A resolved ``sketch`` k > 0 widens the return
+    the same way (zeros — same discipline).
     """
     n, d = _shape_nd(points, layout)
     mode = _norm_precision_mode(precision)
     mixed = mode == "mixed"
+    sk = _resolve_sketch_k(sketch, d)
+    banded = mixed or sk > 0
     block = _pallas_block(block, n, d, mode)
     _check_mosaic_tile(block, n, interpret)
     nt = n // block
@@ -808,7 +1058,7 @@ def min_neighbor_label_pallas(
     if pairs is None:
         pairs, stats = kernel_pair_list(
             points, eps, rm_flat, block, precision, layout,
-            src_mask=src_mask,
+            src_mask=src_mask, sketch=sk,
         )
         poison = stats[0] > stats[1]
     rows, cols = pairs
@@ -823,15 +1073,30 @@ def min_neighbor_label_pallas(
     # No stats output on the propagation kernel: band stats come from
     # the counts pass (they are deterministic per pass); the minlab
     # kernel's in-band test only gates its rescore.
-    best = _pair_call(
-        functools.partial(_minlab_pairs_kernel, mode=mode, nt=nt),
-        nt, d, block, 1, interpret,
-        identity=_INT_INF, combine=jnp.minimum,
-    )(rows, cols, eps2, centers, pts_dn, pts_dn, labi)
+    if sk:
+        # Band norm bound over rows AND sources: a tight row_mask must
+        # not shrink the certified band below a high-norm src column's
+        # float error.
+        slab, sband = _sketch_stage(pts_dn, rm_flat | src_mask, sk, mode)
+        best = _pair_call(
+            functools.partial(
+                _minlab_pairs_sketch_kernel, mode=mode, nt=nt, k=sk
+            ),
+            nt, d, block, 1, interpret,
+            identity=_INT_INF, combine=jnp.minimum,
+            sketch_dim=slab.shape[0],
+        )(rows, cols, jnp.stack([eps2[0], sband]), centers,
+          pts_dn, pts_dn, slab, slab, labi)
+    else:
+        best = _pair_call(
+            functools.partial(_minlab_pairs_kernel, mode=mode, nt=nt),
+            nt, d, block, 1, interpret,
+            identity=_INT_INF, combine=jnp.minimum,
+        )(rows, cols, eps2, centers, pts_dn, pts_dn, labi)
     best = best[:nt].reshape(-1)
     if poison is not None:
         best = jnp.where(poison, jnp.iinfo(jnp.int32).min, best)
-    if mixed:
+    if banded:
         return best, jnp.zeros(2, jnp.int32)
     return best
 

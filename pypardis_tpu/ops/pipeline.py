@@ -367,14 +367,19 @@ def unpack_pipeline_result(packed):
     jax.jit,
     static_argnames=(
         "cap", "min_samples", "metric", "block", "precision", "backend",
-        "pair_budget",
+        "pair_budget", "sketch",
     ),
 )
 def _pipeline_cluster(
     xs, mask_k, owner, eps, *, cap, min_samples, metric, block, precision,
-    backend, pair_budget,
+    backend, pair_budget, sketch=None,
 ):
-    """Stage 2 (fused): fixed-size DBSCAN + unscatter + pack."""
+    """Stage 2 (fused): fixed-size DBSCAN + unscatter + pack.
+
+    ``sketch`` arrives RESOLVED (a concrete k or None-for-env) from
+    :func:`dbscan_device_pipeline` — resolving outside the jit keeps
+    the compiled-program key honest about which prefilter it baked in.
+    """
     roots_s, core_s, pair_stats = dbscan_fixed_size(
         xs,
         eps,
@@ -386,6 +391,7 @@ def _pipeline_cluster(
         backend=backend,
         layout="dn",
         pair_budget=pair_budget,
+        sketch=sketch,
     )
     return _pipeline_pack(roots_s, core_s, pair_stats, owner, cap=cap)
 
@@ -679,6 +685,7 @@ def dbscan_device_pipeline(
     pair_budget: int | None = None,
     layout_key=None,
     jobstate=None,
+    sketch: int | str | None = None,
 ):
     """points_t: (d, cap) float32, centered, zero-padded past ``n``
     (traced) — or a ZERO-ARG CALLABLE producing it, evaluated only
@@ -771,6 +778,19 @@ def dbscan_device_pipeline(
     obs_current().metrics.set(
         "pipeline.kernel_tiles", max(1, capk // _eff)
     )
+    # Resolve the sketch spec HERE, outside every jit: the knob becomes
+    # a static argument of the cluster program, so the compiled-program
+    # cache key says exactly which prefilter it carries (the env
+    # default resolves once per call, not once per trace).  The
+    # host-stepped route below ignores it — it pins sketch=0 (it
+    # exists for 10M+-point LOW-d workloads where the prefilter has
+    # nothing to amortize; see ops.labels._prepare_counts).
+    from .sketch import check_sketch_spec, resolve_sketch, sketch_dims
+
+    if sketch is None:
+        sk = sketch_dims(xs.shape[0], metric)
+    else:
+        sk = resolve_sketch(check_sketch_spec(sketch), xs.shape[0], metric)
     stepped = (
         capk >= STEP_THRESHOLD
         and resolve_backend(
@@ -796,6 +816,7 @@ def dbscan_device_pipeline(
             xs, mask_k, owner, eps,
             cap=cap, min_samples=min_samples, metric=metric, block=block,
             precision=precision, backend=backend, pair_budget=pair_budget,
+            sketch=sk,
         )
         # The bulk transfer IS the sync: execution faults surface here,
         # inside the retry scope, and the steady-state fit pays exactly

@@ -1,0 +1,349 @@
+"""Random-projection sketch prefilter for the high-d distance pass.
+
+At d = 256-1024 the exact distance pass is the wall again (the cost
+model's own ``pairs * B^2 * d`` term), and axis-aligned full-d tile
+boxes stop pruning: Morton order keys on the top-variance axes only,
+so at high d almost every tile pair is "live" by box gap.  This module
+supplies the same certified-classification pattern ``precision="mixed"``
+applies to *arithmetic* (:mod:`pypardis_tpu.ops.precision`), applied to
+*dimensionality*: a seeded k-dim sketch pass classifies every pair as
+definitely-out / definitely-in / in-band against ``eps^2 +- band``, and
+only in-band tiles rerun the unchanged full-d exact kernel — labels
+byte-identical to the unsketched pass by the same rescoring argument.
+
+The certified gate (what the kernels use)
+-----------------------------------------
+
+The Johnson-Lindenstrauss distortion bound (Achlioptas, JCSS 2003 —
+see :func:`jl_band`) is PROBABILISTIC, so it cannot certify byte
+parity.  The kernels instead use a deterministic split: draw an
+Achlioptas-style sparse +-1 matrix seeded by ``(d, k,
+PYPARDIS_SKETCH_SEED)``, orthonormalize it in float64 (QR), and keep
+
+* ``s(x) = Q^T x``            — the k-dim sketch coordinates,
+* ``r(x) = |x - Q s(x)|``     — the residual norm, stored as a
+  (k+1)-th slab row, recovered as ``sqrt(|x|^2 - |s|^2)``.
+
+With exactly orthonormal ``Q`` the residual is orthogonal to the
+sketch subspace, so for any pair::
+
+    t2 = |s(x) - s(y)|^2 + (r(x) - r(y))^2   <=  |x - y|^2
+                                             <=  t2 + 4 r(x) r(y)
+
+— ``t2`` (one (k+1)-dim squared distance over the slab) is a certified
+LOWER bound and ``t2 + 4 rx ry`` a certified UPPER bound.  The float32
+``Q`` is only near-orthonormal and the slab arithmetic rounds, so the
+gates carry a scalar halfwidth (:func:`sketch_gate_band`) following
+the ``band_halfwidth``/``exact_slack`` conventions of
+:mod:`pypardis_tpu.ops.precision`:
+
+* ``t2 - band > eps^2``              -> definitely-out,
+* ``t2 + 4 rx ry <= eps^2 - band``   -> definitely-in,
+* anything else                      -> in-band; the whole tile
+  rescores through the UNCHANGED exact kernel arithmetic.
+
+Because the gate brackets the exact kernel's own computed d^2 (the
+band folds the exact pass's arithmetic slack in), every non-rescored
+verdict equals the unsketched kernel's verdict and every rescored tile
+runs its bytes — labels are byte-identical for ANY k, which also makes
+a stale trace-time ``PYPARDIS_SKETCH`` read (the documented
+``PYPARDIS_DISPATCH`` semantics, see :mod:`pypardis_tpu.utils.envreg`)
+a telemetry-only hazard, never a correctness one.
+
+The same slab serves as a tighter tile box: sketch-space bounding
+boxes with the inflated gate threshold ``sqrt(eps^2 + band)`` give a
+SOUND pair prune (``d2 <= eps^2`` implies ``t2 <= eps^2 + band``
+implies the sketch box gap passes), replacing the useless full-d boxes
+in the pair-list extraction and tightening the global-Morton boundary
+ring (AND-composed with the full-d box test — each test is sound on
+its own).  Note the two tests must never be summed: ``t2`` and the
+full-d box gap both lower-bound the SAME distance, so their sum does
+not.
+
+Frames: the sketch transform is ``Q^T x`` with NO internal recentring
+— every array a kernel call compares (owned + halo/boundary slabs)
+sits in one staged coordinate frame, and a pointwise-deterministic
+transform keeps cross-shard sketch coordinates comparable.  The
+drivers' global recentring (which protects the ``|x|^2+|y|^2-2xy``
+expansion) is what keeps frame magnitudes — and hence the band —
+small; correctness never depends on it.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from ..utils import envreg
+from .precision import _BAND_SAFETY, band_halfwidth, exact_slack
+
+# k never exceeds this many sketch dimensions (past this the sketch
+# pass itself costs like a mid-d exact pass and the d//4 ratio below
+# has already flattened the win).
+SKETCH_MAX_K = 256
+# ... and never drops below this many (too few dims, everything lands
+# in band and the prefilter only adds overhead).
+SKETCH_MIN_K = 16
+
+
+def sketch_seed() -> int:
+    """The reproducible projection seed (``PYPARDIS_SKETCH_SEED``)."""
+    return int(envreg.raw("PYPARDIS_SKETCH_SEED", "1299721"))
+
+
+def sketch_delta() -> float:
+    """JL failure probability for the PREDICTIVE band
+    (``PYPARDIS_SKETCH_DELTA``)."""
+    return float(envreg.raw("PYPARDIS_SKETCH_DELTA", "0.01"))
+
+
+def sketch_min_d() -> int:
+    """Dimensionality below which ``auto`` resolves to off
+    (``PYPARDIS_SKETCH_MIN_D``)."""
+    return int(envreg.raw("PYPARDIS_SKETCH_MIN_D", "128"))
+
+
+def auto_k(d: int) -> int:
+    """The ``auto`` sketch width for dimensionality ``d``: ``d // 4``
+    clamped to [SKETCH_MIN_K, SKETCH_MAX_K].
+
+    The ratio is set by the certified gate's geometry, not by JL
+    accuracy: projecting onto a random k-subspace retains ~``k/d`` of
+    a pair's squared distance, so the definitely-out gate only fires
+    past ``~eps * sqrt(d/k)`` — while the regime where the prefilter
+    matters at all (noise-dominated high-d frames whose axis-aligned
+    tile boxes are blind) only extends to a few multiples of eps.
+    ``k = d/4`` keeps ``sqrt(d/k) = 2`` so the gate fires inside that
+    window; the measured counts-pass win at ``d//8`` was BELOW 1.0 on
+    exactly the geometry the sketch targets (scripts/sketch_probe.py),
+    which is what pinned this ratio."""
+    return max(SKETCH_MIN_K, min(SKETCH_MAX_K, int(d) // 4))
+
+
+def check_sketch_spec(spec):
+    """Normalize a user-facing ``sketch=`` spec.
+
+    Accepts ``None`` (defer to ``PYPARDIS_SKETCH``), ``"auto"``,
+    ``"off"``/``0`` (force off), or a positive integer k.  Returns the
+    canonical spec (``None`` | ``"auto"`` | int >= 0); raises
+    ValueError on anything else — the construction-time validation
+    every knob gets.
+    """
+    if spec is None:
+        return None
+    if isinstance(spec, str):
+        s = spec.strip().lower()
+        if s == "auto":
+            return "auto"
+        if s in ("off", ""):
+            return 0
+        try:
+            spec = int(s)
+        except ValueError:
+            raise ValueError(
+                f"sketch must be 'auto', 'off', or an integer k >= 0, "
+                f"got {spec!r}"
+            ) from None
+    if isinstance(spec, (bool, float)) or not isinstance(
+        spec, (int, np.integer)
+    ):
+        raise ValueError(
+            f"sketch must be 'auto', 'off', or an integer k >= 0, "
+            f"got {spec!r}"
+        )
+    if int(spec) < 0:
+        raise ValueError(f"sketch k must be >= 0, got {spec}")
+    return int(spec)
+
+
+def resolve_sketch(spec, d: int, metric: str = "euclidean") -> int:
+    """The effective sketch width for one kernel pass (0 = off).
+
+    ``spec`` is a canonical spec (:func:`check_sketch_spec`); ``d`` the
+    data dimensionality; ``metric`` the KERNEL metric.  The sketch is a
+    squared-euclidean-distance discipline (like the box-gap pair
+    extraction), so cityblock resolves to off; ``auto`` resolves to
+    off below ``PYPARDIS_SKETCH_MIN_D`` (low-d boxes prune fine) and
+    to :func:`auto_k` above it.  An explicit k is clamped so the
+    sketch never reaches the full dimensionality (``k <= d // 2`` —
+    past that the prefilter cannot pay for itself and the residual
+    split degenerates at k = d).
+    """
+    if str(metric) != "euclidean":
+        return 0
+    spec = check_sketch_spec(spec)
+    d = int(d)
+    if spec == "auto" or spec is None:
+        if d < sketch_min_d():
+            return 0
+        k = auto_k(d)
+    else:
+        k = int(spec)
+    if k <= 0:
+        return 0
+    return max(1, min(k, d // 2))
+
+
+def sketch_dims(d: int, metric: str = "euclidean") -> int:
+    """Resolve ``PYPARDIS_SKETCH`` for one kernel pass (0 = off).
+
+    Read at TRACE time like ``PYPARDIS_DISPATCH`` — flipping the
+    variable after a program compiled needs ``jax.clear_caches()``;
+    because the sketch is label-neutral for any k, a stale read can
+    only stale the band telemetry, never the labels.
+    """
+    return resolve_sketch(envreg.raw("PYPARDIS_SKETCH", "auto"), d, metric)
+
+
+@functools.lru_cache(maxsize=32)
+def _sketch_matrix(d: int, k: int, seed: int):
+    """(Q, eta) for one ``(d, k, seed)`` triple.
+
+    ``Q`` is (d, k) float32 with near-orthonormal columns: an
+    Achlioptas sparse {+1, 0, -1} draw (database-friendly random
+    projections, JCSS 2003 — entries +-1 w.p. 1/6 each, 0 w.p. 2/3)
+    orthonormalized by float64 QR, then rounded to f32.  The QR keeps
+    the column SPAN of the sparse draw (a uniformly random k-subspace,
+    which is what the JL statistics need) while making the
+    sketch/residual split certifiable.  ``eta`` is the f32 matrix's
+    orthonormality defect ``|Q^T Q - I|_F`` measured in float64 — the
+    deterministic input of :func:`sketch_gate_band`.
+
+    Host numpy on purpose: Q is a trace-time constant embedded in the
+    compiled programs (seed/d/k-deterministic, so every shard of a
+    mesh — and every host of a fleet — bakes the same matrix).
+    """
+    rng = np.random.default_rng(
+        np.random.SeedSequence([int(seed), int(d), int(k)])
+    )
+    g = rng.choice(
+        np.array([-1.0, 0.0, 1.0]), size=(d, k), p=[1 / 6, 2 / 3, 1 / 6]
+    )
+    # A degenerate draw (rank-deficient at tiny d) falls back to a
+    # dense Gaussian column where needed; QR demands full column rank.
+    while np.linalg.matrix_rank(g) < k:  # pragma: no cover - tiny-d only
+        g = g + 1e-3 * rng.standard_normal((d, k))
+    q64, _ = np.linalg.qr(g.astype(np.float64))
+    q = np.ascontiguousarray(q64[:, :k], dtype=np.float32)
+    gram = q.astype(np.float64).T @ q.astype(np.float64)
+    eta = float(np.linalg.norm(gram - np.eye(k), "fro"))
+    return q, eta
+
+
+def sketch_matrix(d: int, k: int, seed=None):
+    """The cached ``(Q, eta)`` pair; ``seed=None`` reads the env knob."""
+    if seed is None:
+        seed = sketch_seed()
+    return _sketch_matrix(int(d), int(k), int(seed))
+
+
+def jl_band(k: int, delta=None) -> float:
+    """PREDICTIVE JL distortion halfwidth, relative to d^2.
+
+    The Achlioptas bound: projecting onto a random k-subspace
+    preserves ``|x - y|^2`` (after the ``d/k`` rescale) within relative
+    distortion ``eps`` with failure probability ``delta`` once ``k >=
+    4 ln(1/delta) / (eps^2/2 - eps^3/3)``; inverting the leading term
+    gives ``eps ~ sqrt(8 ln(1/delta) / k)``.  This is what the
+    planner's cost model and the probe's telemetry quote — the KERNEL
+    gate never uses it (a probabilistic bound cannot certify byte
+    parity; :func:`sketch_gate_band` is the certified one).
+    """
+    if delta is None:
+        delta = sketch_delta()
+    k = max(int(k), 1)
+    delta = min(max(float(delta), 1e-12), 0.5)
+    return float(np.sqrt(8.0 * np.log(1.0 / delta) / k))
+
+
+def sketch_slab(pts_dn, q):
+    """The (k+1, N) f32 sketch slab of a (d, N) coordinate slab.
+
+    Rows 0..k-1 are ``Q^T x``; row k is the residual norm ``r(x) =
+    sqrt(max(|x|^2 - |Q^T x|^2, 0))`` — so a plain (k+1)-dim squared
+    distance over slab columns IS the certified lower bound ``t2``.
+    Computed on device inside the jitted kernel entry (one (k, d) x
+    (d, N) matmul plus two squared-norm passes); ``q`` is the
+    trace-time constant from :func:`sketch_matrix`.  Pad columns
+    (zeros) sketch to zeros, exactly like the coordinate slab.
+    """
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    pts = pts_dn.astype(jnp.float32)
+    qj = jnp.asarray(q, jnp.float32)
+    s = lax.dot_general(
+        qj, pts, (((0,), (0,)), ((), ())),
+        precision=lax.Precision.HIGHEST,
+    )
+    full = jnp.sum(pts * pts, axis=0)
+    proj = jnp.sum(s * s, axis=0)
+    r = jnp.sqrt(jnp.maximum(full - proj, 0.0))
+    return jnp.concatenate([s, r[None, :]], axis=0)
+
+
+def sketch_gate_band(nmax, d: int, k: int, eta: float,
+                     precision: str = "high", fast_exact: bool = True):
+    """Certified scalar halfwidth of the sketch classification gate.
+
+    ``nmax`` is the masked GLOBAL maximum coordinate-column norm of
+    the pass's operands (a traced f32 scalar — slab column norms are
+    bounded by it, since ``|s|^2 + r^2 ~ |x|^2``); ``d``/``k`` the
+    full/sketch dimensionalities; ``eta`` the host-measured
+    orthonormality defect of Q.  The bound brackets ``|d2_kernel -
+    t2|`` beyond the ``4 rx ry`` residual spread, covering (the
+    ``exact_slack`` conventions of :mod:`ops.precision`):
+
+    * the exact kernel's own arithmetic error vs true d^2 — one
+      ``exact_slack`` plus a worst-case-sequential length-d f32
+      accumulation term ``d * 2^-24 * (nx+ny)^2`` (material at
+      d = 1024, invisible below);
+    * the slab arithmetic: t2's own f32 slack (one more
+      ``exact_slack`` + its length-(k+1) accumulation, folded into the
+      d term) and the ``Q^T x`` matmul rounding crossed against the
+      sketch difference, ``2 sqrt(k) d 2^-24 (nx+ny)^2``;
+    * the f32 Q's orthonormality defect: cross terms bounded by
+      ``4 eta (nx+ny)^2`` (``|Q^T e| <= eta |s|`` plus the Gram
+      perturbation of ``|Q(sx-sy)|^2``), which also absorbs the
+      residual-extraction rounding ``|s|^2 eta``-scale terms;
+    * when the pass's fast dot is genuinely lossy
+      (``precision='default'`` off CPU), the bf16 single-pass
+      ``band_halfwidth`` — the gate then brackets the bf16 d^2 the
+      kernel would actually compare.
+
+    All terms ride the shared ``_BAND_SAFETY`` margin.  On recentred
+    unit-scale data the band is ~1e-4 relative to frame scale — the
+    in-band fraction is driven by the residual spread geometry, not by
+    this halfwidth.
+    """
+    s = 2.0 * nmax
+    s2 = s * s
+    acc = (2.0 ** -24) * s2
+    band = (
+        2.0 * exact_slack(nmax, nmax)
+        + 2.0 * float(d) * acc
+        + 2.0 * float(np.sqrt(max(int(k), 1))) * float(d) * acc
+        + 4.0 * float(eta) * s2
+    )
+    if str(precision) == "default" and not fast_exact:
+        band = band + band_halfwidth(nmax, nmax)
+    return _BAND_SAFETY * band
+
+
+def sketch_box_norm(lo, hi):
+    """Upper bound on slab COLUMN norms from per-tile sketch boxes.
+
+    ``sqrt(max over non-empty tiles of sum_dim max(lo^2, hi^2))`` —
+    what a receiver can certify about a REMOTE shard's slab from the
+    boxes alone (the global-Morton boundary exchange ships boxes, not
+    norms).  Empty tiles arrive as inverted (+BIG, -BIG) boxes and
+    must not poison the bound, so ``lo > hi`` rows contribute zero.
+    """
+    import jax.numpy as jnp
+
+    lo = jnp.asarray(lo, jnp.float32)
+    hi = jnp.asarray(hi, jnp.float32)
+    good = jnp.all(lo <= hi, axis=-1)
+    corner = jnp.sum(jnp.maximum(lo * lo, hi * hi), axis=-1)
+    return jnp.sqrt(jnp.max(jnp.where(good, corner, 0.0), initial=0.0))

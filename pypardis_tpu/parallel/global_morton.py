@@ -601,17 +601,25 @@ def _gm_counts_delta_step(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("gtile", "btcap", "bcap", "mesh", "axis")
+    jax.jit, static_argnames=("gtile", "btcap", "bcap", "mesh", "axis",
+                              "sketch")
 )
 def _gm_select_step(owned, mask, gid, eps, *, gtile, btcap, bcap, mesh,
-                    axis):
-    """Send-side boundary-tile selection + zeroed receive buffers."""
+                    axis, sketch=0):
+    """Send-side boundary-tile selection + zeroed receive buffers.
+
+    ``sketch`` (resolved k, static): tightens the send set with the
+    sketch-space box test (:func:`..parallel.halo.boundary_send_select`)
+    — the extra ``n_send_box`` output is the full-d-only count the
+    telemetry ratio reports against."""
 
     def per_device(o, m, g):
         out = boundary_send_select(
-            o[0], m[0], g[0], eps, gtile=gtile, btcap=btcap, axis=axis
+            o[0], m[0], g[0], eps, gtile=gtile, btcap=btcap, axis=axis,
+            sketch=sketch,
         )
-        (s_pts, s_msk, s_gid, s_lo, s_hi, n_send, ovf, my_lo, my_hi) = out
+        (s_pts, s_msk, s_gid, s_lo, s_hi, n_send, ovf, my_lo, my_hi,
+         n_send_box) = out
         k = o.shape[2]
         r_pts = jnp.zeros((1, bcap, gtile, k), o.dtype)
         r_msk = jnp.zeros((1, bcap, gtile), bool)
@@ -621,6 +629,7 @@ def _gm_select_step(owned, mask, gid, eps, *, gtile, btcap, bcap, mesh,
         return (
             s_pts[None], s_msk[None], s_gid[None], s_lo[None], s_hi[None],
             n_send[None], ovf[None], my_lo[None], my_hi[None],
+            n_send_box[None],
             r_pts, r_msk, r_gid, r_val, r_ovf,
         )
 
@@ -633,7 +642,7 @@ def _gm_select_step(owned, mask, gid, eps, *, gtile, btcap, bcap, mesh,
         mesh=mesh,
         in_specs=(sp3, sp2, sp2),
         out_specs=(
-            sp4, sp3, sp3, sp3, sp3, sp1, sp1, sp3, sp3,
+            sp4, sp3, sp3, sp3, sp3, sp1, sp1, sp3, sp3, sp1,
             sp4, sp3, sp3, sp2, sp1,
         ),
         check_vma=False,
@@ -753,7 +762,7 @@ def _gm_flatten_step(recv_pts, recv_msk, recv_gid, recv_val, my_lo,
 
 
 def _gm_exchange(arrays, eps, *, mesh, axis, gtile, bt, bc,
-                 round_hook=None):
+                 round_hook=None, sketch=0):
     """Run the boundary-tile exchange: select, P-1 spanned ring rounds,
     flatten.  Returns ``((bnd, bmsk, bgid), xstats, send_need,
     recv_overflow)`` — ``send_need`` is the exact per-device max of
@@ -777,9 +786,10 @@ def _gm_exchange(arrays, eps, *, mesh, axis, gtile, bt, bc,
         out = _gm_select_step(
             owned, omsk, ogid, np.float32(eps),
             gtile=gtile, btcap=bt, bcap=bc, mesh=mesh, axis=axis,
+            sketch=sketch,
         )
         (s_pts, s_msk, s_gid, s_lo, s_hi, n_send, s_ovf, my_lo, my_hi,
-         r_pts, r_msk, r_gid, r_val, r_ovf) = out
+         n_send_box, r_pts, r_msk, r_gid, r_val, r_ovf) = out
         state = (s_pts, s_msk, s_gid, s_lo, s_hi,
                  r_pts, r_msk, r_gid, r_val, r_ovf)
         t_ring = _time.perf_counter()
@@ -831,6 +841,9 @@ def _gm_exchange(arrays, eps, *, mesh, axis, gtile, bt, bc,
             bmsk = bmsk[:, :gtile_rows]
             bgid = bgid[:, :gtile_rows]
         sent_tiles = int(np.minimum(n_send_np, bt).sum())
+        sent_tiles_box = int(
+            np.minimum(np.asarray(n_send_box), bt).sum()
+        )
         xstats = {
             "boundary_tiles": int(tiles_np.sum()),
             "boundary_rows": int(rows_np.sum()),
@@ -839,6 +852,12 @@ def _gm_exchange(arrays, eps, *, mesh, axis, gtile, bt, bc,
             # the occupancy analogue of the KD host route's halo_bytes
             # (duplicated rows shipped), at tile granularity.
             "boundary_tile_bytes": sent_tiles * gtile * k * 4,
+            # Full-d-box-only twins: what the ring WOULD carry without
+            # the sketch tightening (== the actual counters when
+            # sketch=0).  sent_tiles <= sent_tiles_box always — the
+            # sketch test only ANDs into the live mask.
+            "sent_tiles_box": sent_tiles_box,
+            "boundary_bytes_box": sent_tiles_box * gtile * k * 4,
             "boundary_tile_caps": [int(bt), int(bc)],
             "exchange_tile": int(gtile),
             "ring_wall_s": round(ring_wall, 6),
@@ -863,7 +882,7 @@ def _gm_exchange(arrays, eps, *, mesh, axis, gtile, bt, bc,
 
 
 def _gm_boundary_tiles(arrays, eps, *, mesh, axis, block, btcap, base,
-                       round_hook=None):
+                       round_hook=None, sketch=0):
     """The boundary exchange behind its capacity ladder and the staging
     cache (route ``gm_boundary``, keyed base + eps): warm refits of the
     same data/eps skip the select + ring entirely.
@@ -873,7 +892,9 @@ def _gm_boundary_tiles(arrays, eps, *, mesh, axis, block, btcap, base,
     doubling ladder below is a backstop, not two extra full exchange
     passes per cold fit."""
     faults.maybe_fail("gm.exchange")
-    bkey = base + ("boundary", float(eps))
+    # sketch is in the key: the tightened send set changes which tiles
+    # the cached boundary slab holds (a superset/subset per setting).
+    bkey = base + ("boundary", float(eps), int(sketch))
     cached = staging.device_get("gm_boundary", bkey)
     if cached is not None:
         (bnd, bmsk, bgid), baux = cached
@@ -918,7 +939,7 @@ def _gm_boundary_tiles(arrays, eps, *, mesh, axis, block, btcap, base,
     while True:
         (bnd, bmsk, bgid), xstats, send_need, recv_ovf = _gm_exchange(
             arrays, eps, mesh=mesh, axis=axis, gtile=gtile, bt=bt, bc=bc,
-            round_hook=round_hook,
+            round_hook=round_hook, sketch=sketch,
         )
         send_ovf = max(0, send_need - bt)
         if send_ovf == 0 and recv_ovf == 0:
@@ -1540,6 +1561,10 @@ def _gm_chained_dbscan(
             "boundary_rows": boundary_rows,
             "sent_tiles": boundary_tiles,
             "boundary_tile_bytes": boundary_tiles * block * k * 4,
+            # Host-side tile selection is already box-exact; no ring,
+            # so the box twins equal the actuals on this route.
+            "sent_tiles_box": boundary_tiles,
+            "boundary_bytes_box": boundary_tiles * block * k * 4,
             "boundary_tile_caps": [int(btiles), int(btiles)],
             "exchange_tile": int(block),
             "halo_factor": float(boundary_rows) / max(n, 1),
@@ -1558,7 +1583,7 @@ def _gm_chained_dbscan(
             "exchange_overlap_efficiency": 0.0,
         }
         _exec_stats(stats, oc_on=True, pstats=pstats, block=block,
-                    k=k, precision=precision, n=n)
+                    k=k, precision=precision, n=n, metric=metric)
         stats["duplicated_work_factor"] = 1.0
         stats["owner_computes"] = True
         return _canonicalize_roots(labels, core), core, stats
@@ -1714,7 +1739,13 @@ def global_morton_dbscan(
     # run one program at a time with their own probe + Retrier scope.
     from ..utils.budget import pair_overflow as _pair_overflow
     from ..utils.hints import PAIR_BUDGET_HINTS, dispatch_tag
+    from ..ops.sketch import sketch_dims
 
+    # Same trace-time env resolution the cluster-step kernels use
+    # (metric-gated; 0 below min-d or for non-euclidean): the boundary
+    # ring's send-side tightening rides the SAME sketch the kernels
+    # run, so the telemetry ratio describes one configuration.
+    sk_gm = int(sketch_dims(k, metric))
     owned_kind = resolve_backend(backend, metric, cap, block, k, precision)
     # Overlap needs pair lists for the delta pass: gate on the OWNED
     # slab's dispatch decision (the combined slab is never smaller, so
@@ -1780,6 +1811,7 @@ def global_morton_dbscan(
     (bnd, bmsk, bgid), xstats = _gm_boundary_tiles(
         arrays, eps, mesh=mesh, axis=axis, block=block, btcap=btcap,
         base=base, round_hook=_counts_hook if overlap else None,
+        sketch=sk_gm,
     )
     t_exchange_raw = _time.perf_counter() - t0
     ring_wall = float(xstats.get("ring_wall_s", 0.0) or 0.0)
@@ -2069,7 +2101,7 @@ def global_morton_dbscan(
         exchange_overlap_efficiency=round(float(overlap_eff), 6),
     )
     _exec_stats(stats, oc_on=True, pstats=pstats, block=block, k=k,
-                precision=precision, n=n)
+                precision=precision, n=n, metric=metric)
     # Zero duplicated ROWS by construction: every point is neighbor-
     # counted and clustered exactly once, on its owning shard (the KD
     # gauge counts clustered slots, whose cap is the LARGEST partition;

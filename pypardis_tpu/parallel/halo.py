@@ -180,7 +180,8 @@ def _keep_tiles(cat_val, cap_tiles):
     return order, kept, dropped
 
 
-def boundary_send_select(owned, mask, gid, eps, *, gtile, btcap, axis):
+def boundary_send_select(owned, mask, gid, eps, *, gtile, btcap, axis,
+                         sketch=0):
     """Per-device body: select and compact MY boundary tiles.
 
     Must run inside ``shard_map``.  ``owned``: (cap, k) this shard's
@@ -206,15 +207,32 @@ def boundary_send_select(owned, mask, gid, eps, *, gtile, btcap, axis):
     cap raises an actionable error naming the exact need and the knobs
     (``btcap=`` / ``PYPARDIS_GM_BTCAP``) — dropped boundary tiles would
     mean silently wrong labels, so exhaustion is always loud.
+
+    ``sketch`` (a RESOLVED projection width k, 0 = off — the caller
+    resolves against the metric outside the trace): ALSO require each
+    tile's (k+1)-dim sketch-space box to lie within ``sqrt(eps^2 +
+    band)`` of some remote tile's sketch box, and send only tiles
+    passing BOTH tests.  Each test alone is a sound must-send superset
+    (a cross-shard pair within eps keeps its tile live under either
+    geometry — the slab distance lower-bounds d^2 up to the certified
+    band), so their intersection still contains every needed tile,
+    and at high d the sketch boxes prune the ring far harder than the
+    full-d boxes whose per-axis gaps wash out.  ``n_send_box`` (the
+    full-d-only count) returns alongside for the telemetry ratio; the
+    downstream ring/flatten row filters stay full-d and exact.
     """
-    from ..ops.distances import cross_tile_live, tile_bounds
+    from ..ops.distances import (
+        _sketch_slab_t, cross_tile_live, tile_bounds,
+    )
+    from ..ops.sketch import sketch_gate_band, sketch_matrix
 
     cap, k = owned.shape
     nt = cap // gtile
     tiles = owned.reshape(nt, gtile, k)
     tmsk = mask.reshape(nt, gtile)
     tgid = gid.reshape(nt, gtile)
-    lo, hi = tile_bounds(tiles.transpose(0, 2, 1), tmsk)  # (nt, k)
+    tiles_t = tiles.transpose(0, 2, 1)
+    lo, hi = tile_bounds(tiles_t, tmsk)  # (nt, k)
 
     n_dev = (
         jax.lax.axis_size(axis)
@@ -230,6 +248,33 @@ def boundary_send_select(owned, mask, gid, eps, *, gtile, btcap, axis):
     rem_lo = jnp.where(mine, _BOX_BIG, all_lo).reshape(n_dev * nt, k)
     rem_hi = jnp.where(mine, -_BOX_BIG, all_hi).reshape(n_dev * nt, k)
     live = cross_tile_live(lo, hi, rem_lo, rem_hi, eps)
+    n_send_box = jnp.sum(live.astype(jnp.int32))
+    if sketch:
+        q, eta = sketch_matrix(k, sketch)
+        slab = _sketch_slab_t(tiles_t, jnp.asarray(q))
+        slo, shi = tile_bounds(slab, tmsk)  # (nt, sketch+1)
+        # One mesh-wide norm bound: the band must cover the float error
+        # at the HIGHEST-norm point on ANY shard, not just mine.
+        nmax = jax.lax.pmax(
+            jnp.sqrt(jnp.max(jnp.where(
+                tmsk, jnp.sum(tiles_t * tiles_t, axis=1), 0.0
+            ))),
+            axis,
+        )
+        band = sketch_gate_band(nmax, k, sketch, eta)
+        eps_gate = jnp.sqrt(jnp.float32(eps) ** 2 + band)
+        all_slo = jax.lax.all_gather(slo, axis)
+        all_shi = jax.lax.all_gather(shi, axis)
+        sdim = slo.shape[1]
+        srem_lo = jnp.where(mine, _BOX_BIG, all_slo).reshape(
+            n_dev * nt, sdim
+        )
+        srem_hi = jnp.where(mine, -_BOX_BIG, all_shi).reshape(
+            n_dev * nt, sdim
+        )
+        live = live & cross_tile_live(
+            slo, shi, srem_lo, srem_hi, eps_gate
+        )
 
     order, valid, overflow = _keep_tiles(live, btcap)
     send_pts = jnp.where(valid[:, None, None], tiles[order], 0.0)
@@ -240,7 +285,7 @@ def boundary_send_select(owned, mask, gid, eps, *, gtile, btcap, axis):
     n_send = jnp.sum(live.astype(jnp.int32))
     return (
         send_pts, send_msk, send_gid, send_lo, send_hi, n_send, overflow,
-        lo, hi,
+        lo, hi, n_send_box,
     )
 
 

@@ -1225,7 +1225,7 @@ def _sharded_dbscan_1dev_overlap(
         )
     stats["overlap_efficiency"] = round(float(eff_cell[0]), 4)
     _exec_stats(stats, oc_on=False, pstats=pstats, block=block, k=k,
-                precision=precision, n=n)
+                precision=precision, n=n, metric=metric)
     return _canonicalize_roots(final, core), core, stats
 
 
@@ -1820,14 +1820,20 @@ def _sharded_hint_key(owned_shape, halo_cap, block, precision, eps, metric):
     The binding extraction runs per partition over (cap + hcap) points,
     so both capacities key the entry; eps/metric shape the live-pair
     count directly; the dispatch-mode tag keeps dense-grid budgets from
-    over-reserving the compacted kernels (and vice versa).
+    over-reserving the compacted kernels (and vice versa).  The
+    resolved sketch k keys too: sketch-space tile boxes prune to a
+    different live-pair count than full-d boxes, so budgets learned
+    under one prefilter setting must not seed the other.
     """
+    from ..ops.distances import _norm_metric
+    from ..ops.sketch import sketch_dims
     from ..utils.hints import dispatch_tag
 
     nt = (int(owned_shape[-2]) + int(halo_cap)) // max(int(block), 1)
+    sk = sketch_dims(int(owned_shape[-1]), _norm_metric(metric))
     return (
         "sharded", dispatch_tag(nt), tuple(owned_shape), int(halo_cap),
-        block, precision, float(eps), str(metric),
+        block, precision, float(eps), str(metric), sk,
     )
 
 
@@ -1851,7 +1857,8 @@ def _oc_applies(owner_computes, mesh, p_total) -> bool:
     )
 
 
-def _exec_stats(stats, *, oc_on, pstats, block, k, precision, n):
+def _exec_stats(stats, *, oc_on, pstats, block, k, precision, n,
+                metric="euclidean"):
     """Fold the execution telemetry every sharded route shares into
     ``stats``: the owner-computes mode, the clustered-volume
     ``duplicated_work_factor`` (slots whose core status is computed
@@ -1895,6 +1902,15 @@ def _exec_stats(stats, *, oc_on, pstats, block, k, precision, n):
         stats["kernel_tiles"] = int(
             -(-max(cap + hcap, 1) // stats["kernel_block"])
         )
+    # Resolved random-projection prefilter width (0 = off).  Resolved
+    # here at REPORT time from the same env the kernels read at trace
+    # time; a mid-session env flip without jax.clear_caches() can make
+    # this stale relative to an already-compiled program — telemetry
+    # only, labels are sketch-neutral for any k.
+    from ..ops.distances import _norm_metric
+    from ..ops.sketch import sketch_dims
+
+    stats["sketch_k"] = int(sketch_dims(int(k), _norm_metric(metric)))
     return stats
 
 
@@ -2140,7 +2156,7 @@ def sharded_dbscan(
                 halo_bytes=_ring_halo_bytes(stats, used_hcap, k),
             )
             _exec_stats(stats, oc_on=oc_on, pstats=pstats, block=block,
-                        k=k, precision=precision, n=n)
+                        k=k, precision=precision, n=n, metric=metric)
             staging.give_back_after_put(host_bufs)
             return _canonicalize_roots(labels, core), core, stats
         labels, core, m_rounds, used_hcap = out
@@ -2151,7 +2167,7 @@ def sharded_dbscan(
         )
         labels, core = np.asarray(labels), np.asarray(core)
         _exec_stats(stats, oc_on=oc_on, pstats=pstats, block=block,
-                    k=k, precision=precision, n=n)
+                    k=k, precision=precision, n=n, metric=metric)
         staging.give_back_after_put(host_bufs)
         return _canonicalize_roots(labels, core), core, stats
     if (
@@ -2245,7 +2261,7 @@ def sharded_dbscan(
             )
         stats = dict(stats, merge="host")
         _exec_stats(stats, oc_on=oc_on, pstats=pstats, block=block,
-                    k=k, precision=precision, n=n)
+                    k=k, precision=precision, n=n, metric=metric)
         staging.give_back_after_put(host_bufs)
         return _canonicalize_roots(labels, core), core, stats
 
@@ -2290,7 +2306,7 @@ def sharded_dbscan(
     )
     labels, core = np.asarray(labels), np.asarray(core)
     _exec_stats(stats, oc_on=oc_on, pstats=pstats, block=block,
-                k=k, precision=precision, n=n)
+                k=k, precision=precision, n=n, metric=metric)
     staging.give_back_after_put(host_bufs)
     return _canonicalize_roots(labels, core), core, stats
 
@@ -2600,7 +2616,7 @@ def sharded_dbscan_device(
             halo_bytes=_ring_halo_bytes(stats, used_hcap, k),
         )
         _exec_stats(stats, oc_on=oc_on, pstats=pstats, block=block,
-                    k=k, precision=precision, n=n)
+                    k=k, precision=precision, n=n, metric=metric)
         return _canonicalize_roots(labels, core), core, stats, part, pid
     labels, core, m_rounds, used_hcap = out
     stats.update(
@@ -2610,7 +2626,7 @@ def sharded_dbscan_device(
     )
     labels, core = np.asarray(labels), np.asarray(core)
     _exec_stats(stats, oc_on=oc_on, pstats=pstats, block=block,
-                k=k, precision=precision, n=n)
+                k=k, precision=precision, n=n, metric=metric)
     return _canonicalize_roots(labels, core), core, stats, part, pid
 
 

@@ -79,6 +79,12 @@ class CorpusRow:
     kernel_tiles: Optional[int] = None
     kernel_passes: Optional[int] = None
     band_fraction: Optional[float] = None
+    # Resolved sketch-prefilter width of the run's kernel passes (0 =
+    # off, None = the archive predates the knob).  When > 0 the run's
+    # band_fraction IS the sketch rescore fraction (the stats columns
+    # are shared — see ops.sketch), which is how the compute-term
+    # fitter prices sketch rows.
+    sketch_k: Optional[int] = None
     duplicated_work_factor: Optional[float] = None
     halo_bytes: Optional[int] = None
     peak_host_rss_bytes: Optional[int] = None
@@ -185,6 +191,10 @@ def row_from_report(report: Dict, *, wall_s=None,
         kernel_tiles=int(tiles) if tiles is not None else None,
         kernel_passes=int(comp.get("kernel_passes") or 0) or None,
         band_fraction=_num(comp.get("band_fraction")),
+        sketch_k=(
+            int(comp["sketch_k"]) if _num(comp.get("sketch_k"))
+            is not None else None
+        ),
         duplicated_work_factor=_num(sh.get("duplicated_work_factor")),
         halo_bytes=int(halo) if halo is not None else None,
         peak_host_rss_bytes=int(

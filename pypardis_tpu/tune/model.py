@@ -89,6 +89,30 @@ def precision_factor(backend: str, precision: str,
     }.get(p, 1.0)
 
 
+def _pair_flops(
+    live_pairs: float, block: int, dim: int, passes: int, pf: float,
+    backend: str, sketch: int = 0, sketch_band_fraction: float = 1.0,
+) -> float:
+    """Model FLOPs of the distance pass for one config.
+
+    Sketch off: the classic ``pairs * B^2 * (dim+2) * 2 * passes * pf``.
+    Sketch on (``sketch`` = resolved k): every pair runs the (k+1)-dim
+    slab gate at HIGHEST precision — ``(k+3)`` columns with the same
+    augmented-operand accounting — and only the ambiguous fraction
+    reruns the full-d exact term, so the two terms are
+    ``pairs * B^2 * (k+3)`` + ``band_fraction * pairs * B^2 * (d+2)``.
+    One shared ``pair_flop_s`` coefficient prices both (they run on the
+    same unit, the MXU/gemm path), which is what lets sketch rows and
+    unsketched rows fit the SAME coefficient.
+    """
+    base = float(live_pairs) * block * block * 2.0 * passes
+    if sketch <= 0:
+        return base * (dim + 2) * pf
+    sbf = min(max(float(sketch_band_fraction), 0.0), 1.0)
+    pf_hi = precision_factor(backend, "highest")
+    return base * ((sketch + 3) * pf_hi + sbf * (dim + 2) * pf)
+
+
 def _nonneg_lstsq(X: np.ndarray, y: np.ndarray) -> np.ndarray:
     """Plain least squares with negative coefficients clamped to 0 and
     refit on the surviving columns — enough structure for 1-2 column
@@ -164,11 +188,19 @@ class CostModel:
             if len(comp) >= 4:
                 X = np.array([
                     [
-                        r.live_pairs * r.block * r.block
-                        * (r.dim + 2) * 2.0 * (r.kernel_passes or 1)
-                        * precision_factor(
-                            backend, r.precision or "high",
-                            r.band_fraction or 0.0,
+                        _pair_flops(
+                            r.live_pairs, r.block, r.dim,
+                            r.kernel_passes or 1,
+                            precision_factor(
+                                backend, r.precision or "high",
+                                r.band_fraction or 0.0,
+                            ),
+                            backend,
+                            sketch=r.sketch_k or 0,
+                            sketch_band_fraction=(
+                                r.band_fraction
+                                if r.band_fraction is not None else 1.0
+                            ),
                         ),
                         float(r.live_pairs * (r.kernel_passes or 1)),
                     ]
@@ -259,6 +291,8 @@ class CostModel:
         boundary_bytes: float = 0.0,
         is_stream: bool = False,
         passes: int = 4,
+        sketch: int = 0,
+        sketch_band_fraction: float = 1.0,
     ) -> Dict[str, float]:
         """Predicted per-phase seconds for one concrete config.
 
@@ -273,9 +307,9 @@ class CostModel:
         c = self.coef
         par = max(1, devices if self.backend != "cpu" else 1)
         pf = precision_factor(self.backend, precision, band_fraction)
-        flops = (
-            float(live_pairs) * block * block * (dim + 2) * 2.0
-            * passes * pf
+        flops = _pair_flops(
+            live_pairs, block, dim, passes, pf, self.backend,
+            sketch=sketch, sketch_band_fraction=sketch_band_fraction,
         )
         compute = (
             c["pair_flop_s"] * flops

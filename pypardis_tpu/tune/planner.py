@@ -24,9 +24,11 @@ trace of why each knob was chosen.
 Every PLANNED knob is label-safe: mode (cross-mode byte parity is
 pinned by the engine family's tests), block (pruning granularity
 only), precision high<->mixed (byte-identical by the PR 7 band
-construction), merge route, and dispatch (commutative-fold parity,
-PR 11) — so ``DBSCAN(auto=True)`` labels are byte-identical to the
-same explicit config by construction.
+construction), merge route, dispatch (commutative-fold parity,
+PR 11), and sketch (byte-identical for any k by the certified-gate
+rescore, :mod:`pypardis_tpu.ops.sketch`) — so ``DBSCAN(auto=True)``
+labels are byte-identical to the same explicit config by
+construction.
 """
 
 from __future__ import annotations
@@ -38,7 +40,7 @@ from typing import Dict, List, Optional, Tuple
 from .model import model_for
 from .probe import DatasetProbe, candidate_blocks
 
-_KNOBS = ("mode", "block", "precision", "merge", "dispatch")
+_KNOBS = ("mode", "block", "precision", "merge", "dispatch", "sketch")
 # Planner candidates per knob.  Precision plans only among the
 # label-identical-to-high ladder rungs (high / mixed); `highest`
 # differs from `high` in last-ulp verdicts on natural near-eps pairs
@@ -160,8 +162,17 @@ def plan_fit(
     probe: DatasetProbe,
     pinned: Optional[Dict] = None,
     corpus_rows=None,
+    *,
+    metric: str = "euclidean",
 ) -> TunePlan:
-    """Plan the unpinned knobs for one fit described by ``probe``."""
+    """Plan the unpinned knobs for one fit described by ``probe``.
+
+    ``metric`` is the KERNEL metric string — the sketch knob is a
+    euclidean-only discipline, so any other value (or a callable's
+    name) plans ``sketch=0``.  The sketch knob is label-safe like
+    every other planned knob (byte parity for any k by the certified
+    gate construction, :mod:`pypardis_tpu.ops.sketch`).
+    """
     user_pinned = dict(pinned or {})
     user_pinned.pop("_device_resident", None)
     rules: List[str] = []
@@ -230,6 +241,24 @@ def plan_fit(
     merges = [fixed["merge"]] if "merge" in fixed else (
         ["device", "host"] if sharded else ["auto"]
     )
+    # -- sketch candidates: off, plus the auto width when the metric
+    # and dimensionality admit one.  A user pin restricts the FINAL
+    # choice to its resolved width but the alternative still gets
+    # scored, so a pin the model disagrees with is conflict-recorded.
+    from ..ops.sketch import check_sketch_spec, resolve_sketch
+
+    auto_sk = probe.sketch_k_auto if str(metric) == "euclidean" else 0
+    pin_sk = None
+    if "sketch" in fixed:
+        try:
+            pin_sk = resolve_sketch(
+                check_sketch_spec(fixed["sketch"]), probe.dim, metric
+            )
+        except ValueError:
+            pin_sk = 0
+        sketches = sorted({pin_sk, 0} | ({auto_sk} if auto_sk else set()))
+    else:
+        sketches = [0, auto_sk] if auto_sk > 0 else [0]
 
     def _dispatch_for(tiles: float) -> str:
         # Unpinned dispatch follows the engine's own measured
@@ -260,11 +289,14 @@ def plan_fit(
             "live_pairs": ref["live_pair_fraction"] * tiles * tiles,
             "live_pair_fraction": ref["live_pair_fraction"],
             "band_fraction": ref["band_fraction"],
+            "sketch_band_fraction": ref.get(
+                "sketch_band_fraction", 1.0
+            ),
         }
 
     scored: List[Tuple[Dict, Dict]] = []
-    for mode, block, prec, merge in itertools.product(
-        modes, blocks, precisions, merges
+    for mode, block, prec, merge, sk in itertools.product(
+        modes, blocks, precisions, merges, sketches
     ):
         st = _block_stats(block)
         disp = _dispatch_for(st["tiles"])
@@ -285,10 +317,12 @@ def plan_fit(
             ),
             is_stream=probe.is_memmap,
             passes=_PASSES,
+            sketch=int(sk),
+            sketch_band_fraction=st.get("sketch_band_fraction", 1.0),
         )
         cfg = {
             "mode": mode, "block": block, "precision": prec,
-            "merge": merge, "dispatch": disp,
+            "merge": merge, "dispatch": disp, "sketch": int(sk),
         }
         scored.append((cfg, phases))
     if not scored:
@@ -300,9 +334,23 @@ def plan_fit(
         key=lambda it: (
             it[1]["total_s"],
             it[0]["block"], it[0]["mode"], it[0]["precision"],
-            it[0]["merge"], it[0]["dispatch"],
+            it[0]["merge"], it[0]["dispatch"], it[0]["sketch"],
         )
     )
+    if pin_sk is not None:
+        best_any = scored[0]
+        pinned_scored = [
+            it for it in scored if it[0]["sketch"] == pin_sk
+        ]
+        scored = pinned_scored or scored
+        if best_any[0]["sketch"] != pin_sk:
+            rules.append(
+                f"NOTE: cost model preferred sketch="
+                f"{best_any[0]['sketch']} "
+                f"({best_any[1]['total_s']:.3f}s predicted) but the "
+                f"user pinned sketch={user_pinned.get('sketch')} "
+                f"(resolves to {pin_sk}); keeping the pin"
+            )
     best_cfg, best_phases = scored[0]
 
     # -- per-knob reasons: chosen value vs the best alternative -------
@@ -324,6 +372,12 @@ def plan_fit(
             reasons[knob] = (
                 f"{best_cfg[knob]} — the engine's measured "
                 f"pair-dispatch crossover at this tile count"
+            )
+            continue
+        if knob == "sketch" and len(alts) < 2:
+            reasons[knob] = (
+                "0 — dimensionality below the sketch gate or a "
+                "non-euclidean kernel metric (prefilter off)"
             )
             continue
         if len(alts) < 2:

@@ -65,6 +65,14 @@ class DatasetProbe:
     # Fraction of sampled pairs whose d^2 lands in the mixed-precision
     # rescore band around eps^2.
     pair_fraction_in_band: float
+    # Sketch-prefilter features: the auto sketch width for this dim
+    # (0 below the min-d gate) and the fraction of sampled pairs the
+    # certified sketch gate at that width leaves AMBIGUOUS (neither
+    # definitely-in nor definitely-out) — the pairs that pay the
+    # full-d rescore.  Measured with the REAL projection matrix and
+    # gate band, so the estimate shares the kernels' own geometry.
+    sketch_k_auto: int = 0
+    pair_fraction_in_sketch_band: float = 0.0
     # Per candidate block: estimated tiles, live tile pairs, live
     # tile-pair fraction, and the derived band fraction (band pairs /
     # pairs examined per pass).
@@ -207,6 +215,32 @@ def probe_dataset(
     ) / m
     neighbors = p_eps * n
 
+    # -- sketch-gate ambiguity on the same sub-sample -----------------
+    # Run the REAL certified gate (projection matrix, residual bound,
+    # gate band — ops.sketch) over the density pairs at the auto width:
+    # the fraction left ambiguous is what the cost model charges the
+    # full-d rescore term for.  Host numpy throughout; O(m^2 k).
+    from ..ops.sketch import (
+        resolve_sketch, sketch_gate_band, sketch_matrix,
+    )
+
+    sk_auto = resolve_sketch("auto", k)
+    p_sk_band = 0.0
+    if sk_auto > 0:
+        q, eta = sketch_matrix(k, sk_auto)
+        s = dens @ q.astype(np.float64)
+        ssq = np.einsum("ij,ij->i", s, s)
+        resid = np.sqrt(np.maximum(sq - ssq, 0.0))
+        t2 = np.maximum(
+            ssq[:, None] + ssq[None, :] - 2.0 * (s @ s.T), 0.0
+        ) + (resid[:, None] - resid[None, :]) ** 2
+        up = t2 + 4.0 * resid[:, None] * resid[None, :]
+        nmax = float(np.sqrt(sq.max())) if len(sq) else 0.0
+        band = float(sketch_gate_band(nmax, k, sk_auto, eta))
+        ambig = ~((t2.ravel() - band > eps2)
+                  | (up.ravel() <= eps2 - band))
+        p_sk_band = float(np.count_nonzero(ambig)) / m
+
     # -- per-block tile geometry --------------------------------------
     block_stats: Dict[int, Dict[str, float]] = {}
     for B in sorted({int(b) for b in blocks}):
@@ -220,11 +254,17 @@ def probe_dataset(
         band_fraction = min(
             1.0, p_band / frac if frac > 0 else 0.0
         )
+        # Same pair-mass-to-live-mass transfer band_fraction uses: the
+        # share of LIVE pair work the sketch gate leaves ambiguous.
+        sketch_band_fraction = min(
+            1.0, p_sk_band / frac if frac > 0 else 0.0
+        )
         block_stats[B] = {
             "tiles": float(tiles),
             "live_pairs": float(live_pairs),
             "live_pair_fraction": float(frac),
             "band_fraction": float(band_fraction),
+            "sketch_band_fraction": float(sketch_band_fraction),
         }
 
     limit = rss_soft_limit()
@@ -243,6 +283,8 @@ def probe_dataset(
         neighbors_per_point=float(neighbors),
         pair_fraction_in_eps=p_eps,
         pair_fraction_in_band=p_band,
+        sketch_k_auto=int(sk_auto),
+        pair_fraction_in_sketch_band=float(p_sk_band),
         blocks=block_stats,
         rss_soft_limit=int(limit),
         memory_pressure=bool(memory_pressure()),

@@ -79,6 +79,22 @@ _DECLARATIONS: Tuple[EnvVar, ...] = (
     EnvVar("PYPARDIS_STEP_OVERLAP", "bool", "auto (off on TPU)",
            "Speculative next-batch dispatch on the stepped route; "
            "queued re-execution poisons tunneled TPU workers."),
+    # -- sketch prefilter ---------------------------------------------
+    EnvVar("PYPARDIS_SKETCH", "spec", "auto",
+           "Random-projection sketch prefilter for the distance "
+           "pass: `auto` picks k from the dimensionality, an integer "
+           "pins k, `0`/`off` disables (read at TRACE time; flip "
+           "needs `jax.clear_caches()`)."),
+    EnvVar("PYPARDIS_SKETCH_DELTA", "float", "0.01",
+           "JL failure probability the PREDICTIVE `jl_band` "
+           "halfwidth is quoted at (planner/telemetry only; the "
+           "kernel gate uses the certified bound)."),
+    EnvVar("PYPARDIS_SKETCH_MIN_D", "int", "128",
+           "Dimensionality below which `sketch=auto` resolves to "
+           "off (low-d tiles prune fine with full-d boxes)."),
+    EnvVar("PYPARDIS_SKETCH_SEED", "int", "1299721",
+           "Seed of the sparse random-projection matrix; fixed per "
+           "(d, k, seed) so sketches are reproducible across hosts."),
     # -- distributed execution ----------------------------------------
     EnvVar("PYPARDIS_CHAINED_OVERLAP", "bool", "1",
            "Double-buffered host build/ship overlap on the 1-device "

@@ -567,6 +567,67 @@ def main() -> None:
             if not isinstance(sw.get(key), int):
                 fail(f"telemetry.sweep.{key} is {sw.get(key)!r}")
 
+    # Sketch-prefilter contract (ISSUE 17): a sketch row must carry a
+    # positive resolved projection width, a band fraction in [0, 1],
+    # the cross-route byte-parity claim, per-dim counts parity, the GM
+    # boundary-bytes invariant (the sketch-space send gate can only
+    # SHRINK the ring: sketch bytes <= full-d box bytes), and a finite
+    # positive headline win.
+    if str(row["metric"]).startswith("sketch"):
+        if row.get("schema") != "pypardis_tpu/sketch@1":
+            fail(f"sketch row schema is {row.get('schema')!r}")
+        sk = row.get("sketch_k")
+        if not isinstance(sk, int) or isinstance(sk, bool) or sk <= 0:
+            fail(f"sketch row.sketch_k is {sk!r}, expected int > 0")
+        v = row.get("value")
+        if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                or v != v or v in (float("inf"), float("-inf")) or v <= 0:
+            fail(f"sketch win value is {v!r}, expected finite > 0")
+        bf = row.get("sketch_band_fraction")
+        if not isinstance(bf, (int, float)) or isinstance(bf, bool) \
+                or bf != bf or not 0 <= bf <= 1:
+            fail(
+                f"sketch_band_fraction is {bf!r}, expected a finite "
+                f"number in [0, 1]"
+            )
+        if row.get("labels_match") is not True:
+            fail(
+                "sketch row labels_match is not True — sketch-on labels "
+                "must be byte-identical to the exact pass on every route"
+            )
+        pd = row.get("per_dim")
+        if not isinstance(pd, list) or not pd:
+            fail(f"sketch row.per_dim is {pd!r}, expected non-empty list")
+        for i, entry in enumerate(pd):
+            if entry.get("counts_match") is not True:
+                fail(f"per_dim[{i}] counts_match is not True")
+            ek = entry.get("sketch_k")
+            if not isinstance(ek, int) or ek <= 0:
+                fail(f"per_dim[{i}] sketch_k is {ek!r}")
+        if pd[-1].get("auto_on") is not True:
+            fail(
+                "sketch row's largest dim did not engage the AUTO "
+                "policy — the headline win must come from sketch='auto'"
+            )
+        bs = row.get("boundary_bytes_sketch")
+        bb = row.get("boundary_bytes_box")
+        if not isinstance(bs, int) or not isinstance(bb, int):
+            fail(
+                f"sketch boundary bytes are {bs!r} / {bb!r}, expected "
+                f"ints"
+            )
+        if bs > bb:
+            fail(
+                f"sketch boundary_bytes_sketch {bs} exceeds the full-d "
+                f"box bound {bb} — the send gate may only shrink the "
+                f"ring"
+            )
+        if int(tel.get("compute", {}).get("sketch_k", 0)) != sk:
+            fail(
+                "telemetry.compute.sketch_k disagrees with the row's "
+                "resolved sketch_k"
+            )
+
     # Auto-tuning contract (ISSUE 14): a tune row must carry the plan
     # (all five knobs), FINITE predicted per-phase seconds, a probe
     # overhead within the 5% budget, proof that auto-vs-explicit
@@ -584,7 +645,9 @@ def main() -> None:
         ):
             fail(f"tune row.plan is {plan!r}")
         for knob in ("mode", "block", "precision", "merge",
-                     "dispatch"):
+                     "dispatch", "sketch"):
+            # sketch=0 is a real plan value ("prefilter off") and is
+            # not in the sentinel tuple — only a MISSING key fails.
             if plan["config"].get(knob) in (None, ""):
                 fail(f"tune plan missing knob {knob!r}")
         pred = row.get("predicted_phases")

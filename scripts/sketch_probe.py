@@ -1,0 +1,283 @@
+#!/usr/bin/env python
+"""CI probe for the sketch-prefiltered high-d distance pass (ISSUE 17).
+
+Geometry: the regime the prefilter exists for — NOISE-DOMINATED high-d
+frames.  Clusters sit on mutually equidistant centers (a scaled
+orthonormal latent basis embedded along random ambient directions) with
+full-rank ambient noise whose floor dominates every pairwise distance:
+per-coordinate the between-cluster signal drowns under the tile's own
+noise width, so the axis-aligned full-d tile boxes go blind (nearly
+every tile pair is "live" by box gap — the high-d curse), while
+pairwise DISTANCES stay cleanly separated (intra ~ noise floor < eps,
+inter = 3.5x eps).  That separation is exactly what the certified
+random-projection gate sees: ``|Q^T(x-y)|^2 ~ (k/d) |x-y|^2``, so with
+the auto width ``k = d/4`` the definitely-out gate (threshold
+``~eps * sqrt(d/k) = 2 eps``) retires the box-blind bulk and only
+shared-cluster tiles rescore at full d.  On low-noise geometry the
+boxes already prune everything and the sketch can only add overhead —
+which is why the auto policy gates on dimensionality, not on a
+universal win.
+
+Two sections, one row:
+
+* **Counts-pass sweep** — the XLA counts pass at d in {64, 512},
+  sketch ON vs OFF, byte-parity asserted per dim.  The headline
+  ``value`` is the wall ratio at the LARGEST dim, gated by
+  ``SKETCH_MIN_WIN`` (CI default 1.25 on the CPU mesh, where the
+  gate's elementwise tail is memory-bound next to the matmuls; the
+  acceptance-scale run on TPU hardware targets >= 3x —
+  ``SKETCH_N=65536 SKETCH_MIN_WIN=3 make sketch-probe`` — where the
+  d/k = 4x MXU-flop reduction is the whole story).
+* **Route parity** — full fits at the largest dim across the fused
+  single-device engine, the KD owner-computes mesh, and
+  ``mode="global_morton"``, each with ``sketch="auto"`` and
+  ``sketch=0``: all six label vectors must describe the identical
+  clustering (fused renumbered to the distributed family's
+  min-core-gid canon, exactly like ``global_morton_probe``).  The GM
+  sketch-on fit must also report ``boundary_tile_bytes <=
+  boundary_bytes_box`` — the sketch-space send gate can only SHRINK
+  the ring.
+
+Emits ONE bench-style JSON row (``schema="pypardis_tpu/sketch@1"``,
+``metric="sketch_prefilter_win"``) through the ``bench_diff
+--annotate | check_bench_json --require-diff`` pipe; the checker
+re-enforces the invariants so a hand-edited row cannot pass.
+
+Geometry via env: SKETCH_N (default 16384 for the counts sweep),
+SKETCH_PARITY_N (4096 for the six full fits), SKETCH_DIMS ("64,512"),
+SKETCH_BLOCK (128), SKETCH_REPS (2 timing reps), SKETCH_MIN_WIN.
+"""
+
+import json
+import os
+import sys
+import time
+
+_N_DEV = int(os.environ.get("PYPARDIS_PROBE_DEVICES", "8"))
+if os.environ.get("PYPARDIS_PROBE_PLATFORM") != "native":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={_N_DEV}"
+        ).strip()
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+if os.environ.get("PYPARDIS_PROBE_PLATFORM") != "native":
+    jax.config.update("jax_platforms", "cpu")
+    if "jax_num_cpu_devices" in jax.config._value_holders:
+        jax.config.update("jax_num_cpu_devices", _N_DEV)
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+from benchdata import ari_vs_truth  # noqa: E402
+
+SIGMA = 0.5  # ambient noise scale; every eps/separation derives from it
+MS = 10
+
+
+def _geometry(n, dim, n_centers=48, seed=0):
+    """Noise-dominated equidistant clusters; returns (X, truth, eps).
+
+    Centers are a scaled orthonormal basis (pairwise distance EXACTLY
+    ``3.5 * eps`` — comfortably past the out-gate's ``2 eps``
+    threshold plus its projection-tail margin, still far inside the
+    box-blind window) embedded along random ambient directions, plus
+    full-rank N(0, SIGMA^2) noise.  The noise floor sqrt(2) * SIGMA *
+    sqrt(dim) concentrates hard in high d, so ``eps`` at 1.06x the
+    floor makes every same-cluster pair a neighbor and no cross-cluster
+    point reachable — the DBSCAN oracle is the center assignment."""
+    rng = np.random.default_rng(seed)
+    eps = round(1.06 * SIGMA * np.sqrt(2.0 * dim), 2)
+    basis = np.linalg.qr(rng.normal(size=(dim, n_centers)))[0]
+    centers = (3.5 * eps / np.sqrt(2.0)) * basis.T
+    truth = rng.integers(0, n_centers, size=n)
+    X = centers[truth] + rng.normal(scale=SIGMA, size=(n, dim))
+    return X.astype(np.float32), truth, eps
+
+
+def _timed(fn, reps):
+    jax.block_until_ready(fn())
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _counts_sweep(n, dim, block, reps):
+    """Sketch on/off counts-pass walls + byte parity on one dim."""
+    from pypardis_tpu.ops.distances import neighbor_counts
+    from pypardis_tpu.ops.sketch import resolve_sketch
+    from pypardis_tpu.partition import spatial_order
+    from pypardis_tpu.utils import round_up
+
+    X, _truth, eps = _geometry(n, dim)
+    X = X[spatial_order(X - X.mean(axis=0))]
+    cap = round_up(n, block)
+    pts = np.zeros((cap, dim), np.float32)
+    pts[:n] = X
+    pts = jnp.asarray(pts)
+    mask = jnp.arange(cap) < n
+
+    # Below the SKETCH_MIN_D auto gate (d=64) "auto" resolves to 0 —
+    # pin the same d/4 width explicitly there so the on/off parity
+    # sweep still exercises the gate at every probed dim, and record
+    # that auto would have kept it off.
+    sk_auto = resolve_sketch("auto", dim)
+    sk = sk_auto or resolve_sketch(max(dim // 4, 1), dim)
+    dt_off = _timed(
+        lambda: neighbor_counts(pts, eps, mask, block=block, sketch=0),
+        reps,
+    )
+    dt_on = _timed(
+        lambda: neighbor_counts(
+            pts, eps, mask, block=block, sketch=sk
+        )[0],
+        reps,
+    )
+    c_off = np.asarray(
+        neighbor_counts(pts, eps, mask, block=block, sketch=0)
+    )
+    c_on, bstats = neighbor_counts(
+        pts, eps, mask, block=block, sketch=sk
+    )
+    assert np.array_equal(c_off, np.asarray(c_on)), (
+        f"sketch counts diverge from exact at d={dim} (k={sk})"
+    )
+    band_pairs, rescored = [int(v) for v in np.asarray(bstats)]
+    win = dt_off / max(dt_on, 1e-9)
+    print(
+        f"counts d={dim:4d}: off={dt_off:.3f}s on={dt_on:.3f}s "
+        f"(k={sk}) win={win:.2f}x band_pairs={band_pairs} "
+        f"rescored_tiles={rescored}",
+        file=sys.stderr,
+    )
+    return {
+        "dim": dim,
+        "eps": eps,
+        "sketch_k": sk,
+        "auto_on": sk_auto > 0,
+        "counts_off_s": round(dt_off, 4),
+        "counts_on_s": round(dt_on, 4),
+        "win": round(win, 3),
+        "band_pairs": band_pairs,
+        "rescored_tiles": rescored,
+        "counts_match": True,
+    }
+
+
+def main() -> None:
+    from pypardis_tpu import DBSCAN
+    from pypardis_tpu.ops.labels import densify_labels
+    from pypardis_tpu.parallel import default_mesh
+    from pypardis_tpu.parallel.sharded import _canonicalize_roots
+
+    n = int(os.environ.get("SKETCH_N", 16384))
+    parity_n = int(os.environ.get("SKETCH_PARITY_N", 4096))
+    dims = [
+        int(d)
+        for d in os.environ.get("SKETCH_DIMS", "64,512").split(",")
+    ]
+    block = int(os.environ.get("SKETCH_BLOCK", 128))
+    reps = int(os.environ.get("SKETCH_REPS", 2))
+    min_win = float(os.environ.get("SKETCH_MIN_WIN", 1.25))
+    n_dev = min(_N_DEV, jax.device_count())
+    mesh = default_mesh(n_dev)
+
+    per_dim = [_counts_sweep(n, d, block, reps) for d in sorted(dims)]
+    head = per_dim[-1]
+    assert head["auto_on"] and head["sketch_k"] > 0, (
+        f"auto sketch resolved to 0 at d={head['dim']} — the probe's "
+        f"largest dim must sit above the SKETCH_MIN_D gate"
+    )
+    assert head["win"] >= min_win, (
+        f"counts-pass win {head['win']}x at d={head['dim']} below the "
+        f"{min_win}x gate"
+    )
+
+    # -- route parity at the largest dim ------------------------------
+    dim = head["dim"]
+    X, truth, eps = _geometry(parity_n, dim)
+    kw = dict(eps=eps, min_samples=MS, block=block)
+    fits = {}
+    for route, extra in (
+        ("fused", dict(mesh=default_mesh(1))),
+        ("kd", dict(mesh=mesh, max_partitions=n_dev)),
+        ("global_morton", dict(mesh=mesh, mode="global_morton")),
+    ):
+        for sk in ("auto", 0):
+            m = DBSCAN(sketch=sk, **kw, **extra)
+            m.fit(X)
+            fits[(route, sk)] = m
+
+    # The fused engine numbers clusters Morton-first; renumber to the
+    # distributed family's min-core-gid canon before the byte compare.
+    def canon(route, sk):
+        m = fits[(route, sk)]
+        labs = np.asarray(m.labels_)
+        if route == "fused":
+            labs = densify_labels(_canonicalize_roots(
+                labs, np.asarray(m.core_sample_mask_)
+            ))
+        return labs
+
+    ref = canon("global_morton", 0)
+    for key in fits:
+        labs = canon(*key)
+        if not np.array_equal(ref, labs):
+            print(
+                f"sketch probe FAILED: labels diverge on route={key[0]}"
+                f" sketch={key[1]}", file=sys.stderr,
+            )
+            sys.exit(1)
+
+    gm_on = fits[("global_morton", "auto")]
+    rep = gm_on.report()
+    sh, comp = rep["sharding"], rep["compute"]
+    bytes_sketch = int(sh.get("boundary_tile_bytes", 0))
+    bytes_box = int(sh.get("boundary_bytes_box", bytes_sketch))
+    if bytes_sketch > bytes_box:
+        print(
+            f"sketch probe FAILED: GM boundary bytes grew under the "
+            f"sketch send gate ({bytes_sketch} > {bytes_box})",
+            file=sys.stderr,
+        )
+        sys.exit(1)
+    assert int(comp["sketch_k"]) == head["sketch_k"], (
+        "GM fit's resolved sketch_k disagrees with the kernel sweep's"
+    )
+
+    row = {
+        "metric": "sketch_prefilter_win",
+        "value": head["win"],
+        "unit": "x",
+        "schema": "pypardis_tpu/sketch@1",
+        "n": n,
+        "parity_n": parity_n,
+        "dim": dim,
+        "dims": sorted(dims),
+        "block": block,
+        "eps": head["eps"],
+        "sketch_k": head["sketch_k"],
+        "sketch_band_fraction": float(comp["band_fraction"]),
+        "per_dim": per_dim,
+        "routes": ["fused", "kd", "global_morton"],
+        "labels_match": True,
+        "boundary_bytes_sketch": bytes_sketch,
+        "boundary_bytes_box": bytes_box,
+        "ari_vs_truth": round(
+            ari_vs_truth(gm_on.labels_, truth), 4
+        ),
+        "telemetry": rep,
+    }
+    print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,304 @@
+"""Sketch-prefiltered high-d distance pass (ISSUE 17).
+
+The contract under test: ``sketch=k`` / ``"auto"`` classifies tile
+pairs in a seeded k-dim random-projection space against ``eps^2 +-
+band`` and only in-band tiles rerun the UNCHANGED exact full-d kernel
+— so labels and counts are BYTE-IDENTICAL to the unsketched pass for
+ANY k (``np.array_equal``, not ARI), across the XLA scan kernels, the
+Pallas pair-list kernels (interpret mode), the fused engine, the KD
+owner-computes mesh, and global-Morton — where the sketch-space send
+gate may only SHRINK the boundary ring.  Plus the certified sandwich
+itself, the resolution policy (d/4, min-d gate, cityblock off), and
+construction-time spec validation.
+"""
+
+import functools
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pypardis_tpu import DBSCAN
+from pypardis_tpu.ops.labels import dbscan_fixed_size
+from pypardis_tpu.ops.sketch import (
+    SKETCH_MAX_K,
+    SKETCH_MIN_K,
+    auto_k,
+    check_sketch_spec,
+    jl_band,
+    resolve_sketch,
+    sketch_gate_band,
+    sketch_matrix,
+    sketch_slab,
+)
+from pypardis_tpu.parallel import default_mesh, staging
+
+SIGMA = 0.5
+MS = 5
+
+
+@pytest.fixture(autouse=True)
+def _fresh_staging():
+    staging.clear()
+    yield
+    staging.clear()
+
+
+def _noise_dominated(n, dim, n_centers=8, seed=0):
+    """The sketch's target regime (scripts/sketch_probe.py geometry):
+    equidistant centers on a scaled orthonormal latent basis + full-rank
+    noise whose floor dominates every coordinate — axis-aligned tile
+    boxes go blind while pairwise distances stay separated."""
+    rng = np.random.default_rng(seed)
+    eps = round(1.06 * SIGMA * np.sqrt(2.0 * dim), 2)
+    basis = np.linalg.qr(rng.normal(size=(dim, n_centers)))[0]
+    centers = (3.5 * eps / np.sqrt(2.0)) * basis.T
+    truth = rng.integers(0, n_centers, size=n)
+    X = centers[truth] + rng.normal(scale=SIGMA, size=(n, dim))
+    return X.astype(np.float32), eps
+
+
+# -- spec validation and resolution policy ------------------------------
+
+
+def test_spec_validation():
+    assert check_sketch_spec(None) is None
+    assert check_sketch_spec("auto") == "auto"
+    assert check_sketch_spec("off") == 0
+    assert check_sketch_spec(0) == 0
+    assert check_sketch_spec("32") == 32
+    assert check_sketch_spec(np.int64(8)) == 8
+    for bad in ("weird", -1, 1.5, True, [16]):
+        with pytest.raises((ValueError, TypeError)):
+            check_sketch_spec(bad)
+    with pytest.raises(ValueError, match="sketch"):
+        DBSCAN(eps=0.3, min_samples=5, sketch="sometimes")
+
+
+def test_resolve_policy():
+    # auto gates on dimensionality: off below SKETCH_MIN_D...
+    assert resolve_sketch("auto", 64) == 0
+    # ... and d/4 above it (the measured ratio — d/8 LOST on the
+    # sketch's own target geometry, see ops/sketch.py:auto_k).
+    assert resolve_sketch("auto", 512) == 512 // 4 == auto_k(512)
+    # clamped to [SKETCH_MIN_K, SKETCH_MAX_K] ...
+    assert auto_k(2048) == SKETCH_MAX_K
+    assert auto_k(130) == max(SKETCH_MIN_K, 130 // 4)
+    # ... and an explicit pin never exceeds d // 2 (the residual split
+    # degenerates at k = d) but DOES apply below the auto min-d gate.
+    assert resolve_sketch(500, 64) == 32
+    assert resolve_sketch(16, 64) == 16
+    # squared-euclidean discipline only.
+    assert resolve_sketch("auto", 512, metric="cityblock") == 0
+    assert resolve_sketch(64, 512, metric="cityblock") == 0
+    assert resolve_sketch(0, 512) == 0
+
+
+def test_resolve_min_d_env(monkeypatch):
+    monkeypatch.setenv("PYPARDIS_SKETCH_MIN_D", "32")
+    assert resolve_sketch("auto", 64) == SKETCH_MIN_K
+
+
+# -- the projection matrix and the certified sandwich -------------------
+
+
+def test_matrix_deterministic_and_orthonormal():
+    q, eta = sketch_matrix(256, 64, seed=7)
+    assert q.shape == (256, 64) and q.dtype == np.float32
+    # f32 QR output: defect far below the gate band's 4*eta*s^2 term
+    # ever mattering on unit-scale frames.
+    assert eta < 1e-4
+    gram = q.astype(np.float64).T @ q.astype(np.float64)
+    np.testing.assert_allclose(gram, np.eye(64), atol=1e-5)
+    q2, eta2 = sketch_matrix(256, 64, seed=7)
+    assert q2 is q and eta2 == eta  # lru-cached trace-time constant
+    q3, _ = sketch_matrix(256, 64, seed=8)
+    assert not np.array_equal(q, q3)
+
+
+def test_gate_sandwich_certified():
+    """t2 <= d2 <= t2 + 4 rx ry, within the certified band, on random
+    high-d data — the inequality the kernels' verdicts stand on."""
+    rng = np.random.default_rng(0)
+    d, k, n = 384, 96, 256
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    q, eta = sketch_matrix(d, k)
+    slab = np.asarray(sketch_slab(jnp.asarray(X.T), q))
+    assert slab.shape == (k + 1, n)
+    nmax = float(np.linalg.norm(X, axis=1).max())
+    band = float(sketch_gate_band(jnp.float32(nmax), d, k, eta))
+    i = rng.integers(0, n, size=500)
+    j = rng.integers(0, n, size=500)
+    d2 = np.sum((X[i] - X[j]) ** 2, axis=1, dtype=np.float64)
+    t2 = np.sum(
+        (slab[:, i] - slab[:, j]) ** 2, axis=0, dtype=np.float64
+    )
+    spread = 4.0 * slab[k, i].astype(np.float64) * slab[k, j]
+    assert np.all(t2 <= d2 + band)
+    assert np.all(d2 <= t2 + spread + band)
+
+
+def test_jl_band_is_predictive_only_and_monotone():
+    assert jl_band(64) > jl_band(256)
+    assert jl_band(64, delta=0.1) < jl_band(64, delta=0.001)
+
+
+# -- kernel-level byte parity -------------------------------------------
+
+
+def _counts(X, eps, block=128, **kw):
+    from pypardis_tpu.ops.distances import neighbor_counts
+    from pypardis_tpu.partition import spatial_order
+    from pypardis_tpu.utils import round_up
+
+    X = X[spatial_order(X - X.mean(axis=0))]
+    cap = round_up(len(X), block)
+    pts = np.zeros((cap, X.shape[1]), np.float32)
+    pts[: len(X)] = X
+    mask = jnp.arange(cap) < len(X)
+    return neighbor_counts(
+        jnp.asarray(pts), eps, mask, block=block, **kw
+    )
+
+
+def test_counts_byte_parity_across_widths():
+    X, eps = _noise_dominated(768, 256)
+    ref = np.asarray(_counts(X, eps, sketch=0))
+    assert ref.max() >= MS  # the geometry actually clusters
+    for sk in (16, 64, "auto"):
+        counts, stats = _counts(X, eps, sketch=sk)
+        np.testing.assert_array_equal(ref, np.asarray(counts), str(sk))
+        band_pairs, rescored = [int(v) for v in np.asarray(stats)]
+        # Shared-cluster tiles are in-band by construction (every true
+        # neighbor pair is), so the rescore path must actually fire —
+        # parity with zero rescores would mean the gate never ran.
+        assert band_pairs > 0 and rescored > 0, str(sk)
+
+
+def test_counts_byte_parity_mixed_precision():
+    """sketch composes with precision='mixed': the sketch gate decides
+    WHERE full-d arithmetic runs, mixed decides HOW — counts stay
+    byte-identical to the plain exact pass."""
+    X, eps = _noise_dominated(768, 256, seed=1)
+    ref = np.asarray(_counts(X, eps, sketch=0))
+    counts, _ = _counts(X, eps, sketch="auto", precision="mixed")
+    np.testing.assert_array_equal(ref, np.asarray(counts))
+
+
+def test_fixed_size_backend_parity(monkeypatch):
+    """dbscan_fixed_size sketch on/off parity on the XLA kernels AND
+    the Pallas pair-list kernels (interpret mode — CPU CI's view of
+    the Mosaic twins)."""
+    from pypardis_tpu.ops import pallas_kernels as pk
+
+    X, eps = _noise_dominated(512, 160, seed=2)
+    cap = 512
+    pts = np.zeros((cap, X.shape[1]), np.float32)
+    pts[: len(X)] = X - X.mean(axis=0)
+    mask = jnp.arange(cap) < len(X)
+
+    def fit(backend, sketch):
+        out = dbscan_fixed_size(
+            jnp.asarray(pts), eps, MS, jnp.asarray(mask), block=128,
+            backend=backend, sketch=sketch,
+        )
+        return [np.asarray(o) for o in out]
+
+    l_ref, c_ref, _ = fit("xla", 0)
+    assert l_ref.max() >= 0
+    l_on, c_on, ps_on = fit("xla", "auto")
+    np.testing.assert_array_equal(l_ref, l_on)
+    np.testing.assert_array_equal(c_ref, c_on)
+    assert ps_on[3] > 0  # sketch-band pairs counted in the stats slab
+
+    monkeypatch.setattr(
+        pk, "neighbor_counts_pallas",
+        functools.partial(pk.neighbor_counts_pallas, interpret=True),
+    )
+    monkeypatch.setattr(
+        pk, "min_neighbor_label_pallas",
+        functools.partial(pk.min_neighbor_label_pallas, interpret=True),
+    )
+    for sketch in (0, "auto"):
+        l_p, c_p, _ = fit("pallas", sketch)
+        np.testing.assert_array_equal(l_ref, l_p, str(sketch))
+        np.testing.assert_array_equal(c_ref, c_p, str(sketch))
+
+
+# -- driver-level byte parity + telemetry -------------------------------
+
+
+def _route_kw():
+    return (
+        ("fused", dict(mesh=default_mesh(1))),
+        ("kd", dict(mesh=default_mesh(8), max_partitions=8)),
+        ("global_morton", dict(mesh=default_mesh(8),
+                               mode="global_morton")),
+    )
+
+
+def test_routes_sketch_on_off_byte_parity():
+    X, eps = _noise_dominated(1024, 160, seed=3)
+    for route, extra in _route_kw():
+        fits = {}
+        for sk in (0, "auto"):
+            staging.clear()
+            m = DBSCAN(eps=eps, min_samples=MS, block=128,
+                       sketch=sk, **extra)
+            m.fit(X)
+            fits[sk] = m
+        np.testing.assert_array_equal(
+            np.asarray(fits[0].labels_),
+            np.asarray(fits["auto"].labels_), err_msg=route,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(fits[0].core_sample_mask_),
+            np.asarray(fits["auto"].core_sample_mask_), err_msg=route,
+        )
+        comp_on = fits["auto"].report()["compute"]
+        assert comp_on["sketch_k"] == auto_k(160), route
+        assert fits[0].report()["compute"]["sketch_k"] == 0, route
+
+
+def test_global_morton_boundary_ring_only_shrinks():
+    """The sketch-space send gate ANDs with the full-d box test, so
+    the GM boundary ring can only get SMALLER — and with sketch off
+    the box twins equal the primary stats exactly."""
+    X, eps = _noise_dominated(1024, 160, seed=4)
+    kw = dict(eps=eps, min_samples=MS, block=128,
+              mesh=default_mesh(8), mode="global_morton")
+    staging.clear()
+    m_off = DBSCAN(sketch=0, **kw)
+    m_off.fit(X)
+    sh = m_off.report()["sharding"]
+    assert sh["sent_tiles"] == sh["sent_tiles_box"]
+    assert sh["boundary_tile_bytes"] == sh["boundary_bytes_box"]
+
+    staging.clear()
+    m_on = DBSCAN(sketch="auto", **kw)
+    m_on.fit(X)
+    sh = m_on.report()["sharding"]
+    assert sh["sent_tiles"] <= sh["sent_tiles_box"]
+    assert sh["boundary_tile_bytes"] <= sh["boundary_bytes_box"]
+    np.testing.assert_array_equal(
+        np.asarray(m_off.labels_), np.asarray(m_on.labels_)
+    )
+
+
+def test_env_knob_resolves_like_constructor(monkeypatch):
+    """PYPARDIS_SKETCH is the knob's env spelling; the constructor pin
+    wins over it and restores the token after the fit."""
+    import jax
+
+    monkeypatch.setenv("PYPARDIS_SKETCH", "0")
+    X, eps = _noise_dominated(512, 160, seed=5)
+    jax.clear_caches()  # trace-time read, like PYPARDIS_DISPATCH
+    try:
+        m = DBSCAN(eps=eps, min_samples=MS, block=128, sketch="auto",
+                   mesh=default_mesh(1))
+        m.fit(X)
+        assert m.report()["compute"]["sketch_k"] == auto_k(160)
+        assert os.environ["PYPARDIS_SKETCH"] == "0"  # token restored
+    finally:
+        jax.clear_caches()

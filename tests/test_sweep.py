@@ -403,3 +403,43 @@ def test_fused_device_route_parity(blobs, monkeypatch):
         np.testing.assert_array_equal(
             res.labels(eps), ref.labels(eps), err_msg=str(eps)
         )
+
+
+def test_sweep_on_sketch_model_stays_exact(monkeypatch):
+    """A sketch-enabled model's sweep (ISSUE 17): the cached
+    neighbor-pair graph is an EXACT full-d artifact (the emission pass
+    never sketches — a prefilter verdict cannot be re-thresholded at a
+    smaller eps), so sweep results are byte-identical whether the
+    model carries sketch='auto' or sketch=0, at a dimensionality where
+    the fit path WOULD sketch (d >= SKETCH_MIN_D)."""
+    rng = np.random.default_rng(11)
+    dim, n = 160, 900
+    basis = np.linalg.qr(rng.normal(size=(dim, 4)))[0]
+    eps = round(1.06 * 0.5 * np.sqrt(2.0 * dim), 2)
+    centers = (3.5 * eps / np.sqrt(2.0)) * basis.T
+    X = (
+        centers[rng.integers(0, 4, size=n)]
+        + rng.normal(scale=0.5, size=(n, dim))
+    ).astype(np.float32)
+    eps_list = [round(0.8 * eps, 2), eps]
+    kw = dict(block=128, mesh=default_mesh(8))
+
+    from pypardis_tpu.ops.sketch import resolve_sketch
+
+    assert resolve_sketch("auto", dim) > 0  # the fit path would sketch
+
+    staging.clear()
+    ref = DBSCAN(eps=eps, min_samples=5, sketch=0, **kw).sweep(
+        X, eps_list
+    )
+    staging.clear()
+    m = DBSCAN(eps=eps, min_samples=5, sketch="auto", **kw)
+    res = m.sweep(X, eps_list)
+    assert res.stats["distance_passes"] == 1
+    for e in eps_list:
+        np.testing.assert_array_equal(
+            res.labels(e), ref.labels(e), err_msg=str(e)
+        )
+        np.testing.assert_array_equal(
+            res.core(e), ref.core(e), err_msg=str(e)
+        )

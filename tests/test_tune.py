@@ -183,7 +183,8 @@ def test_explain_names_every_knob(blobs):
     p = probe_dataset(blobs, 0.4, devices=8, backend="cpu")
     plan = plan_fit(p, {}, harvest_corpus(roots=[_REPO], local=""))
     text = plan.explain()
-    for knob in ("mode", "block", "precision", "merge", "dispatch"):
+    for knob in ("mode", "block", "precision", "merge", "dispatch",
+                 "sketch"):
         assert knob in text
     assert "predicted" in text and "probe" in text
     # round-trips through the checkpoint dict form
@@ -374,3 +375,86 @@ def test_non_auto_unchanged(blobs):
     assert m.merge == "auto" and m.mode == "auto"
     m.fit(blobs)
     assert "tune" not in m.report()
+
+
+# -- sketch knob (ISSUE 17) ---------------------------------------------
+
+
+def _high_d(n=1536, dim=512, n_centers=8, seed=0):
+    """The sketch prefilter's target regime (scripts/sketch_probe.py):
+    noise-dominated high-d clusters whose axis-aligned tile boxes are
+    blind while pairwise distances stay separated."""
+    rng = np.random.default_rng(seed)
+    eps = round(1.06 * 0.5 * np.sqrt(2.0 * dim), 2)
+    basis = np.linalg.qr(rng.normal(size=(dim, n_centers)))[0]
+    centers = (3.5 * eps / np.sqrt(2.0)) * basis.T
+    X = (
+        centers[rng.integers(0, n_centers, size=n)]
+        + rng.normal(scale=0.5, size=(n, dim))
+    ).astype(np.float32)
+    return X, eps
+
+
+def test_plan_sketch_on_at_high_d_off_at_low_d(blobs):
+    from pypardis_tpu.ops.sketch import auto_k
+
+    X, eps = _high_d()
+    p = probe_dataset(X, eps, devices=8, backend="cpu")
+    assert p.sketch_k_auto == auto_k(512)
+    assert 0.0 < p.pair_fraction_in_sketch_band < 1.0
+    plan = plan_fit(p, {}, [])
+    assert plan.config["sketch"] == p.sketch_k_auto
+    assert "sketch" in plan.knob_reasons
+
+    # Low d: auto resolves to off and the planner must not invent one.
+    p_lo = probe_dataset(blobs, 0.4, devices=8, backend="cpu")
+    assert p_lo.sketch_k_auto == 0
+    assert plan_fit(p_lo, {}, []).config["sketch"] == 0
+
+
+def test_plan_sketch_pin_conflict_recorded():
+    """A user pin the cost model disagrees with: the pin WINS and the
+    disagreement lands in the plan's rule trace (the same discipline
+    as every other pinned knob)."""
+    X, eps = _high_d()
+    p = probe_dataset(X, eps, devices=8, backend="cpu")
+    plan = plan_fit(p, {"sketch": 0}, [])
+    assert plan.config["sketch"] == 0  # the user wins
+    assert any(
+        "cost model preferred sketch=" in r for r in plan.rules
+    )
+    assert "pinned" in plan.knob_reasons["sketch"]
+
+
+def test_plan_sketch_off_for_non_euclidean():
+    X, eps = _high_d(n=512)
+    p = probe_dataset(X, eps, devices=8, backend="cpu")
+    plan = plan_fit(p, {}, [], metric="cityblock")
+    assert plan.config["sketch"] == 0
+
+
+def test_auto_fit_plans_and_applies_sketch_high_d():
+    """DBSCAN(auto=True) end-to-end at d=160: the plan carries a
+    positive sketch width, the fit applies it (compute telemetry),
+    and labels stay byte-identical to the explicit sketch=0 config —
+    the knob's label-safety is what makes it plannable at all."""
+    from pypardis_tpu.ops.sketch import auto_k
+
+    X, eps = _high_d(n=768, dim=160)
+    staging.clear()
+    m = DBSCAN(eps=eps, min_samples=5, auto=True, block=128,
+               mesh=default_mesh(8))
+    m.fit(X)
+    rep = m.report()
+    planned = rep["tune"]["plan"]["config"]["sketch"]
+    assert planned == auto_k(160)
+    assert rep["compute"]["sketch_k"] == planned
+
+    staging.clear()
+    cfg = dict(rep["tune"]["plan"]["config"])
+    ref = _explicit(
+        X, m, {**cfg, "sketch": 0}, mesh=default_mesh(8), sketch=0
+    )
+    np.testing.assert_array_equal(
+        np.asarray(m.labels_), np.asarray(ref.labels_)
+    )
