@@ -7,8 +7,8 @@ PY ?= python
 	partition-probe serve-probe live-probe ingest-probe \
 	global-morton-probe fault-probe bench-diff flight-check \
 	northstar northstar-smoke streammem-probe sort-probe \
-	kernel-probe sweep-probe tune-probe sketch-probe monitor \
-	monitor-probe demo clean
+	kernel-probe sweep-probe hierarchy-probe tune-probe \
+	sketch-probe monitor monitor-probe demo clean
 
 all: native test
 
@@ -63,8 +63,8 @@ bench:
 # level builder's mp-doubling cost ratio exceeds 1.5x).
 bench-smoke: lint partition-probe serve-probe live-probe ingest-probe \
 		global-morton-probe fault-probe bench-diff flight-check \
-		northstar-smoke kernel-probe sweep-probe tune-probe \
-		sketch-probe monitor-probe
+		northstar-smoke kernel-probe sweep-probe hierarchy-probe \
+		tune-probe sketch-probe monitor-probe
 	JAX_PLATFORMS=cpu BENCH_N=2000 BENCH_DIM=4 BENCH_REPS=1 \
 	BENCH_DEV_REPS=1 $(PY) bench.py \
 	| $(PY) scripts/bench_diff.py --annotate --baseline-dir . \
@@ -90,6 +90,20 @@ kernel-probe:
 # Acceptance-scale run: `SWEEP_N=100000 make sweep-probe`.
 sweep-probe:
 	$(PY) scripts/sweep_probe.py \
+	| $(PY) scripts/bench_diff.py --annotate --baseline-dir . \
+	| $(PY) scripts/check_bench_json.py --require-diff
+
+# Density-hierarchy probe (ISSUE 18): the eps-free path — mutual-
+# reachability MST + stability-condensed tree over the cached pair
+# graph — timing an 8-rung sweep(X, "auto") ladder against 8 solo
+# fits at the same eps values.  Gates: distance_passes == 1 for the
+# whole ladder, ladder wall <= 0.2x the solo sum (amortization >= 5),
+# per-rung byte parity + ARI == 1.0, boruvka_rounds <= round_cap, and
+# mst_edges == n_live - n_components; the schema'd hierarchy@1 row
+# rides the bench_diff cross-round gate.  Acceptance-scale run:
+# `HIER_N=100000 make hierarchy-probe`.
+hierarchy-probe:
+	$(PY) scripts/hierarchy_probe.py \
 	| $(PY) scripts/bench_diff.py --annotate --baseline-dir . \
 	| $(PY) scripts/check_bench_json.py --require-diff
 

@@ -594,9 +594,10 @@ class DBSCAN:
 
     def __init__(
         self,
-        eps: float = 0.5,
+        eps: Optional[float] = 0.5,
         min_samples: int = 5,
         metric="euclidean",
+        min_cluster_size: Optional[int] = None,
         max_partitions: Optional[int] = None,
         split_method: str = "min_var",
         block: Optional[int] = None,
@@ -676,9 +677,21 @@ class DBSCAN:
             check_kernel_backend, check_metric, check_precision,
         )
 
-        validate_params(eps, min_samples)
-        self.eps = float(eps)
+        # eps=None opts into the density-hierarchy path (ops.hierarchy):
+        # fit() selects eps by HDBSCAN*'s stability rule and exposes it
+        # as ``eps_``; a concrete eps still validates loudly.
+        validate_params(eps, min_samples, allow_none_eps=True)
+        self.eps = None if eps is None else float(eps)
         self.min_samples = int(min_samples)
+        # Condensation granularity of the hierarchy path; None defers
+        # to max(min_samples, 2) (the HDBSCAN* default coupling).
+        if min_cluster_size is not None and int(min_cluster_size) < 2:
+            raise ValueError(
+                f"min_cluster_size must be >= 2, got {min_cluster_size}"
+            )
+        self.min_cluster_size = (
+            None if min_cluster_size is None else int(min_cluster_size)
+        )
         self.metric = metric
         # Canonical metric name ("euclidean"/"cityblock"/"cosine") —
         # cosine is a DRIVER metric (unit-normalize + eps remap onto
@@ -733,6 +746,12 @@ class DBSCAN:
         # Amortized-sweep telemetry of the most recent sweep() — the
         # ``sweep`` block of report().
         self._sweep_stats: Optional[Dict] = None
+        # Density-hierarchy state (eps=None fits / sweep("auto")): the
+        # ``hierarchy`` block of report(), and the stability-selected
+        # eps in the USER frame — the value predict/serving runs at
+        # when the model was fitted with eps=None.
+        self._hier_stats: Optional[Dict] = None
+        self.eps_: Optional[float] = None
         # Serving state (pypardis_tpu.serve): the cached query engine
         # and, for checkpoint-loaded models, the persisted core-point
         # coordinates the index builds from.
@@ -759,12 +778,30 @@ class DBSCAN:
         ``metric='haversine'`` the CHORD ``2 sin(eps / 2)`` of the
         great-circle angle (monotone on [0, pi]); else eps unchanged.
         The serving index builds against this value
-        (:func:`pypardis_tpu.serve.index.build_index`)."""
+        (:func:`pypardis_tpu.serve.index.build_index`).  An eps=None
+        model resolves to the fitted ``eps_`` — the stability-selected
+        cut — so predict/serving run at exactly the eps the labels were
+        computed at."""
+        eps = self._effective_eps()
         if self._metric_norm == "cosine":
-            return float(np.sqrt(2.0 * self.eps))
+            return float(np.sqrt(2.0 * eps))
         if self._metric_norm == "haversine":
-            return float(2.0 * np.sin(self.eps / 2.0))
-        return float(self.eps)
+            return float(2.0 * np.sin(eps / 2.0))
+        return float(eps)
+
+    def _effective_eps(self) -> float:
+        """``self.eps``, or the stability-selected ``eps_`` of an
+        eps=None model (hierarchy path).  Raises before the first fit —
+        there is no eps to serve at until the hierarchy selects one."""
+        if self.eps is not None:
+            return float(self.eps)
+        if self.eps_ is not None:
+            return float(self.eps_)
+        raise RuntimeError(
+            "this model was constructed with eps=None and has not been "
+            "fitted yet — fit() selects eps by the stability rule and "
+            "exposes it as eps_"
+        )
 
     def _kernel_frame(self):
         """Context manager swapping ``(eps, metric)`` to the kernel
@@ -787,7 +824,11 @@ class DBSCAN:
         @contextlib.contextmanager
         def swap():
             saved = (self.eps, self.metric)
-            self.eps, self.metric = self.kernel_eps, "euclidean"
+            # eps=None hierarchy bodies pick their own kernel-frame
+            # ceiling (_hier_ceiling) — only the metric swap matters;
+            # every sweep/hierarchy consumer takes eps explicitly.
+            eps_k = None if saved[0] is None else self.kernel_eps
+            self.eps, self.metric = eps_k, "euclidean"
             try:
                 yield
             finally:
@@ -805,16 +846,31 @@ class DBSCAN:
         points — the frame every downstream surface, serving included,
         shares) and eps remaps to ``sqrt(2 * eps)`` for the L2 kernels;
         labels are exactly the cosine-threshold clustering.
+
+        ``eps=None`` models take the density-hierarchy path instead:
+        one distance pass at a data-derived ceiling, the
+        mutual-reachability MST, and HDBSCAN*'s stability rule select
+        the flat cut (``eps_``) — see :meth:`_fit_hierarchy`.
         """
+        if self.eps is None:
+            if resume is not None:
+                raise ValueError(
+                    "resume/checkpointing is not supported on the "
+                    "eps=None hierarchy path"
+                )
+            return self._fit_hierarchy(data)
         if self._metric_norm in ("cosine", "haversine"):
             keys, points = _as_keys_points(data)
             with self._kernel_frame():
                 self._train_impl(
                     (keys, self._driver_frame_rows(points)), resume
                 )
+            self.eps_ = float(self.eps)
             return self
 
-        return self._train_impl(data, resume)
+        self._train_impl(data, resume)
+        self.eps_ = float(self.eps)
+        return self
 
     def _driver_frame_rows(self, points) -> np.ndarray:
         """Project raw input rows into the driver metric's kernel
@@ -907,6 +963,10 @@ class DBSCAN:
         self._live_model = None
         self._live_stats = None
         self._fit_generation += 1
+        # A concrete-eps fit supersedes any earlier hierarchy fit: the
+        # fitted eps IS the model's eps, and a stale hierarchy block
+        # would describe the previous clustering.
+        self._hier_stats = None
 
         if len(points) == 0:
             self.labels_ = np.empty(0, np.int32)
@@ -1106,10 +1166,28 @@ class DBSCAN:
         from .utils.profiling import PhaseTimer
         from .utils.validate import check_metric
 
-        eps_arr = np.atleast_1d(np.asarray(eps_list, np.float64))
-        if eps_arr.ndim != 1 or len(eps_arr) == 0:
-            raise ValueError("eps_list must be a non-empty 1-D sequence")
-        eps_vals = [float(e) for e in eps_arr]
+        # eps_list="auto" extracts the top-stability eps ladder from the
+        # density hierarchy instead of requiring a user grid — same ONE
+        # distance pass, per-rung labels from dendrogram cuts (no
+        # per-config fixpoint at all).
+        auto_ladder = isinstance(eps_list, str)
+        if auto_ladder and eps_list != "auto":
+            raise ValueError(
+                f"eps_list must be a sequence of eps values or the "
+                f"string 'auto', got {eps_list!r}"
+            )
+        if auto_ladder:
+            eps_vals = None
+        else:
+            eps_arr = np.atleast_1d(np.asarray(eps_list, np.float64))
+            if eps_arr.ndim != 1 or len(eps_arr) == 0:
+                raise ValueError(
+                    "eps_list must be a non-empty 1-D sequence"
+                )
+            eps_vals = [float(e) for e in eps_arr]
+            for e in eps_vals:
+                validate_params(e, 1)
+                check_metric(self.metric, e)
         if min_samples_list is None:
             ms_vals = [int(self.min_samples)]
         else:
@@ -1120,12 +1198,12 @@ class DBSCAN:
                     "sequence"
                 )
             ms_vals = [int(m) for m in ms_arr]
-        for e in eps_vals:
-            validate_params(e, 1)
-            check_metric(self.metric, e)
         for m in ms_vals:
-            validate_params(eps_vals[0], m)
-        configs = [(e, m) for e in eps_vals for m in ms_vals]
+            validate_params(1.0, m)
+        configs = (
+            None if auto_ladder
+            else [(e, m) for e in eps_vals for m in ms_vals]
+        )
 
         keys, points = _as_keys_points(data)
         if self._metric_norm in ("cosine", "haversine"):
@@ -1149,21 +1227,29 @@ class DBSCAN:
         self.neighbors = None
         self.cluster_dict = None
         self._sweep_stats = None
+        self._hier_stats = None
         timer = PhaseTimer()
         sampler = obs.ResourceSampler(rec).start()
         try:
             with obs.use_recorder(rec):
                 _check_finite(points)
                 with self._kernel_frame():
-                    labels, core, per_cfg, sweep = self._sweep_run(
-                        points, configs, timer
-                    )
+                    if auto_ladder:
+                        labels, core, per_cfg, sweep = (
+                            self._sweep_auto_run(points, ms_vals, timer)
+                        )
+                        configs = [tuple(c) for c in sweep["configs"]]
+                    else:
+                        labels, core, per_cfg, sweep = self._sweep_run(
+                            points, configs, timer
+                        )
         finally:
             sampler.stop()
         self._result_cache = None
         # Model surface from the LAST config (a sweep leaves a fitted
         # model, like a fit at that config would).
         last = configs[-1]
+        self.eps_ = float(last[0])
         self.labels_ = labels[last]
         self.core_sample_mask_ = core[last]
         self.metrics_.update(timer.as_dict())
@@ -1258,7 +1344,7 @@ class DBSCAN:
             )
             return self._sweep_refit(points, configs, timer)
 
-        relabel_fn, gstats = relabel
+        relabel_fn, gstats, _ghandle = relabel
         import time as _time
 
         labels_out, core_out, per_cfg = {}, {}, []
@@ -1456,10 +1542,27 @@ class DBSCAN:
         edge_stats = jnp.asarray(stats[:2], jnp.int32)
         metric_norm = _norm_metric(metric_k)
 
+        # Numpy twin of _pipeline_pack's owner unscatter (slot-space
+        # roots/core -> global rows) — byte-identical wire semantics,
+        # shared by the CPU relabel and the hierarchy path's finalize.
+        owner_np = np.asarray(owner)
+        mask_np = np.asarray(mask_k)
+        capk = len(mask_np)
+
+        def _unscatter(roots_s, core_s):
+            valid = roots_s >= 0
+            tgt = np.clip(roots_s, 0, capk - 1)
+            roots_gl = np.where(valid, owner_np[tgt], -1)
+            out = np.full(cap, -1, np.int32)
+            core_out = np.zeros(cap, bool)
+            sel = owner_np < cap
+            out[owner_np[sel]] = roots_gl[sel]
+            core_out[owner_np[sel]] = core_s[sel]
+            return out[:n], core_out[:n]
+
         if jax_backend_name() == "cpu":
-            # Host relabel in kernel-slot space + the numpy twin of
-            # _pipeline_pack's owner unscatter — byte-identical wire
-            # semantics, segmented reductions instead of XLA scatters.
+            # Host relabel in kernel-slot space — segmented reductions
+            # instead of XLA scatters.
             from .ops.labels import (
                 graph_dbscan_host,
                 graph_dbscan_host_prepare,
@@ -1468,23 +1571,13 @@ class DBSCAN:
             state = graph_dbscan_host_prepare(
                 np.asarray(gi), np.asarray(gj), np.asarray(dv)
             )
-            mask_np = np.asarray(mask_k)
-            owner_np = np.asarray(owner)
-            capk = len(mask_np)
 
             def relabel(eps_c, ms_c):
                 roots_s, core_s, passes = graph_dbscan_host(
                     state, mask_np, eps_c, ms_c, metric=metric_norm
                 )
-                valid = roots_s >= 0
-                tgt = np.clip(roots_s, 0, capk - 1)
-                roots_gl = np.where(valid, owner_np[tgt], -1)
-                out = np.full(cap, -1, np.int32)
-                core_out = np.zeros(cap, bool)
-                sel = owner_np < cap
-                out[owner_np[sel]] = roots_gl[sel]
-                core_out[owner_np[sel]] = core_s[sel]
-                return out[:n], core_out[:n], passes
+                out, core_out = _unscatter(roots_s, core_s)
+                return out, core_out, passes
         else:
 
             def relabel(eps_c, ms_c):
@@ -1506,7 +1599,15 @@ class DBSCAN:
             "n_partitions": 1,
             "owned_cap": cap,
         }
-        return relabel, gstats
+        # Graph handle for the hierarchy path: the slab in THIS route's
+        # id space (kernel slots) + the unscatter that maps slot-space
+        # labels back to input rows — the fused train()/sweep() wire
+        # semantics, so hierarchy cuts land byte-identical.
+        ghandle = {
+            "gi": gi, "gj": gj, "dv": dv, "mask": mask_np,
+            "n_ids": capk, "finalize": _unscatter,
+        }
+        return relabel, gstats, ghandle
 
     def _sweep_graph_kd(self, points, eps_max, timer, n_devices):
         """KD-route graph: partition + owner-computes slabs at eps_max
@@ -1529,8 +1630,11 @@ class DBSCAN:
             ]
             self.metrics_["partition_builder"] = part.builder
             self.bounding_boxes = part.bounding_boxes
+            # The graph's halo radius is eps_max (not the model eps —
+            # which is None on the hierarchy path): every config below
+            # the ceiling is covered by construction.
             self.expanded_boxes = {
-                l: b.expand(2 * self.eps)
+                l: b.expand(2 * eps_max)
                 for l, b in part.bounding_boxes.items()
             }
         with timer.phase("graph"):
@@ -1619,7 +1723,16 @@ class DBSCAN:
 
         gstats = dict(gstats, build_s=_time.perf_counter() - t_b
                       + gstats.get("build_s", 0.0))
-        return relabel, gstats
+        # Sharded-route graph handle: already in global-gid space with
+        # min-core-gid roots, so finalize is the identity slice.
+        ghandle = {
+            "gi": gi, "gj": gj, "dv": dv, "mask": np.ones(n, bool),
+            "n_ids": n,
+            "finalize": lambda lab, cor: (
+                np.asarray(lab[:n], np.int32), np.asarray(cor[:n], bool)
+            ),
+        }
+        return relabel, gstats, ghandle
 
     def _sweep_refit(self, points, configs, timer):
         """Label-safe degradation rung: k independent fits (the
@@ -1689,6 +1802,404 @@ class DBSCAN:
             "n_devices": int(self._n_devices()),
         }
         self.metrics_["n_partitions"] = 1
+        return labels_out, core_out, per_cfg, sweep
+
+    # -- density hierarchy (eps-free fits) --------------------------------
+
+    def _user_eps_from_kernel(self, eps_k: float) -> float:
+        """Kernel-frame eps -> user frame (inverse of ``kernel_eps``)."""
+        if self._metric_norm == "cosine":
+            return float(eps_k) ** 2 / 2.0
+        if self._metric_norm == "haversine":
+            return float(2.0 * np.arcsin(min(float(eps_k) / 2.0, 1.0)))
+        return float(eps_k)
+
+    def _hier_ceiling(self, points) -> float:
+        """The hierarchy's eps_max (KERNEL frame): the one distance
+        pass materializes the pair graph at this ceiling, and the
+        cached family is truncated there (root births clamp to it).
+
+        Resolution order: a concrete model eps (``sweep("auto")`` on a
+        fitted-eps model — the caller's ceiling by definition; note
+        this runs inside ``_kernel_frame``, so ``self.eps`` is already
+        remapped), then the ``PYPARDIS_HIER_EPS_MAX`` override (USER
+        frame), else a deterministic sample-kNN heuristic: 4x the 98th
+        percentile of the ``min_samples``-th-neighbor distance over a
+        strided ``PYPARDIS_HIER_SAMPLE``-row sample — an OVERestimate
+        of the true core distances (a sample is sparser than the full
+        set), so in-cluster MST edges stay below the ceiling.
+        """
+        if self.eps is not None:
+            return float(self.eps)
+        env = envreg.raw("PYPARDIS_HIER_EPS_MAX")
+        if env:
+            e_u = float(env)
+            validate_params(e_u, 1)
+            if self._metric_norm == "cosine":
+                return float(np.sqrt(2.0 * e_u))
+            if self._metric_norm == "haversine":
+                return float(2.0 * np.sin(e_u / 2.0))
+            return e_u
+        from .ops.distances import _norm_metric
+
+        km = _norm_metric(self.metric)
+        pts = np.asarray(points, np.float32)
+        n = len(pts)
+        s_max = max(
+            2, min(int(envreg.raw("PYPARDIS_HIER_SAMPLE", "2048")), n)
+        )
+        sample = pts[:: max(1, n // s_max)][:s_max]
+        s = len(sample)
+        k = min(max(self.min_samples, 2), s - 1)
+        if km == "cityblock":
+            dk = np.empty(s, np.float32)
+            for lo in range(0, s, 256):
+                hi = min(lo + 256, s)
+                d = np.abs(
+                    sample[lo:hi, None, :] - sample[None, :, :]
+                ).sum(-1)
+                dk[lo:hi] = np.partition(d, k, axis=1)[:, k]
+        else:
+            sq = (sample * sample).sum(-1)
+            d2 = np.maximum(
+                sq[:, None] + sq[None, :] - 2.0 * (sample @ sample.T),
+                0.0,
+            )
+            dk = np.sqrt(np.partition(d2, k, axis=1)[:, k])
+        ceil = 4.0 * float(np.quantile(dk.astype(np.float64), 0.98))
+        if self._metric_norm in ("cosine", "haversine"):
+            # Kernel eps is a unit-sphere chord length: past 2 every
+            # pair qualifies, which only inflates the pair graph.
+            ceil = min(ceil, 1.999)
+        return max(ceil, 1e-6)
+
+    def _hier_run(self, points, timer, ms: Optional[int] = None):
+        """Routing + graph build + hierarchy construction (kernel
+        frame) — the eps-free twin of ``_sweep_run``'s front half.
+
+        Returns a context dict: ``hier`` (the ``min_samples``
+        hierarchy), ``build`` (ms -> another Hierarchy over the SAME
+        prepared slab — core pass + MST only, no new distance work),
+        ``gh``/``gstats``/``run_mode``/``n_devices``/``eps_max_k``.
+        """
+        from .ops import hierarchy as _hier
+        from .ops.distances import _norm_metric
+        from .parallel import staging as _staging
+
+        ms = int(self.min_samples if ms is None else ms)
+        n = len(points)
+        n_devices = self._n_devices()
+        sharded = (
+            not _is_device_array(points)
+            and n_devices > 1
+            and n >= 2 * n_devices
+        )
+        eps_max = self._hier_ceiling(points)
+        _staging.begin_fit()
+        if sharded and self.mode == "global_morton":
+            run_mode = "global_morton"
+            _relabel, gstats, gh = self._sweep_graph_global(
+                points, eps_max, timer, run_mode, n_devices
+            )
+        elif sharded:
+            run_mode = "kd"
+            _relabel, gstats, gh = self._sweep_graph_kd(
+                points, eps_max, timer, n_devices
+            )
+        else:
+            run_mode = "fused"
+            n_devices = 1
+            _relabel, gstats, gh = self._sweep_graph_fused(
+                points, eps_max, timer
+            )
+        km = _norm_metric(self.metric)
+        eps_f = np.float32(eps_max)
+        thr_max = float(
+            eps_f * eps_f if km == "euclidean" else eps_f
+        )
+        with timer.phase("hierarchy"):
+            state = _hier.hierarchy_prepare(
+                np.asarray(gh["gi"]), np.asarray(gh["gj"]),
+                np.asarray(gh["dv"]),
+            )
+            cd2 = None
+            if jax_backend_name() != "cpu":
+                # Accelerator routes run the jitted k-th-smallest twin
+                # (bitwise the host values — pinned in tests).
+                import jax.numpy as jnp
+
+                cd2 = np.asarray(
+                    _hier.core_distances_device(
+                        jnp.asarray(gh["gi"]), jnp.asarray(gh["gj"]),
+                        jnp.asarray(gh["dv"]), jnp.asarray(gh["mask"]),
+                        ms,
+                    )
+                )
+
+            def build(ms_c: int):
+                return _hier.build_hierarchy(
+                    state, gh["mask"], gh["n_ids"], int(ms_c),
+                    kernel_metric=km,
+                    user_frame=self._metric_norm,
+                    thr_max=thr_max,
+                    min_cluster_size=self.min_cluster_size,
+                    cd2=cd2 if int(ms_c) == ms else None,
+                )
+
+            hier = build(ms)
+        return {
+            "hier": hier, "build": build, "gh": gh, "gstats": gstats,
+            "run_mode": run_mode, "n_devices": int(n_devices),
+            "eps_max_k": float(eps_max),
+        }
+
+    def _hier_no_refit(self, e: Exception) -> RuntimeError:
+        return RuntimeError(
+            f"the density-hierarchy path needs the cached pair graph "
+            f"and cannot degrade to per-config refits (there is no eps "
+            f"to refit at): {e}.  Raise PYPARDIS_SWEEP_MAX_PAIRS, or "
+            f"lower the graph ceiling via PYPARDIS_HIER_EPS_MAX."
+        )
+
+    def _fit_hierarchy(self, data) -> "DBSCAN":
+        """The eps=None fit: ONE distance pass, stability-selected eps.
+
+        Pair graph at a data-derived ceiling -> per-point core
+        distances -> mutual-reachability MST (Borůvka rounds) ->
+        dendrogram condensed by ``min_cluster_size`` -> HDBSCAN*'s
+        excess-of-mass rule picks the flat cut.  ``labels_`` are
+        byte-identical to a solo ``fit(eps_)`` on the same route, and
+        every step is deterministic given the data and env —
+        byte-reproducible across repeated fits.
+        """
+        import time as _time
+
+        from . import obs
+        from .ops import hierarchy as _hier
+        from .parallel.sharded import SweepGraphOverflow
+        from .utils.profiling import PhaseTimer
+        from .utils.retry import is_degradable_error
+
+        keys, points = _as_keys_points(data)
+        if self._metric_norm in ("cosine", "haversine"):
+            points = self._driver_frame_rows(points)
+        if len(points) == 0:
+            raise ValueError("eps=None fits need a non-empty dataset")
+        t0 = _time.perf_counter()
+        dispatch_token = None
+        sketch_token = None
+        self._tune_stats = None
+        if self.auto:
+            dispatch_token, sketch_token = self._plan_auto(points)
+        rec = obs.RunRecorder()
+        self._recorder = rec
+        self.metrics_ = {}
+        self._serve_engine = None
+        self._serve_core_points = None
+        self._live_model = None
+        self._live_stats = None
+        self._fit_generation += 1
+        self._keys = keys
+        self.data = points
+        self.partitioner_ = None
+        self.bounding_boxes = self.expanded_boxes = None
+        self.neighbors = None
+        self.cluster_dict = None
+        self._sweep_stats = None
+        self._hier_stats = None
+        timer = PhaseTimer()
+        sampler = obs.ResourceSampler(rec).start()
+        try:
+            with obs.use_recorder(rec):
+                _check_finite(points)
+                with self._kernel_frame():
+                    try:
+                        ctx = self._hier_run(points, timer)
+                    except Exception as e:  # noqa: BLE001
+                        if not (
+                            isinstance(e, SweepGraphOverflow)
+                            or is_degradable_error(e)
+                        ):
+                            raise
+                        raise self._hier_no_refit(e) from e
+                    hier = ctx["hier"]
+                    _thr_star, eps_u = hier.select_cut()
+                    # Label at the ROUND TRIP of eps_ (not the raw cut
+                    # weight): labels_ then equal a solo fit(eps_) by
+                    # construction, whatever f32 did to the square.
+                    thr_rt = float(
+                        _hier.thr_from_user_eps(eps_u, self._metric_norm)
+                    )
+                    with timer.phase("relabel"):
+                        lab_s, core_s = hier.labels_at_thr(thr_rt)
+                        lab, core = ctx["gh"]["finalize"](lab_s, core_s)
+                    with timer.phase("densify"):
+                        dense = densify_labels(lab)
+        finally:
+            sampler.stop()
+            if dispatch_token is not None:
+                if dispatch_token == "":
+                    os.environ.pop("PYPARDIS_DISPATCH", None)
+                else:
+                    os.environ["PYPARDIS_DISPATCH"] = dispatch_token
+            if sketch_token is not None:
+                if sketch_token == "":
+                    os.environ.pop("PYPARDIS_SKETCH", None)
+                else:
+                    os.environ["PYPARDIS_SKETCH"] = sketch_token
+        self._result_cache = None
+        self.labels_ = dense
+        self.core_sample_mask_ = np.asarray(core, bool)
+        self.eps_ = float(eps_u)
+        self.metrics_.update(timer.as_dict())
+        self.metrics_["total_s"] = _time.perf_counter() - t0
+        self.metrics_["points_per_sec"] = len(points) / max(
+            self.metrics_["total_s"], 1e-9
+        )
+        from .parallel import staging as _dev_staging
+
+        reused, shipped = _dev_staging.fit_stats()
+        self.metrics_.setdefault("staged_bytes_reused", int(reused))
+        self.metrics_.setdefault("staged_bytes", int(shipped))
+        gstats = ctx["gstats"]
+        self.metrics_.setdefault("live_pairs", int(gstats["graph_pairs"]))
+        self.metrics_["n_partitions"] = int(gstats.get("n_partitions", 1))
+        self.metrics_["kernel_passes"] = 2
+        self._fit_info = {
+            "n_dims": int(points.shape[1]),
+            "n_devices": int(ctx["n_devices"]),
+        }
+        self._hier_stats = self._hier_block(ctx, eps_selected=eps_u)
+        log_phase(
+            "hierarchy", n=len(points),
+            mst_edges=self._hier_stats["mst_edges"],
+            boruvka_rounds=self._hier_stats["boruvka_rounds"],
+            eps_selected=round(float(eps_u), 6),
+            seconds=round(self.metrics_["total_s"], 4),
+        )
+        if self.auto and self._tune_stats is not None:
+            self._tune_finalize()
+        return self
+
+    def _hier_block(self, ctx, eps_selected, ladder=None) -> Dict:
+        """The ``report()["hierarchy"]`` block (user-frame values)."""
+        gstats = ctx["gstats"]
+        block = dict(ctx["hier"].telemetry())
+        block.update(
+            distance_passes=1,
+            graph_pairs=int(gstats["graph_pairs"]),
+            graph_bytes=int(gstats["graph_bytes"]),
+            graph_build_s=round(float(gstats.get("build_s", 0.0)), 6),
+            mode=ctx["run_mode"],
+            n_devices=int(ctx["n_devices"]),
+            eps_max=self._user_eps_from_kernel(ctx["eps_max_k"]),
+            eps_selected=float(eps_selected),
+            min_samples=int(ctx["hier"].min_samples),
+        )
+        if ladder is not None:
+            block["ladder"] = [float(e) for e in ladder]
+        return block
+
+    def _sweep_auto_run(self, points, ms_vals, timer):
+        """Ladder extraction + per-rung dendrogram cuts (kernel frame).
+
+        The eps ladder comes from the first ``ms``'s hierarchy (top
+        stability cuts); each ``(eps, ms)`` rung labels via a cut of
+        that ms's hierarchy — a union-find over ~n MST edges plus one
+        border reduceat, skipping the per-config fixpoint entirely —
+        and stays byte-identical to a solo fit at that config.
+        """
+        import time as _time
+
+        from .ops import hierarchy as _hier
+        from .parallel.sharded import SweepGraphOverflow
+        from .utils.hints import dispatch_tag
+        from .utils.retry import is_degradable_error
+
+        try:
+            ctx = self._hier_run(points, timer, ms=ms_vals[0])
+        except Exception as e:  # noqa: BLE001
+            if not (
+                isinstance(e, SweepGraphOverflow)
+                or is_degradable_error(e)
+            ):
+                raise
+            raise self._hier_no_refit(e) from e
+        k = int(envreg.raw("PYPARDIS_HIER_LADDER_K", "8"))
+        hier0 = ctx["hier"]
+        _thr_star, eps_sel = hier0.select_cut()
+        ladder = hier0.eps_ladder(k)
+        if not ladder:
+            raise RuntimeError(
+                "eps_list='auto' found no positive cuts to ladder "
+                "(degenerate pair graph — every point isolated at the "
+                "ceiling?)"
+            )
+        hiers = {int(ms_vals[0]): hier0}
+        for ms in ms_vals[1:]:
+            if int(ms) not in hiers:
+                with timer.phase("hierarchy"):
+                    hiers[int(ms)] = ctx["build"](ms)
+        configs = [
+            (float(e), int(m)) for e in ladder for m in ms_vals
+        ]
+        gh = ctx["gh"]
+        labels_out, core_out, per_cfg = {}, {}, []
+        relabel_s = []
+        for cfg in configs:
+            e_u, ms = cfg
+            t_c = _time.perf_counter()
+            thr = float(_hier.thr_from_user_eps(e_u, self._metric_norm))
+            with timer.phase("relabel"):
+                lab_s, core_s = hiers[ms].labels_at_thr(thr)
+                lab, core = gh["finalize"](lab_s, core_s)
+            with timer.phase("densify"):
+                dense = densify_labels(lab)
+            labels_out[cfg] = dense
+            core_out[cfg] = np.asarray(core, bool)
+            dt = _time.perf_counter() - t_c
+            relabel_s.append(round(dt, 6))
+            per_cfg.append(
+                {
+                    "eps": e_u,
+                    "min_samples": ms,
+                    "relabel_s": round(dt, 6),
+                    "n_clusters": int(dense.max()) + 1,
+                    "passes": 1,
+                    "staged_bytes_reused": 0,
+                }
+            )
+        self.metrics_["kernel_passes"] = len(configs) + 1
+        gstats = ctx["gstats"]
+        n = len(points)
+        sweep = {
+            "k": len(configs),
+            "configs": [[e, m] for e, m in configs],
+            "distance_passes": 1,
+            "graph_pairs": int(gstats["graph_pairs"]),
+            "graph_bytes": int(gstats["graph_bytes"]),
+            "graph_build_s": round(float(gstats.get("build_s", 0.0)), 6),
+            "relabel_s": relabel_s,
+            "mode": ctx["run_mode"],
+            "owner_computes": ctx["run_mode"] != "fused",
+            "dispatch": dispatch_tag(
+                int(gstats.get("owned_cap", n)) // max(self.block, 1)
+            ),
+            "degraded": None,
+            "n_devices": int(ctx["n_devices"]),
+            "eps_source": "hierarchy_auto",
+            "ladder": [float(e) for e in ladder],
+        }
+        self.metrics_["n_partitions"] = int(
+            gstats.get("n_partitions", 1)
+        )
+        for k_ in ("boundary_tiles", "boundary_tile_bytes",
+                   "halo_factor", "halo_bytes", "partition_sizes"):
+            if k_ in gstats:
+                self.metrics_[k_] = gstats[k_]
+        self._hier_stats = self._hier_block(
+            ctx, eps_selected=eps_sel, ladder=ladder
+        )
         return labels_out, core_out, per_cfg, sweep
 
     # ``labels_`` / ``core_sample_mask_`` / ``data`` are properties so
@@ -1902,6 +2413,12 @@ class DBSCAN:
         # scripts/check_bench_json.py validates it on sweep@1 rows.
         if self._sweep_stats:
             rep["sweep"] = dict(self._sweep_stats)
+        # Density-hierarchy block (ISSUE 18): present after an
+        # eps=None fit or a sweep(eps_list="auto") — MST / Borůvka /
+        # condensed-tree / stability telemetry at ONE distance pass.
+        if self._hier_stats:
+            rep["hierarchy"] = dict(self._hier_stats)
+            rep["params"]["eps_selected"] = self.eps_
         # Auto-tuning block (ISSUE 14): present only on auto=True fits
         # — the plan (with its explain trace), predicted vs measured
         # per-phase seconds, corpus rows consulted, and whether the
@@ -1966,8 +2483,16 @@ class DBSCAN:
         cand = set(candidate_blocks(len(points)))
         if "block" in pinned:
             cand.add(int(pinned["block"]))
+        hier_ceiling_k = None
+        if self.eps is None:
+            # eps=None (hierarchy path): probe at the graph ceiling —
+            # that IS the radius the one distance pass runs at.
+            hier_ceiling_k = self._hier_ceiling(points)
+            eps_probe = self._user_eps_from_kernel(hier_ceiling_k)
+        else:
+            eps_probe = float(self.eps)
         probe = probe_dataset(
-            points, float(self.eps), blocks=sorted(cand),
+            points, eps_probe, blocks=sorted(cand),
             devices=self._n_devices(),
         )
         try:
@@ -1976,7 +2501,20 @@ class DBSCAN:
             kmetric = _norm_metric(self.metric)
         except ValueError:
             kmetric = "other"
-        plan = plan_fit(probe, pinned, rows, metric=kmetric)
+        hier_est = None
+        if hier_ceiling_k is not None:
+            # Hierarchy cost terms: the core pass scales with stored
+            # slab entries (~ per-row neighbors-within-ceiling x n),
+            # the MST with rounds x pairs where rounds is logarithmic
+            # in the live components (every live point enters Borůvka
+            # as its own component).
+            pairs_est = max(
+                1, int(probe.neighbors_per_point * len(points))
+            )
+            hier_est = (float(pairs_est), float(len(points)))
+        plan = plan_fit(
+            probe, pinned, rows, metric=kmetric, hierarchy=hier_est,
+        )
         cfg = plan.config
         self.block = int(cfg.get("block", self.block))
         if cfg.get("precision"):

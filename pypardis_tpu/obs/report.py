@@ -629,6 +629,22 @@ def format_summary(report: Dict) -> str:
         if plan.get("fallback_reason"):
             bits.append("heuristic fallback")
         lines.append("  tune: " + ", ".join(bits))
+    hr = report.get("hierarchy")
+    if hr:
+        bits = [
+            f"{hr.get('mst_edges', 0):,} MST edges in "
+            f"{hr.get('boruvka_rounds', 0)} Borůvka round(s) "
+            f"(cap {hr.get('round_cap', 0)})",
+            f"{hr.get('condensed_clusters', 0)} condensed / "
+            f"{hr.get('selected_clusters', 0)} selected cluster(s), "
+            f"stability {hr.get('stability_total', 0.0):g}",
+            f"eps* {hr.get('eps_selected', 0.0):g} "
+            f"(ceiling {hr.get('eps_max', 0.0):g}, "
+            f"{hr.get('distance_passes', 1)} distance pass)",
+        ]
+        if hr.get("ladder"):
+            bits.append(f"ladder x{len(hr['ladder'])}")
+        lines.append("  hierarchy: " + ", ".join(bits))
     exp = report.get("export")
     if exp:
         dests = []

@@ -1,0 +1,658 @@
+"""Density hierarchy over one cached neighbor-pair graph.
+
+PR 13's sweep amortizes k eps-configs into ONE distance pass by caching
+the ``(i, j, d2)`` triples at ``eps_max``.  That graph subsumes the
+*entire continuous clustering family* below the ceiling (the OPTICS
+observation, Ankerst et al. SIGMOD 1999), and HDBSCAN\\* (Campello et
+al., PAKDD 2013) shows the family collapses to a minimum spanning tree
+over MUTUAL-REACHABILITY distances plus a stability rule:
+
+  ``mreach(i, j) = max(core_k(i), core_k(j), d(i, j))``
+
+where ``core_k(p)`` is the distance to p's ``min_samples``-th neighbor.
+Single-linkage over mreach IS the DBSCAN* hierarchy — cutting the MST
+at any threshold reproduces the core-core components of a DBSCAN fit at
+that eps — so every cut, the condensed dendrogram, and the
+excess-of-mass flat selection all come out of the one cached graph with
+no further distance work.
+
+Everything here operates in ONE id space (kernel slots for the fused
+route, global gids for the sharded routes) on the host-compacted slab;
+the caller owns the mapping back to input rows.  Thresholds live in the
+KERNEL d2 domain (squared L2, or L1 for cityblock) and compare in
+float32 exactly as :func:`pypardis_tpu.ops.labels.graph_dbscan_host`
+does, which is what makes :meth:`Hierarchy.labels_at_thr` byte-identical
+to the relabel engine at the same threshold — the correctness backbone
+pinned in ``tests/test_hierarchy.py``:
+
+* ``cd2(p) <= thr``  ⟺  p has >= min_samples row entries within thr
+  (same row, same f32 values — the k-th smallest of the row), which is
+  exactly the relabel engine's ``max(counts, 1) >= min_samples`` core
+  rule for ``min_samples >= 2`` (``min_samples <= 1`` pins cd2 = 0, the
+  self-count clamp);
+* a candidate edge has ``mreach2 <= thr``  ⟺  the pair is adjacent at
+  thr AND both endpoints are core at thr, so the mreach graph's
+  thr-prefix components equal the core-core subgraph components; and
+* any MST of that graph preserves per-threshold connectivity (the
+  Kruskal prefix property), so a union-find over the MST edges with
+  ``w <= thr`` — ~n edges instead of the full pair list — yields the
+  same min-core-id roots.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .._native import uf_resolve_dense
+from .labels import _INT_INF
+
+_I64_INF = np.int64(np.iinfo(np.int64).max)
+
+
+# ---------------------------------------------------------------------------
+# threshold <-> user-eps frame maps
+#
+# The slab's d2 values are in the KERNEL frame; user-facing eps is in
+# the driver frame for cosine/haversine.  The forward map replicates the
+# engines' round trip EXACTLY (f64 driver remap, then the f32 square of
+# graph_dbscan_host) so a ladder eps chosen here re-thresholds to the
+# intended prefix when a solo fit or a sweep config runs it.
+# ---------------------------------------------------------------------------
+
+
+def thr_from_user_eps(eps_u: float, frame: str) -> np.float32:
+    """User-frame eps -> internal f32 threshold (the engine round trip)."""
+    if frame == "cityblock":
+        return np.float32(eps_u)
+    if frame == "cosine":
+        e = np.float32(np.sqrt(2.0 * eps_u))
+    elif frame == "haversine":
+        e = np.float32(2.0 * np.sin(eps_u / 2.0))
+    else:
+        e = np.float32(eps_u)
+    return e * e
+
+
+def user_eps_from_thr(thr: float, frame: str) -> float:
+    """Internal threshold -> user-frame eps (f64 inverse of the remap)."""
+    t = float(thr)
+    if frame == "cityblock":
+        return t
+    if frame == "cosine":
+        return t / 2.0
+    if frame == "haversine":
+        return float(2.0 * np.arcsin(min(np.sqrt(t) / 2.0, 1.0)))
+    return float(np.sqrt(t))
+
+
+# ---------------------------------------------------------------------------
+# prepare + core distances
+# ---------------------------------------------------------------------------
+
+
+def hierarchy_prepare(gi, gj, dval):
+    """Sort-once slab state for the hierarchy AND the host relabel.
+
+    Like :func:`~pypardis_tpu.ops.labels.graph_dbscan_host_prepare` but
+    rows are additionally sorted by ascending dval WITHIN each row
+    (``np.lexsort`` with gi primary), so the ``min_samples``-th smallest
+    of a row is a direct index — the k-th-smallest segment reduction.
+    ``graph_dbscan_host`` only needs row contiguity for its reduceat
+    calls, so this state is a drop-in for it too: one sort serves both
+    the per-config relabel and every hierarchy pass.
+    """
+    gi = np.asarray(gi, np.int64)
+    gj = np.asarray(gj, np.int64)
+    dv = np.asarray(dval, np.float32)
+    order = np.lexsort((dv, gi))
+    gi_s = gi[order]
+    gj_s = gj[order]
+    dv_s = dv[order]
+    if len(gi_s):
+        starts = np.concatenate(
+            [[0], np.flatnonzero(np.diff(gi_s)) + 1]
+        ).astype(np.int64)
+        uniq = gi_s[starts]
+    else:
+        starts = np.empty(0, np.int64)
+        uniq = np.empty(0, np.int64)
+    return gi_s, gj_s, dv_s, starts, uniq
+
+
+def core_distances(state, mask, min_samples: int) -> np.ndarray:
+    """Per-point squared core distance from the prepared slab.
+
+    ``cd2[p]`` = the ``min_samples``-th smallest dval of p's row (+inf
+    when the row is shorter — never core below the ceiling), except
+    ``min_samples <= 1`` pins valid points to 0: the engines' self-count
+    clamp (``max(counts, 1)``) makes every valid point core at any eps,
+    and a zero core distance reproduces that.  Device-slab +inf padding
+    sorts to the tail of row 0 and can only ever select +inf — inert.
+    """
+    gi_s, gj_s, dv_s, starts, uniq = state
+    mask = np.asarray(mask, bool)
+    n = len(mask)
+    ms = int(min_samples)
+    cd2 = np.full(n, np.inf, np.float32)
+    if ms <= 1:
+        cd2[mask] = np.float32(0.0)
+        return cd2
+    if len(starts):
+        counts = np.diff(np.append(starts, len(gi_s)))
+        has = counts >= ms
+        cd2[uniq[has]] = dv_s[starts[has] + (ms - 1)]
+    cd2[~mask] = np.inf
+    return cd2
+
+
+@jax.jit
+def core_distances_device(gi, gj, dval, mask, min_samples):
+    """Jitted device twin of :func:`core_distances` (same f32 values).
+
+    One lexsort + a first-occurrence rank turns the k-th-smallest
+    segment reduction into a single masked scatter-min — no host round
+    trip for the accelerator routes.  ``min_samples`` is traced, so one
+    compiled program serves every config.
+    """
+    n = mask.shape[0]
+    order = jnp.lexsort((dval, gi))
+    gi_s = gi[order].astype(jnp.int32)
+    dv_s = dval[order]
+    first = jnp.searchsorted(gi_s, gi_s, side="left")
+    rank = jnp.arange(gi_s.shape[0], dtype=jnp.int32) - first.astype(
+        jnp.int32
+    )
+    ms = jnp.asarray(min_samples, jnp.int32)
+    hit = rank == (ms - 1)
+    # Dump slot n for the non-hits; clip keeps the scatter in range.
+    tgt = jnp.where(hit, jnp.clip(gi_s, 0, n), n)
+    cd2 = jnp.full(n + 1, jnp.inf, jnp.float32).at[tgt].min(
+        jnp.where(hit, dv_s, jnp.inf)
+    )[:n]
+    cd2 = jnp.where(mask, cd2, jnp.inf)
+    return jnp.where(
+        ms <= 1, jnp.where(mask, jnp.float32(0.0), jnp.inf), cd2
+    )
+
+
+# ---------------------------------------------------------------------------
+# mutual-reachability MST — Borůvka rounds over the compacted pair list
+# ---------------------------------------------------------------------------
+
+
+def mutual_reachability_mst(state, cd2, n: int):
+    """Borůvka MST over the mutual-reachability graph.
+
+    Candidate edges are the canonical (i < j) half of the slab with
+    ``w = max(cd2[i], cd2[j], dval)`` finite; +inf padding and edges
+    touching never-core points drop out here.  Edges get a unique rank
+    by ``lexsort((j, i, w))`` — a total order, so each component's
+    minimum incident edge is deterministic and the chosen set is
+    cycle-free without any tie-handling.  Each round is a segment-min
+    (``np.minimum.at`` over component labels) + a union-find
+    contraction — the pmin-fixpoint shape of
+    ``parallel/merge.resolve_label_edges``, which also supplies the
+    min-id root convention.
+
+    Returns ``(mi, mj, mw, info)`` with mw ascending-rank-ordered and
+    ``info`` carrying ``boruvka_rounds`` / ``n_live`` /
+    ``n_components`` / ``round_cap`` (the ``ceil(log2(C0)) + 1``
+    convergence bound the probe pins).
+    """
+    gi_s, gj_s, dv_s, starts, uniq = state
+    w = np.maximum(dv_s, np.maximum(cd2[gi_s], cd2[gj_s]))
+    sel = (gi_s < gj_s) & np.isfinite(w)
+    mi = gi_s[sel]
+    mj = gj_s[sel]
+    mw = w[sel].astype(np.float32)
+    order = np.lexsort((mj, mi, mw))
+    mi, mj, mw = mi[order], mj[order], mw[order]
+    m = len(mi)
+    live_ids = np.unique(np.concatenate([mi, mj])) if m else mi
+    n_live = int(len(live_ids))
+    chosen = np.zeros(m, bool)
+    lab = np.arange(n, dtype=np.int64)
+    ranks = np.arange(m, dtype=np.int64)
+    rounds = 0
+    c0 = 0
+    while m:
+        a = lab[mi]
+        b = lab[mj]
+        live = a != b
+        if not live.any():
+            break
+        if rounds == 0:
+            c0 = int(len(np.unique(np.concatenate([a[live], b[live]]))))
+        rounds += 1
+        best = np.full(n, _I64_INF)
+        np.minimum.at(best, a[live], ranks[live])
+        np.minimum.at(best, b[live], ranks[live])
+        chosen[best[best < _I64_INF]] = True
+        lab = uf_resolve_dense(
+            np.stack([mi[chosen], mj[chosen]], axis=1), n
+        )
+    idx = np.flatnonzero(chosen)
+    n_components = (
+        int(len(np.unique(lab[live_ids]))) if n_live else 0
+    )
+    info = {
+        "mst_edges": int(len(idx)),
+        "boruvka_rounds": int(rounds),
+        "round_cap": int(np.ceil(np.log2(max(c0, 2)))) + 1,
+        "n_live": n_live,
+        "n_components": n_components,
+        "candidate_edges": int(m),
+    }
+    return mi[idx], mj[idx], mw[idx], info
+
+
+# ---------------------------------------------------------------------------
+# dendrogram: Kruskal merge forest -> condensed tree -> stability
+# ---------------------------------------------------------------------------
+
+
+def _merge_forest(mi, mj, mw, n: int):
+    """Kruskal merge sequence over the MST edges (ascending (w, i, j)).
+
+    Returns ``(left, right, weight, size, roots)`` — internal node t
+    has id ``n + t``; ``roots`` are the final tree-node ids of the
+    forest (one per connected component of the mreach graph).
+    """
+    order = np.lexsort((mj, mi, mw))
+    ei, ej, ew = mi[order], mj[order], mw[order]
+    m = len(ei)
+    parent = np.arange(n, dtype=np.int64)
+
+    def find(x: int) -> int:
+        root = x
+        while parent[root] != root:
+            root = parent[root]
+        while parent[x] != root:
+            parent[x], x = root, parent[x]
+        return root
+
+    node = np.arange(n, dtype=np.int64)
+    size = np.ones(n, np.int64)
+    left = np.empty(m, np.int64)
+    right = np.empty(m, np.int64)
+    weight = np.empty(m, np.float64)
+    msize = np.empty(m, np.int64)
+    for t in range(m):
+        ra, rb = find(int(ei[t])), find(int(ej[t]))
+        left[t], right[t] = node[ra], node[rb]
+        weight[t] = ew[t]
+        msize[t] = size[ra] + size[rb]
+        parent[rb] = ra
+        size[ra] += size[rb]
+        node[ra] = n + t
+    seen = set()
+    roots = []
+    for p in np.unique(np.concatenate([ei, ej])) if m else []:
+        r = find(int(p))
+        if r not in seen:
+            seen.add(r)
+            roots.append(int(node[r]))
+    return left, right, weight, msize, sorted(roots)
+
+
+class _Cluster:
+    """One condensed cluster: alive for ``thr in [end_w, birth_w)``."""
+
+    __slots__ = (
+        "cid", "parent", "birth_w", "end_w", "size", "exits",
+        "children", "stability",
+    )
+
+    def __init__(self, cid, parent, birth_w, size):
+        self.cid = cid
+        self.parent = parent
+        self.birth_w = birth_w
+        self.end_w = 0.0
+        self.size = size
+        self.exits: List[Tuple[float, int]] = []
+        self.children: List[int] = []
+        self.stability = 0.0
+
+
+class Hierarchy:
+    """Condensed density hierarchy + flat-cut machinery over one slab.
+
+    Built by :func:`build_hierarchy`; ``labels_at_thr`` is the cheap
+    per-cut path (union-find over ~n MST edges + one reduceat border
+    attach — no per-config fixpoint), byte-identical to
+    ``graph_dbscan_host`` at the same threshold and min_samples.
+    """
+
+    def __init__(self, state, mask, n, min_samples, kernel_metric,
+                 user_frame, thr_max, cd2, mst, info):
+        self.state = state
+        self.mask = np.asarray(mask, bool)
+        self.n = int(n)
+        self.min_samples = int(min_samples)
+        self.kernel_metric = kernel_metric
+        self.user_frame = user_frame
+        self.thr_max = float(thr_max)
+        self.cd2 = cd2
+        self.mi, self.mj, self.mw = mst
+        self.info = dict(info)
+        self.clusters: List[_Cluster] = []
+        self.selected: List[int] = []
+        self._lambda_floor = 1e-12
+
+    # -- flat labels -----------------------------------------------------
+
+    def labels_at_thr(self, thr):
+        """Flat labels at an internal f32 threshold (slab id space).
+
+        Same fixpoint as the relabel engine at THIS hierarchy's
+        min_samples (the MST's weights bake in these core distances —
+        a different min_samples needs its own :func:`build_hierarchy`
+        over the shared prepared state): core by core-distance (== the
+        counts rule), components by union-find over the MST's
+        thr-prefix (== core-core components, see module docstring),
+        min-core-id roots, borders to the min adjacent core root.
+        Returns ``(labels, core)``; the caller densifies / unscatters.
+        """
+        gi_s, gj_s, dv_s, starts, uniq = self.state
+        thr_f = np.float32(thr)
+        if self.min_samples <= 1:
+            core = self.mask.copy()
+        else:
+            core = (self.cd2 <= thr_f) & self.mask
+        sel = self.mw <= thr_f
+        roots = uf_resolve_dense(
+            np.stack([self.mi[sel], self.mj[sel]], axis=1), self.n
+        )
+        f = np.where(core, roots, np.int64(_INT_INF))
+        adj = dv_s <= thr_f
+        border = np.full(self.n, np.int64(_INT_INF))
+        if len(starts):
+            cand = np.where(
+                adj & core[gj_s], f[gj_s], np.int64(_INT_INF)
+            )
+            border[uniq] = np.minimum.reduceat(cand, starts)
+        labels = np.where(
+            core, f,
+            np.where(self.mask & (border != _INT_INF), border, -1),
+        ).astype(np.int32)
+        return labels, core
+
+    # -- condensation ----------------------------------------------------
+
+    def _lam(self, w: float, birth: bool = False) -> float:
+        """HDBSCAN*'s lambda = 1 / distance, in the USER frame.
+
+        Duplicate points give zero-distance merges; the floor (half the
+        smallest positive distance in the tree, data-deterministic)
+        keeps lambda finite without reordering any comparisons.  Birth
+        weights clamp at the graph ceiling: the cached family is
+        truncated at eps_max, so a root component's stability honestly
+        starts there instead of pretending the cluster was born at
+        infinite distance.
+        """
+        if birth:
+            w = min(w, self.thr_max)
+        d = user_eps_from_thr(w, self.user_frame)
+        return 1.0 / max(d, self._lambda_floor)
+
+    def condense(self, min_cluster_size: int) -> None:
+        """Condense the merge forest by ``min_cluster_size`` and score
+        every condensed cluster with the excess-of-mass stability
+        ``sum_p (lambda_exit(p) - lambda_birth)``, then run the EOM
+        bottom-up selection (a cluster beats its subtree iff its own
+        stability >= the sum of the children's winning subtrees)."""
+        mcs = int(min_cluster_size)
+        left, right, weight, msize, roots = _merge_forest(
+            self.mi, self.mj, self.mw, self.n
+        )
+        pos_d = [
+            user_eps_from_thr(w, self.user_frame)
+            for w in np.unique(weight) if w > 0
+        ]
+        self._lambda_floor = (
+            0.5 * min(pos_d) if pos_d else 1e-12
+        )
+        self.clusters = []
+        n = self.n
+
+        def nsize(node: int) -> int:
+            return 1 if node < n else int(msize[node - n])
+
+        stack: List[Tuple[int, int]] = []  # (tree node, cluster idx)
+        for r in roots:
+            if nsize(r) < mcs:
+                continue
+            c = _Cluster(len(self.clusters), None, np.inf, nsize(r))
+            self.clusters.append(c)
+            stack.append((r, c.cid))
+        while stack:
+            node, cid = stack.pop()
+            c = self.clusters[cid]
+            while True:
+                if node < n:
+                    # mcs >= 2, so a bare leaf only arises for a
+                    # degenerate 1-point component — closed above.
+                    c.end_w = 0.0
+                    break
+                t = node - n
+                a, b = int(left[t]), int(right[t])
+                sa, sb = nsize(a), nsize(b)
+                w = float(weight[t])
+                if sa >= mcs and sb >= mcs:
+                    c.end_w = w
+                    for child in (a, b):
+                        cc = _Cluster(
+                            len(self.clusters), cid, w, nsize(child)
+                        )
+                        c.children.append(cc.cid)
+                        self.clusters.append(cc)
+                        stack.append((child, cc.cid))
+                    break
+                if sa < mcs and sb < mcs:
+                    c.exits.append((w, sa + sb))
+                    c.end_w = w
+                    break
+                keep, drop = (a, b) if sa >= mcs else (b, a)
+                c.exits.append((w, nsize(drop)))
+                node = keep
+        for c in self.clusters:
+            lb = self._lam(c.birth_w, birth=True)
+            c.stability = sum(
+                (self._lam(w) - lb) * cnt for w, cnt in c.exits
+            )
+            for ch in c.children:
+                c.stability += (
+                    self._lam(self.clusters[ch].birth_w) - lb
+                ) * self.clusters[ch].size
+        # EOM bottom-up: children were appended after their parent, so
+        # reverse construction order IS leaves-first.
+        subtree = [0.0] * len(self.clusters)
+        wins = [False] * len(self.clusters)
+        for c in reversed(self.clusters):
+            kids = sum(subtree[ch] for ch in c.children)
+            if not c.children or c.stability >= kids:
+                wins[c.cid] = True
+                subtree[c.cid] = c.stability
+            else:
+                subtree[c.cid] = kids
+        self.selected = []
+        blocked = [False] * len(self.clusters)
+        for c in self.clusters:  # top-down: parents precede children
+            if blocked[c.cid] or not wins[c.cid]:
+                continue
+            self.selected.append(c.cid)
+            desc = list(c.children)
+            while desc:
+                d = desc.pop()
+                blocked[d] = True
+                desc.extend(self.clusters[d].children)
+        self.info["condensed_clusters"] = len(self.clusters)
+        self.info["selected_clusters"] = len(self.selected)
+        self.info["stability_total"] = round(
+            float(sum(self.clusters[c].stability for c in self.selected)),
+            6,
+        )
+
+    # -- flat-cut selection ---------------------------------------------
+
+    def _cut_candidates(self) -> np.ndarray:
+        ws = np.unique(self.mw.astype(np.float64))
+        ws = ws[np.isfinite(ws)]
+        return np.append(ws, self.thr_max) if len(ws) else np.asarray(
+            [self.thr_max]
+        )
+
+    def cut_scores(self) -> List[Tuple[float, float]]:
+        """``(thr, score)`` per candidate cut — score is the summed
+        stability of EOM-selected clusters alive at thr (alive:
+        ``end_w <= thr < birth_w``; labels are constant between
+        consecutive distinct MST weights, so these are ALL the distinct
+        cuts the family has).  Sweep-line over birth/death events: one
+        cumsum instead of a cuts x clusters scan."""
+        cands = self._cut_candidates()
+        add = np.zeros(len(cands), np.float64)
+        if self.selected:
+            ends = np.asarray(
+                [self.clusters[c].end_w for c in self.selected]
+            )
+            births = np.asarray(
+                [self.clusters[c].birth_w for c in self.selected]
+            )
+            stabs = np.asarray(
+                [self.clusters[c].stability for c in self.selected]
+            )
+            on = np.searchsorted(cands, ends, side="left")
+            off = np.searchsorted(cands, births, side="left")
+            np.add.at(add, on[on < len(cands)], stabs[on < len(cands)])
+            np.subtract.at(
+                add, off[off < len(cands)], stabs[off < len(cands)]
+            )
+        scores = np.cumsum(add)
+        return [(float(t), float(s)) for t, s in zip(cands, scores)]
+
+    def select_cut(self) -> Tuple[float, float]:
+        """The stability-selected flat cut: ``(thr_star, eps_user)``.
+
+        Argmax of :meth:`cut_scores`; ties break toward the LARGER
+        threshold (fewer noise points for equal stability mass).  The
+        returned eps is the f64 midpoint of ``[thr_star, next distinct
+        weight)`` mapped to the user frame, round-trip-checked so a solo
+        ``fit(eps)`` re-thresholds inside the same interval — with the
+        exact boundary as the deterministic fallback when the interval
+        is too narrow (< 4 ulps) to hold a midpoint.
+        """
+        cands = self._cut_candidates()
+        scores = self.cut_scores()
+        best_thr, best_s = scores[0]
+        for thr, s in scores[1:]:
+            if s > best_s or (s == best_s and thr > best_thr):
+                best_thr, best_s = thr, s
+        self.info["cut_thr"] = float(best_thr)
+        self.info["cut_score"] = round(float(best_s), 6)
+        nxt = cands[cands > best_thr]
+        hi = float(nxt[0]) if len(nxt) else float(
+            np.nextafter(np.float32(best_thr), np.float32(np.inf))
+        )
+        return float(best_thr), self._interval_eps(best_thr, hi)
+
+    def _interval_eps(self, lo: float, hi: float) -> float:
+        """A user-frame eps whose engine round trip lands in [lo, hi).
+
+        Labels are constant on the interval, so ANY such eps names the
+        same clustering; the midpoint maximizes slack against the f32
+        re-square.  Falls back to the exact lower boundary (always
+        representable: slab weights ARE f32 values) if the round trip
+        escapes — e.g. a sub-4-ulp interval.
+        """
+        wide = (hi - lo) >= 4 * float(
+            np.spacing(np.float32(max(lo, 1e-30)))
+        )
+        if wide:
+            mid = 0.5 * (lo + hi)
+            eps_u = user_eps_from_thr(mid, self.user_frame)
+            rt = float(thr_from_user_eps(eps_u, self.user_frame))
+            if lo <= rt < hi:
+                return eps_u
+        return user_eps_from_thr(lo, self.user_frame)
+
+    def eps_ladder(self, k: int) -> List[float]:
+        """Top-``k``-stability eps ladder for ``sweep(eps_list="auto")``.
+
+        Candidate cuts ranked by :meth:`cut_scores` (ties toward larger
+        thr), each mapped to a round-trip-safe user eps; deduplicated,
+        returned ASCENDING so the sweep's eps_max is the last rung.
+        Fewer than k distinct cuts return what exists.
+        """
+        cands = self._cut_candidates()
+        ranked = sorted(
+            self.cut_scores(), key=lambda ts: (-ts[1], -ts[0])
+        )
+        out: List[float] = []
+        for thr, _s in ranked:
+            if len(out) >= int(k):
+                break
+            nxt = cands[cands > thr]
+            hi = float(nxt[0]) if len(nxt) else float(
+                np.nextafter(np.float32(thr), np.float32(np.inf))
+            )
+            eps_u = self._interval_eps(thr, hi)
+            if eps_u > 0 and eps_u not in out:
+                out.append(eps_u)
+        return sorted(out)
+
+    def telemetry(self) -> Dict:
+        """The ``report()["hierarchy"]`` block body (caller adds the
+        route/timing fields it owns)."""
+        return dict(self.info)
+
+
+def build_hierarchy(
+    state,
+    mask,
+    n: int,
+    min_samples: int,
+    *,
+    kernel_metric: str = "euclidean",
+    user_frame: str = "euclidean",
+    thr_max: float,
+    min_cluster_size: Optional[int] = None,
+    cd2: Optional[np.ndarray] = None,
+) -> Hierarchy:
+    """Core distances + Borůvka MST + condensed tree in one call.
+
+    ``state`` comes from :func:`hierarchy_prepare` (dv-sorted rows);
+    ``thr_max`` is the graph ceiling in the internal d2/d1 domain;
+    ``cd2`` may be passed in when the jitted device twin already
+    computed it (must equal the host values bitwise — pinned in tests).
+    ``min_cluster_size`` defaults to ``max(min_samples, 2)``.
+    """
+    mcs = (
+        max(int(min_samples), 2) if min_cluster_size is None
+        else int(min_cluster_size)
+    )
+    if mcs < 2:
+        raise ValueError(
+            f"min_cluster_size must be >= 2, got {min_cluster_size}"
+        )
+    t0 = time.perf_counter()
+    if cd2 is None:
+        cd2 = core_distances(state, mask, min_samples)
+    t1 = time.perf_counter()
+    mi, mj, mw, info = mutual_reachability_mst(state, cd2, n)
+    t2 = time.perf_counter()
+    h = Hierarchy(
+        state, mask, n, min_samples, kernel_metric, user_frame,
+        thr_max, cd2, (mi, mj, mw), info,
+    )
+    h.condense(mcs)
+    t3 = time.perf_counter()
+    h.info["min_cluster_size"] = mcs
+    h.info["core_pass_s"] = round(t1 - t0, 6)
+    h.info["mst_s"] = round(t2 - t1, 6)
+    h.info["condense_s"] = round(t3 - t2, 6)
+    return h

@@ -88,6 +88,11 @@ class CorpusRow:
     duplicated_work_factor: Optional[float] = None
     halo_bytes: Optional[int] = None
     peak_host_rss_bytes: Optional[int] = None
+    # -- hierarchy outcome (eps=None fits; None = no hierarchy ran) --
+    hier_pairs: Optional[int] = None       # stored pairs the core pass reduced
+    hier_components: Optional[int] = None  # live components entering Borůvka
+    hier_core_s: Optional[float] = None    # core-distance pass seconds
+    hier_mst_s: Optional[float] = None     # MST (all Borůvka rounds) seconds
     # -- provenance --
     source: str = ""
     schema: str = field(default=CORPUS_SCHEMA)
@@ -167,6 +172,10 @@ def row_from_report(report: Dict, *, wall_s=None,
 
     total = _num(run.get("total_s"))
     pps = _num(run.get("points_per_sec"))
+    hier = report.get("hierarchy", {})
+    hier = hier if isinstance(hier, dict) else {}
+    _hp = _num(hier.get("graph_pairs"))
+    _hc = _num(hier.get("n_live"))  # initial Borůvka components
     return CorpusRow(
         n=int(run.get("n_points", 0) or 0) or None,
         dim=int(run.get("n_dims", 0) or 0) or None,
@@ -200,6 +209,10 @@ def row_from_report(report: Dict, *, wall_s=None,
         peak_host_rss_bytes=int(
             _num(res.get("peak_host_rss_bytes")) or 0
         ) or None,
+        hier_pairs=int(_hp) if _hp is not None else None,
+        hier_components=int(_hc) if _hc is not None else None,
+        hier_core_s=_num(hier.get("core_pass_s")),
+        hier_mst_s=_num(hier.get("mst_s")),
         source=source,
     )
 

@@ -52,6 +52,8 @@ _DEFAULTS = {
         "exch_byte_s": 1.1e-6,       # host-stepped ring, s/byte
         "merge_host_row_s": 2.6e-6,  # union-find spill, s/row
         "merge_round_s": 0.05,       # pmin fixpoint, s/round/device
+        "hier_pair_s": 2.5e-7,       # core-dist pass, s/stored pair
+        "hier_round_s": 6.0e-8,      # Borůvka, s/pair/round
     },
     "tpu": {
         "build_row_s": 2.0e-9,
@@ -61,9 +63,22 @@ _DEFAULTS = {
         "tile_scan_s": 5.0e-8,
         "exch_byte_s": 2.0e-9,       # ICI, not a host-stepped ring
         "merge_host_row_s": 2.6e-6,  # host merge is host-bound anywhere
+        # The hierarchy terms are host-bound on any backend: the pair
+        # slab lands on host for the MST either way.
         "merge_round_s": 0.002,
+        "hier_pair_s": 2.5e-7,
+        "hier_round_s": 6.0e-8,
     },
 }
+
+
+def boruvka_rounds_est(components: float) -> int:
+    """The Borůvka round budget the engine itself is pinned to:
+    components at least halve per round, so ``ceil(log2(C0)) + 1``
+    (the +1 is the final no-progress detection round)."""
+    import math
+
+    return int(math.ceil(math.log2(max(float(components), 2.0)))) + 1
 _FIXPOINT_ROUNDS = 3  # observed 3 on every committed GM row
 
 
@@ -267,6 +282,34 @@ class CostModel:
                 tag,
             ):
                 used += len(md)
+            # -- hierarchy terms: core pass ∝ stored pairs; MST ∝
+            # rounds(log of live components) x pairs (Borůvka) --------
+            hc = [
+                r for r in sel
+                if r.hier_core_s and r.hier_pairs
+                and sources.get("hier_pair_s") == "heuristic"
+            ]
+            if hc and accept(
+                "hier_pair_s",
+                float(sum(r.hier_core_s for r in hc)
+                      / sum(float(r.hier_pairs) for r in hc)),
+                tag,
+            ):
+                used += len(hc)
+            hm = [
+                r for r in sel
+                if r.hier_mst_s and r.hier_pairs and r.hier_components
+                and sources.get("hier_round_s") == "heuristic"
+            ]
+            if hm and accept(
+                "hier_round_s",
+                float(sum(r.hier_mst_s for r in hm)
+                      / sum(float(r.hier_pairs)
+                            * boruvka_rounds_est(r.hier_components)
+                            for r in hm)),
+                tag,
+            ):
+                used += len(hm)
         return cls(
             backend=backend, devices=devices, coef=coef,
             rows_used=used, sources=sources,
@@ -343,6 +386,30 @@ class CostModel:
             "compute_s": float(compute),
             "merge_s": float(merge_s),
             "total_s": float(total),
+        }
+
+    def predict_hierarchy(
+        self, pairs: float, components: float,
+    ) -> Dict[str, float]:
+        """Predicted hierarchy seconds for an eps=None fit.
+
+        ``pairs`` = stored pair-slab entries the one distance pass
+        emits at the graph ceiling; ``components`` = live points
+        entering Borůvka (each starts as its own component).  The core
+        pass is one segment reduction over the slab; each Borůvka
+        round is a segment-min + union-find contraction over the same
+        slab, and rounds are logarithmic in the components
+        (:func:`boruvka_rounds_est`) — both host-bound on any backend.
+        """
+        c = self.coef
+        rounds = boruvka_rounds_est(components)
+        core_s = c["hier_pair_s"] * float(pairs)
+        mst_s = c["hier_round_s"] * rounds * float(pairs)
+        return {
+            "hier_core_s": float(core_s),
+            "hier_mst_s": float(mst_s),
+            "hier_rounds": float(rounds),
+            "hierarchy_s": float(core_s + mst_s),
         }
 
 

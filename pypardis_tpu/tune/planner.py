@@ -121,13 +121,21 @@ class TunePlan:
             if k in self.knob_reasons:
                 lines.append(f"  {k}: {self.knob_reasons[k]}")
         if self.predicted:
+            terms = ["build_s", "exchange_s", "compute_s", "merge_s"]
+            if "hierarchy_s" in self.predicted:
+                terms.append("hierarchy_s")
             lines.append(
                 "  predicted: " + " + ".join(
                     f"{p[:-2]} {self.predicted.get(p, 0.0):.2f}s"
-                    for p in ("build_s", "exchange_s", "compute_s",
-                              "merge_s")
+                    for p in terms
                 )
                 + f" = {self.predicted.get('total_s', 0.0):.2f}s"
+            )
+        if "hier_rounds" in self.predicted:
+            lines.append(
+                "  hierarchy: core pass over the stored pair slab + "
+                f"{int(self.predicted['hier_rounds'])} Borůvka "
+                "round(s) (log2 of live components, telemetry-pinned)"
             )
         lines.append(f"  model: {self.coef_source}")
         if self.fallback_reason:
@@ -164,6 +172,7 @@ def plan_fit(
     corpus_rows=None,
     *,
     metric: str = "euclidean",
+    hierarchy: Optional[Tuple[float, float]] = None,
 ) -> TunePlan:
     """Plan the unpinned knobs for one fit described by ``probe``.
 
@@ -172,6 +181,14 @@ def plan_fit(
     name) plans ``sketch=0``.  The sketch knob is label-safe like
     every other planned knob (byte parity for any k by the certified
     gate construction, :mod:`pypardis_tpu.ops.sketch`).
+
+    ``hierarchy``: ``(pairs_est, components_est)`` when the fit is the
+    eps=None density-hierarchy path — adds the learned hierarchy terms
+    (core pass ∝ stored pairs, Borůvka MST ∝ rounds x pairs with
+    rounds logarithmic in live components) to every candidate's
+    predicted seconds.  The terms are config-invariant (the MST runs
+    host-side over the same slab whatever the route), so they shift
+    totals honestly without perturbing the knob ranking.
     """
     user_pinned = dict(pinned or {})
     user_pinned.pop("_device_resident", None)
@@ -320,6 +337,10 @@ def plan_fit(
             sketch=int(sk),
             sketch_band_fraction=st.get("sketch_band_fraction", 1.0),
         )
+        if hierarchy is not None:
+            hp = model.predict_hierarchy(*hierarchy)
+            phases.update(hp)
+            phases["total_s"] += hp["hierarchy_s"]
         cfg = {
             "mode": mode, "block": block, "precision": prec,
             "merge": merge, "dispatch": disp, "sketch": int(sk),

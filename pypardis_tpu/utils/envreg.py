@@ -128,6 +128,16 @@ _DECLARATIONS: Tuple[EnvVar, ...] = (
     EnvVar("PYPARDIS_SWEEP_MAX_PAIRS", "int", "67108864",
            "Hard cap on the sweep graph slab in edges; past it the "
            "sweep degrades label-safely to per-config refits."),
+    # -- density hierarchy (eps=None fits) ----------------------------
+    EnvVar("PYPARDIS_HIER_EPS_MAX", "float", "unset (sample-kNN x4)",
+           "USER-frame ceiling for the eps=None pair graph; unset "
+           "derives it from a strided sample-kNN overestimate."),
+    EnvVar("PYPARDIS_HIER_LADDER_K", "int", "8",
+           "Rungs `sweep(eps_list=\"auto\")` extracts from the "
+           "dendrogram (top-stability cuts, ascending eps)."),
+    EnvVar("PYPARDIS_HIER_SAMPLE", "int", "2048",
+           "Strided sample rows for the eps=None ceiling heuristic "
+           "(deterministic; larger = tighter ceiling, slower probe)."),
     # -- caches -------------------------------------------------------
     EnvVar("PYPARDIS_COMPILE_CACHE", "path", "~/.cache/pypardis_tpu/xla",
            "Persistent XLA compilation cache directory; empty "

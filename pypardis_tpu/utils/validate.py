@@ -115,15 +115,32 @@ def check_metric(metric, eps=None) -> str:
     return _norm_metric(metric)
 
 
-def validate_params(eps, min_samples) -> None:
+def validate_params(eps, min_samples, allow_none_eps: bool = False) -> None:
     """Raise ValueError on an invalid concrete (eps, min_samples).
 
     Values that are not plain numbers (jax tracers on the in-jit call
     sites) are skipped — validation happens once, host-side, with the
     concrete hyperparameters.
+
+    ``eps=None`` rule (density hierarchy): ``None`` is legal ONLY where
+    ``allow_none_eps=True`` — the ``DBSCAN`` constructor and the
+    fit-time hierarchy path, which selects eps by HDBSCAN*'s stability
+    rule and exposes it as ``eps_``.  Everywhere downstream of a fit
+    (``predict``/serving/``query_engine``) a concrete radius is
+    required and comes from that stability-selected ``eps_``; a
+    concrete ``eps <= 0`` or non-finite value still fails loudly at
+    construction regardless of ``allow_none_eps``.
     """
     if isinstance(min_samples, (int, np.integer)) and min_samples < 1:
         raise ValueError(f"min_samples must be >= 1, got {min_samples}")
+    if eps is None:
+        if allow_none_eps:
+            return
+        raise ValueError(
+            "eps=None is only legal at construction/fit time (the "
+            "density-hierarchy path selects eps by stability); this "
+            "call site needs a concrete positive radius"
+        )
     if isinstance(eps, (int, float, np.floating)):
         if not np.isfinite(eps) or eps <= 0:
             raise ValueError(f"eps must be positive and finite, got {eps}")

@@ -567,6 +567,79 @@ def main() -> None:
             if not isinstance(sw.get(key), int):
                 fail(f"telemetry.sweep.{key} is {sw.get(key)!r}")
 
+    # Density-hierarchy contract (ISSUE 18): a hierarchy row must prove
+    # the one-distance-pass claim for the WHOLE ladder, carry the
+    # spanning-forest invariant from telemetry (mst_edges ==
+    # n_live - n_components), keep Boruvka within its logarithmic round
+    # cap, state per-rung exactness (labels byte-identical + ARI == 1.0
+    # vs solo fits at the same eps), and a stability-selected eps.
+    if str(row["metric"]).startswith("hierarchy"):
+        if row.get("schema") != "pypardis_tpu/hierarchy@1":
+            fail(f"hierarchy row schema is {row.get('schema')!r}")
+        k = row.get("k")
+        if not isinstance(k, int) or k < 2:
+            fail(f"hierarchy row.k is {k!r}, expected int >= 2")
+        hr = tel.get("hierarchy")
+        if not isinstance(hr, dict):
+            fail("hierarchy row without telemetry.hierarchy block")
+        if row.get("distance_passes") != 1 or hr.get(
+            "distance_passes"
+        ) != 1:
+            fail(
+                f"hierarchy row ran {row.get('distance_passes')!r} "
+                f"distance passes — the one-pass ladder is the row's "
+                f"whole point"
+            )
+        for key in ("mst_edges", "boruvka_rounds", "round_cap",
+                    "n_live", "n_components", "condensed_clusters",
+                    "selected_clusters"):
+            v = hr.get(key)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                fail(
+                    f"telemetry.hierarchy.{key} is {v!r}, expected a "
+                    f"non-negative int"
+                )
+        if hr["boruvka_rounds"] > hr["round_cap"]:
+            fail(
+                f"boruvka_rounds {hr['boruvka_rounds']} exceeds the "
+                f"logarithmic cap {hr['round_cap']}"
+            )
+        if hr["mst_edges"] != hr["n_live"] - hr["n_components"]:
+            fail(
+                f"mst_edges {hr['mst_edges']} != n_live "
+                f"{hr['n_live']} - n_components {hr['n_components']} "
+                f"— not a spanning forest"
+            )
+        for key in ("eps_selected", "stability_total"):
+            v = hr.get(key)
+            if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                    or v != v or v in (float("inf"), float("-inf")) \
+                    or v < 0:
+                fail(
+                    f"telemetry.hierarchy.{key} is {v!r}, expected a "
+                    f"finite number >= 0"
+                )
+        v = row.get("value")
+        if not isinstance(v, (int, float)) or v != v or v <= 0:
+            fail(f"hierarchy amortization value is {v!r}")
+        ladder = row.get("ladder")
+        if not isinstance(ladder, list) or len(ladder) != k:
+            fail(
+                f"hierarchy row.ladder is {ladder!r}, expected {k} "
+                f"rungs"
+            )
+        prs = row.get("per_rung")
+        if not isinstance(prs, list) or len(prs) != k:
+            fail(
+                f"hierarchy row.per_rung has {prs!r}, expected {k} "
+                f"entries"
+            )
+        for i, pr in enumerate(prs):
+            if pr.get("labels_match") is not True:
+                fail(f"per_rung[{i}] labels_match is not True")
+            if pr.get("ari") != 1.0:
+                fail(f"per_rung[{i}] ari is {pr.get('ari')!r}, not 1.0")
+
     # Sketch-prefilter contract (ISSUE 17): a sketch row must carry a
     # positive resolved projection width, a band fraction in [0, 1],
     # the cross-route byte-parity claim, per-dim counts parity, the GM

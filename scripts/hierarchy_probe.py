@@ -1,0 +1,198 @@
+#!/usr/bin/env python
+"""CI probe for the density hierarchy (ISSUE 18).
+
+One distance pass, a whole dendrogram: measures the eps-free path —
+mutual-reachability MST + stability-condensed tree over the cached
+neighbor-pair graph — by timing an 8-rung ``sweep(X, "auto")`` ladder
+(rungs picked by HDBSCAN*-style excess-of-mass stability) against 8
+independent ``fit()`` runs at the very same eps values, cold staging
+on both sides.  Gates, enforced here (nonzero exit) and re-checked by
+``scripts/check_bench_json.py``:
+
+* ``distance_passes == 1`` for the whole ladder (core distances, MST,
+  condensation and every rung's flat labels ride ONE cached graph);
+* ladder wall <= 0.2x the sum of the solo fits
+  (``hierarchy_amortization >= 5``);
+* per-rung labels BYTE-IDENTICAL to the solo fits (and ARI == 1.0);
+* ``boruvka_rounds <= round_cap`` (= ceil(log2(live components)) + 1)
+  and ``mst_edges == n_live - n_components`` — the spanning-forest
+  invariant, pinned from telemetry, not recomputed.
+
+Emits ONE bench-style JSON row: ``metric="hierarchy_amortization"``,
+``value`` = (sum of solo walls) / ladder wall, ``schema`` =
+``pypardis_tpu/hierarchy@1``, the per-rung parity table, the
+``hierarchy`` telemetry block and the full ``run_report@1`` telemetry.
+Geometry via env: HIER_N (default 16000), HIER_DIM (4), HIER_K
+(8 ladder rungs), HIER_BLOCK (128).  The graph ceiling is pinned via
+PYPARDIS_HIER_EPS_MAX (default 0.2 here) so the slab stays the same
+size class as the sweep probe's; unset geometry knobs inherit the
+sweep probe's well-separated-centers regime where cross-route byte
+parity is exact.
+"""
+
+import json
+import os
+import sys
+import time
+
+_N_DEV = int(os.environ.get("PYPARDIS_PROBE_DEVICES", "8"))
+if os.environ.get("PYPARDIS_PROBE_PLATFORM") != "native":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={_N_DEV}"
+        ).strip()
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+
+if os.environ.get("PYPARDIS_PROBE_PLATFORM") != "native":
+    jax.config.update("jax_platforms", "cpu")
+    if "jax_num_cpu_devices" in jax.config._value_holders:
+        jax.config.update("jax_num_cpu_devices", _N_DEV)
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _geometry(n: int, dim: int):
+    """The sweep probe's well-separated Gaussian clusters (pairwise
+    center distance >= ~4 vs std 0.15): no border point ever touches
+    two clusters, so every ladder rung's byte parity vs its solo fit
+    is unambiguous (verified for the pinned seed)."""
+    rng = np.random.default_rng(11)
+    k = 8
+    centers = rng.normal(size=(k, dim))
+    centers *= 4.0 / np.linalg.norm(centers, axis=1, keepdims=True)
+    centers = centers * (1.0 + np.arange(k)[:, None] * 0.5)
+    per = n // k
+    X = np.concatenate(
+        [
+            c + rng.normal(scale=0.15, size=(per, dim))
+            for c in centers
+        ]
+        + [rng.normal(scale=0.15, size=(n - per * k, dim)) + centers[0]]
+    )
+    return X.astype(np.float64)
+
+
+def main() -> None:
+    from pypardis_tpu import DBSCAN
+    from pypardis_tpu.parallel import default_mesh, staging
+    from sklearn.metrics import adjusted_rand_score
+
+    n = int(os.environ.get("HIER_N", 16000))
+    dim = int(os.environ.get("HIER_DIM", 4))
+    k_cfg = int(os.environ.get("HIER_K", 8))
+    block = int(os.environ.get("HIER_BLOCK", 128))
+    ms = 5
+    # Pin the graph ceiling: the adaptive sample-kNN heuristic is a
+    # deliberate overestimate, which on this geometry would connect
+    # whole clusters and balloon the slab past the sweep probe's size
+    # class without changing what the probe measures.
+    os.environ.setdefault("PYPARDIS_HIER_EPS_MAX", "0.2")
+    os.environ["PYPARDIS_HIER_LADDER_K"] = str(k_cfg)
+    X = _geometry(n, dim)
+    mesh = default_mesh(min(_N_DEV, jax.device_count()))
+    kw = dict(min_samples=ms, block=block, mesh=mesh)
+
+    # -- warm-up (compiles) -------------------------------------------
+    DBSCAN(eps=None, **kw).sweep(X, "auto")
+    DBSCAN(eps=0.15, **kw).fit(X)
+
+    # -- measured ladder (cold staging, warm jit; best of 2) ----------
+    ladder_samples = []
+    for _rep in range(2):
+        staging.clear()
+        model = DBSCAN(eps=None, **kw)
+        t0 = time.perf_counter()
+        res = model.sweep(X, "auto")
+        ladder_samples.append(time.perf_counter() - t0)
+    ladder_wall = min(ladder_samples)
+    tel = model.report()
+    hier = tel["hierarchy"]
+    ladder = [float(e) for e in tel["sweep"]["ladder"]]
+    assert len(ladder) == k_cfg, (
+        f"auto ladder has {len(ladder)} rungs, requested {k_cfg}"
+    )
+
+    # -- measured solo fits at the SAME eps values --------------------
+    staging.clear()
+    solo_walls = []
+    solo_labels = {}
+    for e in ladder:
+        m = DBSCAN(eps=e, **kw)
+        t0 = time.perf_counter()
+        m.fit(X)
+        solo_walls.append(time.perf_counter() - t0)
+        solo_labels[e] = np.asarray(m.labels_)
+    solo_wall = float(sum(solo_walls))
+
+    # -- gates --------------------------------------------------------
+    assert tel["sweep"]["distance_passes"] == 1, (
+        f"ladder ran {tel['sweep']['distance_passes']} distance "
+        f"passes, expected 1"
+    )
+    assert hier["distance_passes"] == 1
+    assert hier["boruvka_rounds"] <= hier["round_cap"], (
+        f"Boruvka took {hier['boruvka_rounds']} rounds, cap "
+        f"{hier['round_cap']}"
+    )
+    assert hier["mst_edges"] == hier["n_live"] - hier["n_components"], (
+        f"MST has {hier['mst_edges']} edges for {hier['n_live']} live "
+        f"points / {hier['n_components']} components — not a spanning "
+        f"forest"
+    )
+    per_rung = []
+    for e in ladder:
+        match = bool(np.array_equal(res.labels(e, ms), solo_labels[e]))
+        ari = float(
+            adjusted_rand_score(solo_labels[e], res.labels(e, ms))
+        )
+        assert match, f"labels differ from solo fit at eps={e}"
+        assert ari == 1.0, f"ARI {ari} != 1.0 at eps={e}"
+        per_rung.append(
+            {
+                "eps": e,
+                "min_samples": ms,
+                "labels_match": match,
+                "ari": ari,
+                "n_clusters": int(res.labels(e, ms).max()) + 1,
+            }
+        )
+    amortization = solo_wall / max(ladder_wall, 1e-9)
+    assert amortization >= 5.0, (
+        f"ladder wall {ladder_wall:.2f}s not <= 0.2x the "
+        f"{solo_wall:.2f}s sum of {k_cfg} solo fits (amortization "
+        f"{amortization:.2f})"
+    )
+
+    row = {
+        "metric": "hierarchy_amortization",
+        "value": round(amortization, 3),
+        "unit": "x",
+        "schema": "pypardis_tpu/hierarchy@1",
+        "n": n,
+        "dim": dim,
+        "k": k_cfg,
+        "distance_passes": 1,
+        "graph_pairs": int(hier["graph_pairs"]),
+        "mst_edges": int(hier["mst_edges"]),
+        "boruvka_rounds": int(hier["boruvka_rounds"]),
+        "round_cap": int(hier["round_cap"]),
+        "eps_selected": float(hier["eps_selected"]),
+        "ladder": ladder,
+        "ladder_wall_s": round(ladder_wall, 4),
+        "solo_wall_s": round(solo_wall, 4),
+        "samples_s": [round(s, 4) for s in ladder_samples],
+        "per_rung": per_rung,
+        "hierarchy": dict(hier),
+        "telemetry": tel,
+    }
+    print(json.dumps(row))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,440 @@
+"""Density hierarchy over the cached pair graph (ISSUE 18).
+
+One distance pass at a data-derived ceiling materializes the
+neighbor-pair graph; per-point core distances, the mutual-reachability
+MST (Borůvka rounds), and the condensed dendrogram with HDBSCAN*'s
+excess-of-mass stability rule turn it into the ENTIRE continuous
+clustering family.  The correctness bar:
+
+* ``DBSCAN(eps=None).fit(X)`` labels byte-identical to a solo
+  ``fit(eps_)`` at the stability-selected eps, deterministic across
+  repeated fits and across fused/KD/global-Morton (min-core-gid canon);
+* every rung of the ``sweep(eps_list="auto")`` ladder byte-identical to
+  an independent ``fit(eps)`` at that config, on both kernel backends;
+* MST weights equal a scipy ``minimum_spanning_tree`` oracle on the
+  truncated mutual-reachability matrix;
+* degenerate geometries (duplicates, all-noise, single cluster) and the
+  jitted core-distance twin's bitwise parity with the host pass.
+"""
+
+import numpy as np
+import pytest
+from sklearn.datasets import make_blobs
+
+from pypardis_tpu import DBSCAN
+from pypardis_tpu.ops import densify_labels
+from pypardis_tpu.ops.distances import neighbor_pair_graph_host
+from pypardis_tpu.ops.hierarchy import (
+    build_hierarchy,
+    core_distances,
+    core_distances_device,
+    hierarchy_prepare,
+    mutual_reachability_mst,
+    thr_from_user_eps,
+    user_eps_from_thr,
+)
+from pypardis_tpu.parallel import default_mesh
+
+MS = 5
+
+
+@pytest.fixture(scope="module")
+def blobs():
+    X, _ = make_blobs(
+        n_samples=1200, centers=5, n_features=3, cluster_std=0.3,
+        random_state=3,
+    )
+    return X
+
+
+def _canon(labels, core):
+    from pypardis_tpu.parallel.sharded import _canonicalize_roots
+
+    return densify_labels(
+        _canonicalize_roots(np.asarray(labels), np.asarray(core))
+    )
+
+
+def _solo(X, eps, ms, **kw):
+    m = DBSCAN(eps=eps, min_samples=ms, **kw)
+    m.fit(X)
+    return np.asarray(m.labels_), np.asarray(m.core_sample_mask_)
+
+
+def _graph_state(X, eps_max, ms, block=128):
+    """The ops-level harness: padded host pair graph + prepared state."""
+    n, d = X.shape
+    cap = -(-n // block) * block
+    P = np.zeros((cap, d), np.float32)
+    P[:n] = X
+    mask = np.zeros(cap, bool)
+    mask[:n] = True
+    gi, gj, dv, _ = neighbor_pair_graph_host(
+        P, mask, eps_max, metric="euclidean", block=block
+    )
+    state = hierarchy_prepare(gi, gj, dv)
+    return state, mask, cap
+
+
+# -- eps=None fits ------------------------------------------------------
+
+
+def test_eps_none_fit_selects_stable_cut(blobs):
+    m = DBSCAN(eps=None, min_samples=MS, block=128, mesh=default_mesh(1))
+    m.fit(blobs)
+    assert m.eps_ is not None and m.eps_ > 0
+    assert m.eps is None  # the constructor spec survives the fit
+    # Labels byte-identical to a solo fit at the selected eps.
+    ref_l, ref_c = _solo(blobs, m.eps_, MS, block=128,
+                         mesh=default_mesh(1))
+    np.testing.assert_array_equal(m.labels_, ref_l)
+    np.testing.assert_array_equal(np.asarray(m.core_sample_mask_), ref_c)
+    h = m.report()["hierarchy"]
+    assert h["distance_passes"] == 1
+    assert h["boruvka_rounds"] <= h["round_cap"]
+    assert h["mst_edges"] > 0 and h["condensed_clusters"] >= 1
+    assert h["selected_clusters"] >= 1
+    assert h["eps_selected"] == m.eps_
+    assert 0 < h["eps_selected"] <= h["eps_max"] * (1 + 1e-6)
+    assert "hierarchy" in m.summary()
+
+
+def test_eps_none_determinism_across_fits(blobs):
+    a = DBSCAN(eps=None, min_samples=MS, block=128).fit(blobs)
+    b = DBSCAN(eps=None, min_samples=MS, block=128).fit(blobs)
+    assert a.eps_ == b.eps_
+    np.testing.assert_array_equal(a.labels_, b.labels_)
+    np.testing.assert_array_equal(
+        np.asarray(a.core_sample_mask_), np.asarray(b.core_sample_mask_)
+    )
+
+
+def test_eps_none_across_modes(blobs):
+    """fused vs KD vs global-Morton: same selected eps, canon-identical
+    labels (min-core-gid), each at one distance pass."""
+    runs = {}
+    for tag, kw in (
+        ("fused", dict(mesh=default_mesh(1))),
+        ("kd", dict(mesh=default_mesh(8))),
+        ("gm", dict(mesh=default_mesh(8), mode="global_morton")),
+    ):
+        m = DBSCAN(eps=None, min_samples=MS, block=128, **kw)
+        m.fit(blobs)
+        h = m.report()["hierarchy"]
+        assert h["distance_passes"] == 1, tag
+        assert h["boruvka_rounds"] <= h["round_cap"], tag
+        runs[tag] = (m.eps_, _canon(m.labels_, m.core_sample_mask_))
+    eps0, canon0 = runs["fused"]
+    for tag, (e, c) in runs.items():
+        assert e == eps0, tag
+        np.testing.assert_array_equal(c, canon0, err_msg=tag)
+
+
+def test_eps_none_serving_uses_selected_eps(blobs):
+    """predict/serving against an eps=None model runs at the
+    stability-selected ``eps_`` (the validate.py contract)."""
+    m = DBSCAN(eps=None, min_samples=MS, block=128).fit(blobs)
+    pred = m.predict(np.asarray(blobs[:32], np.float64))
+    np.testing.assert_array_equal(np.asarray(pred), m.labels_[:32])
+    assert m.kernel_eps == np.float32(m.eps_)
+
+
+def test_min_cluster_size_controls_condensation(blobs):
+    """A larger min_cluster_size prunes the condensed tree — never
+    more condensed clusters than the default, same one-pass cost."""
+    small = DBSCAN(eps=None, min_samples=MS, block=128).fit(blobs)
+    big = DBSCAN(
+        eps=None, min_samples=MS, min_cluster_size=100, block=128
+    ).fit(blobs)
+    hs = small.report()["hierarchy"]
+    hb = big.report()["hierarchy"]
+    assert hb["condensed_clusters"] <= hs["condensed_clusters"]
+    assert hb["distance_passes"] == 1
+    # And the flat labels still match a solo fit at ITS selected eps.
+    ref_l, _ = _solo(blobs, big.eps_, MS, block=128)
+    np.testing.assert_array_equal(big.labels_, ref_l)
+
+
+# -- the auto ladder ----------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "tag,kw",
+    [
+        ("fused", dict(mesh=None)),
+        ("kd", dict(mesh="mesh8")),
+        ("gm", dict(mesh="mesh8", mode="global_morton")),
+    ],
+)
+def test_auto_ladder_rung_parity(blobs, tag, kw):
+    """Every rung of the dendrogram-extracted eps ladder byte-identical
+    to a solo fit(eps) on the same mode."""
+    kw = dict(kw)
+    kw["mesh"] = default_mesh(8) if kw["mesh"] == "mesh8" \
+        else default_mesh(1)
+    m = DBSCAN(eps=None, min_samples=MS, block=128, **kw)
+    res = m.sweep(blobs, eps_list="auto")
+    assert res.stats["distance_passes"] == 1
+    assert res.stats["eps_source"] == "hierarchy_auto"
+    ladder = res.stats["ladder"]
+    assert ladder == sorted(ladder)
+    assert len(res.configs) == len(ladder)
+    for eps, ms in res.configs:
+        ref_l, ref_c = _solo(blobs, eps, ms, block=128, **kw)
+        np.testing.assert_array_equal(
+            res.labels(eps, ms), ref_l, err_msg=f"{tag} eps={eps}"
+        )
+        np.testing.assert_array_equal(
+            res.core(eps, ms), ref_c, err_msg=f"{tag} eps={eps}"
+        )
+
+
+@pytest.mark.parametrize("backend", ["xla", "pallas"])
+def test_auto_ladder_kernel_backends(blobs, backend, monkeypatch):
+    """The ladder rides the same cached graph under both kernel
+    backends (pallas in interpret mode on the CPU mesh, the
+    test_pallas.py convention)."""
+    if backend == "pallas":
+        import functools
+
+        from pypardis_tpu.ops import pallas_kernels as pk
+
+        monkeypatch.setattr(
+            pk, "neighbor_counts_pallas",
+            functools.partial(pk.neighbor_counts_pallas, interpret=True),
+        )
+        monkeypatch.setattr(
+            pk, "min_neighbor_label_pallas",
+            functools.partial(
+                pk.min_neighbor_label_pallas, interpret=True
+            ),
+        )
+    kw = dict(block=128, mesh=default_mesh(1), kernel_backend=backend)
+    m = DBSCAN(eps=None, min_samples=MS, **kw)
+    res = m.sweep(blobs, eps_list="auto")
+    assert res.stats["distance_passes"] == 1
+    for eps, ms in res.configs[:3]:
+        ref_l, _ = _solo(blobs, eps, ms, **kw)
+        np.testing.assert_array_equal(
+            res.labels(eps, ms), ref_l, err_msg=f"{backend} eps={eps}"
+        )
+
+
+def test_auto_ladder_multi_min_samples(blobs):
+    """min_samples_list x auto ladder: each (eps, ms) rung cuts the
+    RIGHT ms's hierarchy (cd2 differs per ms) and matches a solo fit."""
+    m = DBSCAN(eps=None, min_samples=MS, block=128)
+    res = m.sweep(blobs, eps_list="auto", min_samples_list=[3, 8])
+    assert {ms for _, ms in res.configs} == {3, 8}
+    for eps, ms in res.configs:
+        ref_l, _ = _solo(blobs, eps, ms, block=128)
+        np.testing.assert_array_equal(
+            res.labels(eps, ms), ref_l, err_msg=f"eps={eps} ms={ms}"
+        )
+
+
+def test_sweep_rejects_unknown_eps_string(blobs):
+    with pytest.raises(ValueError):
+        DBSCAN(eps=None, min_samples=MS).sweep(blobs, eps_list="all")
+
+
+# -- MST oracle ---------------------------------------------------------
+
+
+def test_mst_weights_match_scipy_oracle():
+    """Borůvka over the pair slab == scipy minimum_spanning_tree on the
+    dense mutual-reachability matrix truncated at the ceiling (same
+    edge-weight multiset; total weight equal at f32 resolution)."""
+    from scipy.sparse import csr_matrix
+    from scipy.sparse.csgraph import minimum_spanning_tree
+
+    rng = np.random.default_rng(7)
+    X = np.concatenate([
+        rng.normal(c, 0.25, size=(100, 3)) for c in
+        ([0, 0, 0], [4, 0, 0], [0, 4, 0], [2, 2, 3])
+    ]).astype(np.float32)
+    n = len(X)
+    eps_max = 1.2
+    state, mask, cap = _graph_state(X, eps_max, MS)
+    cd2 = core_distances(state, mask, MS)
+    mi, mj, mw, info = mutual_reachability_mst(state, cd2, cap)
+    assert info["boruvka_rounds"] <= info["round_cap"]
+    assert info["mst_edges"] == info["n_live"] - info["n_components"]
+
+    # Oracle: the dense mutual-reachability matrix over the SLAB's own
+    # d2 entries (the kernels' exact f32 arithmetic — a numpy
+    # recomputation differs in last-ulp accumulation order), truncated
+    # at the ceiling like the cached family is.
+    gi_s, gj_s, dv_s = state[0], state[1], state[2]
+    w = np.zeros((n, n), np.float64)
+    live = (
+        np.isfinite(dv_s) & (gi_s != gj_s) & (gi_s < n) & (gj_s < n)
+    )
+    mre = np.maximum(
+        dv_s[live], np.maximum(cd2[gi_s[live]], cd2[gj_s[live]])
+    )
+    keep = np.isfinite(mre)
+    w[gi_s[live][keep], gj_s[live][keep]] = mre[keep]
+    oracle = minimum_spanning_tree(csr_matrix(np.triu(w)))
+    ow = np.sort(np.asarray(oracle[oracle.nonzero()]).ravel())
+    got = np.sort(np.asarray(mw, np.float64))
+    assert len(got) == len(ow)
+    np.testing.assert_allclose(got, ow, rtol=1e-6)
+
+
+def test_core_distances_device_twin_bitwise():
+    """The jitted k-th-smallest segment reduction == the host pass,
+    bitwise, across min_samples values."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(300, 3)).astype(np.float32)
+    state, mask, cap = _graph_state(X, 1.5, MS)
+    gi_s, gj_s, dv_s = state[0], state[1], state[2]
+    for ms in (1, 2, 5, 11):
+        host = core_distances(state, mask, ms)
+        dev = np.asarray(core_distances_device(
+            jnp.asarray(gi_s), jnp.asarray(gj_s), jnp.asarray(dv_s),
+            jnp.asarray(mask), ms,
+        ))
+        np.testing.assert_array_equal(host, dev, err_msg=f"ms={ms}")
+
+
+def test_thr_user_eps_round_trip():
+    """thr_from_user_eps and user_eps_from_thr replicate the engines'
+    exact f32 framing, both directions, for every metric frame."""
+    for frame, eps in (("euclidean", 0.37), ("cityblock", 0.52),
+                       ("cosine", 0.02), ("haversine", 0.1)):
+        thr = thr_from_user_eps(eps, frame)
+        rt = user_eps_from_thr(thr, frame)
+        assert thr_from_user_eps(rt, frame) == thr, frame
+
+
+# -- degenerate geometries ----------------------------------------------
+
+
+def test_duplicate_points_collapse_to_one_cluster():
+    X = np.tile(np.array([[1.0, 2.0, 3.0]], np.float32), (64, 1))
+    X = np.concatenate([X, np.tile([[9.0, 9.0, 9.0]], (64, 1))])
+    m = DBSCAN(eps=None, min_samples=MS, block=128).fit(X)
+    assert m.eps_ > 0
+    lab = np.asarray(m.labels_)
+    assert set(lab[:64]) == {lab[0]} and set(lab[64:]) == {lab[64]}
+    ref_l, _ = _solo(X, m.eps_, MS, block=128)
+    np.testing.assert_array_equal(lab, ref_l)
+
+
+def test_all_noise_geometry(monkeypatch):
+    """Points mutually farther than the (pinned) ceiling: everything
+    noise, the fit still completes with a deterministic eps_.  The
+    ceiling must be pinned — the adaptive sample-kNN heuristic scales
+    past any spacing by construction (it is an overestimate)."""
+    monkeypatch.setenv("PYPARDIS_HIER_EPS_MAX", "1.0")
+    X = (np.arange(32, dtype=np.float32)[:, None] * 1000.0) * np.ones(
+        (1, 3), np.float32
+    )
+    a = DBSCAN(eps=None, min_samples=MS, block=128).fit(X)
+    b = DBSCAN(eps=None, min_samples=MS, block=128).fit(X)
+    assert a.eps_ == b.eps_ and a.eps_ > 0
+    assert (np.asarray(a.labels_) == -1).all()
+    assert a.report()["hierarchy"]["mst_edges"] == 0
+    ref_l, _ = _solo(X, a.eps_, MS, block=128)
+    np.testing.assert_array_equal(a.labels_, ref_l)
+    # An adaptive-ceiling fit on the same geometry chains everything
+    # into one cluster instead — the truncated-family honesty caveat.
+    monkeypatch.delenv("PYPARDIS_HIER_EPS_MAX")
+    c = DBSCAN(eps=None, min_samples=MS, block=128).fit(X)
+    ref_l, _ = _solo(X, c.eps_, MS, block=128)
+    np.testing.assert_array_equal(c.labels_, ref_l)
+
+
+def test_single_cluster_geometry():
+    rng = np.random.default_rng(5)
+    X = rng.normal(0, 0.1, size=(200, 3)).astype(np.float32)
+    m = DBSCAN(eps=None, min_samples=MS, block=128).fit(X)
+    lab = np.asarray(m.labels_)
+    assert lab.max() == 0  # exactly one cluster
+    h = m.report()["hierarchy"]
+    assert h["mst_edges"] == 199  # n_live - 1, one component
+    ref_l, _ = _solo(X, m.eps_, MS, block=128)
+    np.testing.assert_array_equal(lab, ref_l)
+
+
+# -- validation surface -------------------------------------------------
+
+
+def test_eps_validation_rules():
+    # eps=None legal at construction; concrete invalids still fail.
+    DBSCAN(eps=None)
+    with pytest.raises(ValueError):
+        DBSCAN(eps=0.0)
+    with pytest.raises(ValueError):
+        DBSCAN(eps=-1.0)
+    with pytest.raises(ValueError):
+        DBSCAN(eps=float("nan"))
+    with pytest.raises(ValueError):
+        DBSCAN(eps=float("inf"))
+    with pytest.raises(ValueError):
+        DBSCAN(eps=None, min_cluster_size=1)
+    # An unfitted eps=None model has no radius to serve at.
+    m = DBSCAN(eps=None)
+    with pytest.raises(RuntimeError):
+        _ = m.kernel_eps
+    from pypardis_tpu.utils.validate import validate_params
+
+    with pytest.raises(ValueError):
+        validate_params(None, 5)  # downstream call sites stay strict
+    validate_params(None, 5, allow_none_eps=True)
+
+
+def test_eps_none_rejects_resume_and_empty():
+    m = DBSCAN(eps=None, min_samples=MS)
+    with pytest.raises(ValueError):
+        m.train(np.zeros((0, 3), np.float32))
+    with pytest.raises(ValueError):
+        m.train(np.ones((16, 3), np.float32), resume="ckpt.npz")
+
+
+def test_hier_env_ceiling_override(blobs, monkeypatch):
+    """PYPARDIS_HIER_EPS_MAX pins the graph ceiling (user frame); the
+    selected eps never exceeds it and labels stay solo-fit-exact."""
+    monkeypatch.setenv("PYPARDIS_HIER_EPS_MAX", "0.9")
+    m = DBSCAN(eps=None, min_samples=MS, block=128).fit(blobs)
+    h = m.report()["hierarchy"]
+    assert h["eps_max"] == pytest.approx(0.9)
+    assert m.eps_ <= 0.9 * (1 + 1e-6)
+    ref_l, _ = _solo(blobs, m.eps_, MS, block=128)
+    np.testing.assert_array_equal(m.labels_, ref_l)
+
+
+def test_hier_ladder_k_env(blobs, monkeypatch):
+    monkeypatch.setenv("PYPARDIS_HIER_LADDER_K", "3")
+    m = DBSCAN(eps=None, min_samples=MS, block=128)
+    res = m.sweep(blobs, eps_list="auto")
+    assert len(res.stats["ladder"]) <= 3
+
+
+# -- ops-level hierarchy invariants -------------------------------------
+
+
+def test_labels_at_thr_matches_host_engine(blobs):
+    """Dendrogram cuts at arbitrary thresholds == the host relabel
+    engine over the same graph — the backbone identity."""
+    from pypardis_tpu.ops.labels import graph_dbscan_host
+
+    X = np.asarray(blobs, np.float32)
+    eps_max = 1.2
+    state, mask, cap = _graph_state(X, eps_max, MS)
+    thr_max = float(np.float32(eps_max) ** 2)
+    hier = build_hierarchy(
+        state, mask, cap, MS, kernel_metric="euclidean",
+        user_frame="euclidean", thr_max=thr_max,
+    )
+    for eps in (0.2, 0.35, 0.5, 0.8, 1.1):
+        thr = float(np.float32(eps) ** 2)
+        lab, core = hier.labels_at_thr(thr)
+        ref_lab, ref_core, _passes = graph_dbscan_host(
+            state, mask, eps, MS, metric="euclidean"
+        )
+        np.testing.assert_array_equal(lab, ref_lab, err_msg=str(eps))
+        np.testing.assert_array_equal(core, ref_core, err_msg=str(eps))

@@ -375,6 +375,88 @@ def test_device_route_ladder_retries_and_recovers(blobs, monkeypatch):
         )
 
 
+def _ladder_geometry():
+    """Deterministic 1024-point set whose KD shards climb the edge-budget
+    ladder twice: a sparse shard is processed first (tiny need, rung 1 at
+    the 4096 floor), then a 256-point tight blob's shard needs ~33k edges
+    (rung 2).  Densities chosen so every later shard fits the grown
+    budget — the event count is exact, not a lower bound."""
+    rng = np.random.default_rng(13)
+
+    def loose(n, x0, span):
+        pts = rng.uniform(0, span, size=(n, 3)).astype(np.float32)
+        pts[:, 0] += x0
+        return pts
+
+    def tight(n, x0, std):
+        pts = rng.normal(0.0, std, size=(n, 3)).astype(np.float32)
+        pts[:, 0] += x0
+        return pts
+
+    return np.concatenate([
+        loose(384, 0.0, 40.0),     # sparse head: first shard, ~100 pairs
+        tight(128, 60.0, 0.05),    # dense, fits once the ladder grew
+        tight(256, 80.0, 0.05),    # densest: ~33k pairs on one shard
+        loose(256, 100.0, 40.0),   # sparse tail
+    ])
+
+
+def test_device_route_ladder_multi_rung(monkeypatch):
+    """The PR 13 NOTE debt: drive the per-shard edge-budget ladder
+    through >= 2 growth rungs in ONE sweep (64 -> 4096 floor -> exact
+    retry total) and pin the event count byte-exactly alongside label
+    parity with the untouched host route."""
+    X = _ladder_geometry()
+    kw = dict(block=128, mesh=default_mesh(8))
+    staging.clear()
+    ref = DBSCAN(eps=0.4, min_samples=5, **kw).sweep(X, EPS_LIST)
+
+    monkeypatch.setenv("PYPARDIS_SWEEP_EMISSION", "device")
+    monkeypatch.setenv("PYPARDIS_SWEEP_EDGE_BUDGET", "64")
+    staging.clear()
+    m = DBSCAN(eps=0.4, min_samples=5, **kw)
+    res = m.sweep(X, EPS_LIST)
+    rep = m.report()
+    assert rep["sweep"]["degraded"] is None
+    assert rep["sweep"]["mode"] == "kd"
+    # Exactly two rungs: the sparse first shard trips the undersized
+    # budget onto the 4096 floor, the dense shard trips that onto its
+    # exact round_up total, and every later shard inherits the ceiling.
+    assert rep["events"]["pair_overflow"] == 2
+    for eps in EPS_LIST:
+        np.testing.assert_array_equal(
+            res.labels(eps), ref.labels(eps), err_msg=str(eps)
+        )
+        np.testing.assert_array_equal(
+            res.core(eps), ref.core(eps), err_msg=str(eps)
+        )
+
+
+def test_eps_none_fit_rides_device_ladder(monkeypatch):
+    """An eps=None hierarchy fit is built on the same cached pair graph,
+    so the forced device route's budget ladder serves it unchanged:
+    same two rungs, and the stability-selected labels are byte-identical
+    to the host-emission fit."""
+    X = _ladder_geometry()
+    kw = dict(block=128, mesh=default_mesh(8))
+    monkeypatch.setenv("PYPARDIS_HIER_EPS_MAX", "0.4")
+    staging.clear()
+    ref = DBSCAN(eps=None, min_samples=5, **kw).fit(X)
+
+    monkeypatch.setenv("PYPARDIS_SWEEP_EMISSION", "device")
+    monkeypatch.setenv("PYPARDIS_SWEEP_EDGE_BUDGET", "64")
+    staging.clear()
+    m = DBSCAN(eps=None, min_samples=5, **kw).fit(X)
+    rep = m.report()
+    assert rep["events"]["pair_overflow"] == 2
+    assert rep["hierarchy"]["distance_passes"] == 1
+    assert m.eps_ == ref.eps_
+    np.testing.assert_array_equal(m.labels_, ref.labels_)
+    np.testing.assert_array_equal(
+        m.core_sample_mask_, ref.core_sample_mask_
+    )
+
+
 def test_device_route_cap_overflow_degrades(blobs, monkeypatch):
     """The hard PYPARDIS_SWEEP_MAX_PAIRS cap on the device route:
     SweepGraphOverflow -> label-safe per-config refits, telemetry
