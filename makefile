@@ -5,9 +5,9 @@ PY ?= python
 
 .PHONY: all wheel native test verify lint tpu-smoke bench bench-smoke \
 	partition-probe serve-probe live-probe ingest-probe \
-	global-morton-probe fault-probe bench-diff flight-check \
-	northstar northstar-smoke streammem-probe sort-probe \
-	kernel-probe sweep-probe hierarchy-probe tune-probe \
+	gateway-probe global-morton-probe fault-probe bench-diff \
+	flight-check northstar northstar-smoke streammem-probe \
+	sort-probe kernel-probe sweep-probe hierarchy-probe tune-probe \
 	sketch-probe monitor monitor-probe demo clean
 
 all: native test
@@ -62,9 +62,9 @@ bench:
 # then the CI-sized partitioner depth-scaling probe (fails when the
 # level builder's mp-doubling cost ratio exceeds 1.5x).
 bench-smoke: lint partition-probe serve-probe live-probe ingest-probe \
-		global-morton-probe fault-probe bench-diff flight-check \
-		northstar-smoke kernel-probe sweep-probe hierarchy-probe \
-		tune-probe sketch-probe monitor-probe
+		gateway-probe global-morton-probe fault-probe bench-diff \
+		flight-check northstar-smoke kernel-probe sweep-probe \
+		hierarchy-probe tune-probe sketch-probe monitor-probe
 	JAX_PLATFORMS=cpu BENCH_N=2000 BENCH_DIM=4 BENCH_REPS=1 \
 	BENCH_DEV_REPS=1 $(PY) bench.py \
 	| $(PY) scripts/bench_diff.py --annotate --baseline-dir . \
@@ -251,6 +251,21 @@ ingest-probe:
 	JAX_PLATFORMS=cpu \
 	INGEST_N=$${INGEST_N:-4000} INGEST_SECONDS=$${INGEST_SECONDS:-2.0} \
 	$(PY) scripts/ingest_probe.py \
+	| $(PY) scripts/bench_diff.py --annotate --baseline-dir . \
+	| $(PY) scripts/check_bench_json.py --require-diff
+
+# Multi-tenant gateway probe (ISSUE 19): >= 8 registered models under
+# a device-slab byte budget that forces LRU eviction, readmitted
+# predictions byte-identical to pre-eviction, per-tenant quota
+# shedding isolated, then Zipf-distributed multi-tenant traffic
+# across >= 1 mid-run hot-swap epoch swap with zero dropped tickets —
+# emitted as the schema'd gateway@1 row through the bench_diff
+# cross-round gate.
+gateway-probe:
+	JAX_PLATFORMS=cpu \
+	GATEWAY_MODELS=$${GATEWAY_MODELS:-10} \
+	GATEWAY_SECONDS=$${GATEWAY_SECONDS:-2.0} \
+	$(PY) scripts/gateway_probe.py \
 	| $(PY) scripts/bench_diff.py --annotate --baseline-dir . \
 	| $(PY) scripts/check_bench_json.py --require-diff
 

@@ -339,9 +339,14 @@ def save_index(index, path: str) -> None:
     )
 
 
-def load_index(path: str):
+def load_index(path: str, handle=None):
     """Restore a serving index saved by :func:`save_index` (slabs load
-    byte-identical, so a restored index serves identical answers)."""
+    byte-identical, so a restored index serves identical answers).
+
+    ``handle`` names the model the restored index serves in a
+    multi-model plane — the index then stages under its own per-handle
+    route (the gateway readmission path restores an evicted model this
+    way)."""
     from .serve import CorePointIndex
 
     with np.load(_norm_npz(path), allow_pickle=False) as z:
@@ -349,6 +354,7 @@ def load_index(path: str):
             raise ValueError(f"{path} is not a serving-index checkpoint")
         params = json.loads(str(z["params"]))
         idx = CorePointIndex(
+            handle=handle,
             eps=params["eps"],
             center=z["center"],
             tree=z["tree"],

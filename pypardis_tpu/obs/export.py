@@ -389,19 +389,55 @@ def _om_label(value) -> str:
     )
 
 
+# Label-bearing key convention (the multi-tenant gateway's fleet
+# telemetry): inside a ``gateway.``-rooted key, a ``model.<id>`` or
+# ``tenant.<id>`` segment pair renders as an OpenMetrics LABEL rather
+# than a name segment — ``gateway.model.m03.queries`` becomes
+# ``pypardis_gateway_queries{model="m03"}`` — so one scrape shows every
+# resident model/tenant as series of the same family instead of N
+# distinct metric names.
+_OM_LABEL_SEGMENTS = ("model", "tenant")
+
+
+def _om_key_labels(key: str):
+    """Split a registry key into (OpenMetrics family name, rendered
+    label block) per the convention above; non-gateway keys pass
+    through unchanged with an empty label block."""
+    parts = str(key).split(".")
+    if parts[0] != "gateway":
+        return _om_name(key), ""
+    kept, labels, i = [], [], 0
+    while i < len(parts):
+        if parts[i] in _OM_LABEL_SEGMENTS and i + 1 < len(parts) - 1:
+            labels.append((parts[i], parts[i + 1]))
+            i += 2
+        else:
+            kept.append(parts[i])
+            i += 1
+    name = _om_name(".".join(kept))
+    if not labels:
+        return name, ""
+    lab = ",".join(f'{k}="{_om_label(v)}"' for k, v in labels)
+    return name, lab
+
+
 def _om_hist(out: List[str], key: str, snap: Dict) -> None:
     """Append one ``hist@1`` snapshot as an OpenMetrics histogram
-    family (cumulative ``_bucket{le=...}`` series + count + sum)."""
-    n = _om_name(key)
+    family (cumulative ``_bucket{le=...}`` series + count + sum);
+    gateway per-model/per-tenant keys carry their label block on every
+    series."""
+    n, lab = _om_key_labels(key)
+    pre = lab + "," if lab else ""
+    suf = "{" + lab + "}" if lab else ""
     out.append(f"# TYPE {n} histogram")
     cum = 0
     for le, c in snap.get("buckets") or ():
         cum += int(c)
-        out.append(f'{n}_bucket{{le="{float(le):g}"}} {cum}')
+        out.append(f'{n}_bucket{{{pre}le="{float(le):g}"}} {cum}')
     cum += int(snap.get("overflow", 0) or 0)
-    out.append(f'{n}_bucket{{le="+Inf"}} {cum}')
-    out.append(f"{n}_count {int(snap.get('count', cum))}")
-    out.append(f"{n}_sum {float(snap.get('sum_ms', 0.0))}")
+    out.append(f'{n}_bucket{{{pre}le="+Inf"}} {cum}')
+    out.append(f"{n}_count{suf} {int(snap.get('count', cum))}")
+    out.append(f"{n}_sum{suf} {float(snap.get('sum_ms', 0.0))}")
 
 
 def render_openmetrics(reg_dump: Dict,
@@ -410,22 +446,29 @@ def render_openmetrics(reg_dump: Dict,
     — counters, gauges, timing summaries, histogram bucket series, open
     spans, heartbeats, resource watermarks, terminated by ``# EOF``."""
     out: List[str] = []
+    seen_type: set = set()
     for key in sorted(reg_dump.get("counters") or {}):
         v = reg_dump["counters"][key]
         if not isinstance(v, (int, float)) or isinstance(v, bool):
             continue
-        n = _om_name(key)
-        out.append(f"# TYPE {n} counter")
-        out.append(f"{n}_total {v}")
+        n, lab = _om_key_labels(key)
+        if n not in seen_type:
+            seen_type.add(n)
+            out.append(f"# TYPE {n} counter")
+        suf = "{" + lab + "}" if lab else ""
+        out.append(f"{n}_total{suf} {v}")
     for key in sorted(reg_dump.get("gauges") or {}):
         v = reg_dump["gauges"][key]
         if isinstance(v, bool):
             v = int(v)
         if not isinstance(v, (int, float)):
             continue
-        n = _om_name(key)
-        out.append(f"# TYPE {n} gauge")
-        out.append(f"{n} {v}")
+        n, lab = _om_key_labels(key)
+        if n not in seen_type:
+            seen_type.add(n)
+            out.append(f"# TYPE {n} gauge")
+        suf = "{" + lab + "}" if lab else ""
+        out.append(f"{n}{suf} {v}")
     for key in sorted(reg_dump.get("timings") or {}):
         t = reg_dump["timings"][key]
         n = _om_name(key) + "_seconds"

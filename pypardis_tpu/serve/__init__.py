@@ -31,21 +31,48 @@ the clustering under insert/delete, and the streaming-ingest layer
 one recluster dispatch + one index delta per batch) and LSM-style
 background compaction (:class:`Compactor`) with an atomic whole-index
 epoch swap that never drops in-flight tickets.
+
+Above all of it sits the multi-tenant plane: :class:`ModelGateway`
+(:mod:`.gateway`) composes N model handles — each index staged under
+its own device route — behind one registry with a device-slab byte
+budget (LRU spill via ``save_index``, byte-identical readmission via
+``load_index``) and one shared admission controller (per-tenant token
+buckets; over-quota requests shed with :class:`TenantQuotaExceeded`
+before touching any engine, full queues with :class:`QueueFull`,
+blown deadlines with :class:`DeadlineExceeded`).
+:func:`gateway_load` drives Zipf-distributed tenant traffic through
+it (``make gateway-probe``).
 """
 
-from .engine import QueryEngine, ReplicatedQueryEngine
+from .engine import DeadlineExceeded, QueryEngine, QueueFull, \
+    ReplicatedQueryEngine
+from .gateway import (
+    GatewayError,
+    ModelGateway,
+    ModelNotRegistered,
+    StaleModelHandle,
+    TenantQuotaExceeded,
+)
 from .index import CorePointIndex, build_index
 from .ingest import Compactor, IngestQueue
 from .live import LiveModel
-from .load import sustained_load
+from .load import gateway_load, sustained_load
 
 __all__ = [
     "Compactor",
     "CorePointIndex",
+    "DeadlineExceeded",
+    "GatewayError",
     "IngestQueue",
+    "ModelGateway",
+    "ModelNotRegistered",
     "QueryEngine",
+    "QueueFull",
     "ReplicatedQueryEngine",
+    "StaleModelHandle",
+    "TenantQuotaExceeded",
     "LiveModel",
     "build_index",
+    "gateway_load",
     "sustained_load",
 ]

@@ -118,11 +118,20 @@ class QueryEngine:
         max_pending: int = 1 << 16,
         precision: str = "high",
         model=None,
+        handle: Optional[str] = None,
     ):
         from ..obs import RunRecorder
         from ..utils.validate import check_precision
 
         self.index = index
+        # Model handle: which model this engine serves.  Defaults to
+        # the index's own handle — the explicit thread the gateway
+        # pulls on when composing N engines; ``None`` keeps the
+        # historical one-model-per-process behavior.
+        self.handle = (
+            str(handle) if handle is not None
+            else getattr(index, "handle", None)
+        )
         # Staleness guard: an engine built from a model records the
         # model's fit generation; a caller holding this engine across a
         # REFIT gets a clear error instead of silently serving the
@@ -165,9 +174,10 @@ class QueryEngine:
     @classmethod
     def from_model(cls, model, *, leaves=None, block: int = 256,
                    qblock: int = 128, backend: Optional[str] = None,
-                   **kw) -> "QueryEngine":
+                   handle: Optional[str] = None, **kw) -> "QueryEngine":
         index = build_index(
-            model, leaves=leaves, block=block, qblock=qblock
+            model, leaves=leaves, block=block, qblock=qblock,
+            handle=handle,
         )
         if backend is None:
             backend = getattr(model, "kernel_backend", "auto")
@@ -373,6 +383,7 @@ class QueryEngine:
 
         st = self.index.stats
         return {
+            "model": self.handle or "default",
             "queries": int(self.queries),
             "batches": int(self.batches),
             "qps": round(self.queries / self._busy_s, 1)
@@ -385,7 +396,11 @@ class QueryEngine:
             "n_core": int(self.index.n_core),
             "n_leaves": int(st.get("n_leaves", 0)),
             "index_bytes": int(st.get("index_bytes", 0)),
-            "index_device_bytes": int(staging.route_nbytes("serve_index")),
+            "index_device_bytes": int(
+                staging.route_nbytes(
+                    getattr(self.index, "staging_route", "serve_index")
+                )
+            ),
             "staged_bytes_reused": int(st.get("staged_bytes_reused", 0)),
             "backend": str(self.backend),
             "precision": str(self.precision),
@@ -405,7 +420,11 @@ class QueryEngine:
                 getattr(self.index, "generation", 0)
             ),
             "index_delta_bytes": int(
-                staging.route_delta_nbytes("serve_index_delta")
+                staging.route_delta_nbytes(
+                    getattr(
+                        self.index, "delta_route", "serve_index_delta"
+                    )
+                )
             ),
             # Full bounded-histogram snapshot (pypardis_tpu/hist@1):
             # windowed percentiles + lifetime bucket counts, what the

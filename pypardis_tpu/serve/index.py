@@ -82,7 +82,19 @@ class CorePointIndex:
         self, *, eps, center, tree, coords, labels, blo, bhi,
         block: int, qblock: int, n_core: int, stats: Optional[Dict] = None,
         leaf_slabs: Optional[Dict] = None, gids=None,
+        handle: Optional[str] = None,
     ):
+        # Model handle: names which fitted model this index serves.
+        # ``None`` keeps the historical single-model staging route
+        # (``serve_index``); a named handle gets its OWN route, so N
+        # resident indexes coexist in the device cache instead of
+        # evicting each other through the one-entry-per-route rule —
+        # the seam the multi-tenant gateway composes over.
+        self.handle = None if handle is None else str(handle)
+        self.staging_route = (
+            "serve_index" if self.handle is None
+            else f"serve_index.{self.handle}"
+        )
         self.eps = float(eps)
         self.eps2 = eps2_f32(eps)
         self.center = np.asarray(center, np.float64)
@@ -149,7 +161,7 @@ class CorePointIndex:
     def build(
         cls, cores, labels, eps, *, leaves: Optional[int] = None,
         block: int = 256, qblock: int = 128, seed: int = 0,
-        stage: bool = True, center=None,
+        stage: bool = True, center=None, handle: Optional[str] = None,
     ):
         """Index ``(n_core, d)`` core points with their cluster labels.
 
@@ -188,6 +200,7 @@ class CorePointIndex:
                 blo=np.empty((0, d), np.float32),
                 bhi=np.empty((0, d), np.float32),
                 block=int(block), qblock=int(qblock), n_core=0,
+                handle=handle,
             )
             idx.stats = {"n_core": 0, "n_leaves": 0, "build_s": 0.0,
                          "index_bytes": 0, "staged_bytes_reused": 0,
@@ -237,7 +250,7 @@ class CorePointIndex:
         idx = cls(
             eps=eps, center=center, tree=tree, coords=coords,
             labels=slab_labels, blo=blo, bhi=bhi, block=block,
-            qblock=int(qblock), n_core=n,
+            qblock=int(qblock), n_core=n, handle=handle,
         )
         idx.src_index = src_index
         # The constructor's slab map derives from stats["leaf_cap"],
@@ -308,6 +321,12 @@ class CorePointIndex:
 
     # -- device residency -------------------------------------------------
 
+    @property
+    def delta_route(self) -> str:
+        """Staging route of this index's live-update deltas (per
+        handle, like :attr:`staging_route`)."""
+        return self.staging_route + "_delta"
+
     def _content_key(self):
         from ..parallel import staging
 
@@ -319,8 +338,10 @@ class CorePointIndex:
 
     def device_arrays(self):
         """The staged (coords, labels, blo, bhi) device arrays —
-        content-keyed through the ``serve_index`` staging route, so a
-        rebuilt index over the same clustering reuses device memory."""
+        content-keyed through this handle's staging route
+        (:attr:`staging_route`), so a rebuilt index over the same
+        clustering reuses device memory, and indexes of DIFFERENT
+        handles never evict each other."""
         if self._dev is not None:
             return self._dev
         import jax.numpy as jnp
@@ -328,12 +349,12 @@ class CorePointIndex:
         from ..parallel import staging
 
         key = self._content_key()
-        cached = staging.device_get("serve_index", key)
+        cached = staging.device_get(self.staging_route, key)
         if cached is not None:
             arrays, _aux = cached
         else:
             arrays = staging.device_put_cached(
-                "serve_index", key,
+                self.staging_route, key,
                 (
                     jnp.asarray(self.coords),
                     jnp.asarray(self.labels),
@@ -627,8 +648,8 @@ class CorePointIndex:
                 delta += 2 * self.blo[brows].nbytes
             self._dev = (coords_d, labels_d, blo_d, bhi_d)
             staging.device_replace(
-                "serve_index", self._content_key(), self._dev,
-                staged_nbytes=delta, delta_route="serve_index_delta",
+                self.staging_route, self._content_key(), self._dev,
+                staged_nbytes=delta, delta_route=self.delta_route,
             )
         self.epoch += 1
         self.delta_bytes += int(delta)
@@ -676,7 +697,7 @@ class CorePointIndex:
         # content key (a FULL re-ship, the compaction's one bulk
         # transfer — write deltas stay cheap between swaps).
         self._dev = None
-        staging.device_evict("serve_index")
+        staging.device_evict(self.staging_route)
         self._base_cols = int(self.coords.shape[1])
         self.deltas_since_compact = 0
         self.generation += 1
@@ -843,9 +864,14 @@ def _model_core_set(model):
 
 def build_index(
     model, *, leaves=None, block: int = 256, qblock: int = 128,
-    seed: int = 0,
+    seed: int = 0, handle=None,
 ):
     """Serving index of a fitted (or checkpoint-loaded) ``DBSCAN``.
+
+    ``handle`` names the model in a multi-model serving plane: the
+    index stages under its own per-handle route so a
+    :class:`~pypardis_tpu.serve.gateway.ModelGateway` fleet of N
+    resident indexes shares the device cache without collisions.
 
     A ``metric='cosine'`` model indexes in its unit-sphere kernel
     frame: the core coordinates are already normalized (the model's
@@ -860,7 +886,7 @@ def build_index(
     eps = float(getattr(model, "kernel_eps", model.eps))
     idx = CorePointIndex.build(
         cores, labels, eps, leaves=leaves, block=block,
-        qblock=qblock, seed=seed,
+        qblock=qblock, seed=seed, handle=handle,
     )
     metric_norm = getattr(model, "_metric_norm", None)
     idx.unit_norm = metric_norm == "cosine"

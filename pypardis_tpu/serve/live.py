@@ -82,9 +82,16 @@ class LiveModel:
 
     def __init__(self, model, *, leaves: Optional[int] = None,
                  block: int = 256, qblock: int = 128, warm: bool = True,
+                 handle: Optional[str] = None,
                  _resume: Optional[Dict] = None, **engine_kw):
         model._require_fitted()
         self.model = model
+        # Model handle: names this model in a multi-model serving
+        # plane — threaded into the engine/index build so the mutable
+        # index stages under its own per-handle route (the gateway's
+        # composition seam); ``None`` keeps the historical
+        # one-model-per-process route.
+        self.handle = None if handle is None else str(handle)
         self.eps = float(model.eps)
         self.min_samples = int(model.min_samples)
         self._fit_generation = getattr(model, "_fit_generation", 0)
@@ -167,12 +174,13 @@ class LiveModel:
             self.index = _resume["index"]
             self.engine = QueryEngine(
                 self.index, backend=model.kernel_backend, model=model,
-                **engine_kw,
+                handle=self.handle, **engine_kw,
             )
             model._serve_engine = self.engine
         else:
             self.engine = model.query_engine(
-                block=block, qblock=qblock, **engine_kw
+                block=block, qblock=qblock, handle=self.handle,
+                **engine_kw
             )
             self.index = self.engine.index
             self.index.attach_gids(np.flatnonzero(core))
@@ -697,6 +705,7 @@ class LiveModel:
 
         c = self._counters
         self.stats.update({
+            "model": self.handle or "default",
             "points": int(self._alive[:self._n].sum()),
             "cores": int(self._core[:self._n][
                 self._alive[:self._n]].sum()),
@@ -711,7 +720,11 @@ class LiveModel:
             "index_epoch": int(self.index.epoch),
             "index_delta_bytes": int(self.index.delta_bytes),
             "index_delta_route_bytes": int(
-                staging.route_delta_nbytes("serve_index_delta")
+                staging.route_delta_nbytes(
+                    getattr(
+                        self.index, "delta_route", "serve_index_delta"
+                    )
+                )
             ),
             "insert_p50_ms": _pct(self._ins_ms, 50),
             "insert_p99_ms": _pct(self._ins_ms, 99),

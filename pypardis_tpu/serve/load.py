@@ -445,3 +445,276 @@ def _sustained_load(
             if p99_in > 0 and p99_out > 0 else 0.0
         ),
     }
+
+
+def gateway_load(gateway, model_ids, **kw) -> Dict:
+    """Multi-tenant fleet traffic against a :class:`~pypardis_tpu.
+    serve.gateway.ModelGateway` (see :func:`_gateway_load` for every
+    knob); attaches the live export plane for the run the way
+    :func:`sustained_load` does."""
+    exporters = attach_exporters(getattr(gateway, "recorder", None))
+    try:
+        return _gateway_load(gateway, model_ids, **kw)
+    finally:
+        if exporters is not None:
+            exporters.close()
+
+
+def _gateway_load(
+    gateway,
+    model_ids,
+    *,
+    tenants: int = 4,
+    clients_per_tenant: int = 1,
+    duration_s: float = 2.0,
+    rate_hz: float = 120.0,
+    batch_rows: int = 8,
+    zipf_s: float = 1.2,
+    write_fraction: float = 0.0,
+    seed: int = 0,
+    submit_timeout_s: Optional[float] = None,
+    refresh_at_s: Optional[float] = None,
+    refresher: Optional[Callable] = None,
+    query_sampler: Optional[Callable] = None,
+) -> Dict:
+    """Drive ``tenants`` x ``clients_per_tenant`` open-loop Poisson
+    clients through the gateway's admission gate.
+
+    Each client's per-request model choice is **Zipf-distributed**
+    (p(rank) proportional to ``(rank+1)**-zipf_s``) over a per-tenant
+    *rotation* of ``model_ids`` — every tenant has a different hot
+    model, so under a residency budget the fleet's long tail churns
+    through eviction/readmission while each tenant's head stays warm
+    (the access pattern LRU is built for).  ``write_fraction`` of a
+    tenant's requests become single-point live inserts when the chosen
+    model is a live handle (measured as update-visible round trips);
+    non-live choices fall back to reads.
+
+    Sheds are first-class: :class:`~pypardis_tpu.serve.gateway.
+    TenantQuotaExceeded` (per-tenant quota) and
+    :class:`~pypardis_tpu.serve.QueueFull` are counted per tenant, and
+    the client backs off — the harness never aborts on admission
+    control doing its job.  ``refresher()`` (e.g. a closure around
+    ``gateway.refresh``) fires once from the pump thread at
+    ``refresh_at_s`` seconds — the hot swap lands mid-traffic, and the
+    zero-dropped-tickets contract is checked the same way the ingest
+    harness checks the Compactor's.
+
+    Read latencies are classified against the gateway's eviction and
+    swap windows (``read_p99_in_window_ms`` vs
+    ``read_p99_outside_ms``) — residency churn and epoch swaps are
+    synchronous under the gateway lock, so completed windows are
+    authoritative by sweep time.
+    """
+    from .engine import QueueFull
+    from .gateway import TenantQuotaExceeded
+
+    model_ids = [str(m) for m in model_ids]
+    if not model_ids:
+        raise ValueError("gateway_load needs at least one model id")
+    tenant_names = [f"t{i:02d}" for i in range(int(tenants))]
+    lock = gateway.lock
+    # Zipf pmf over model ranks, shared by every client; each tenant
+    # rotates the model order so rank 0 (the hot model) differs.
+    ranks = np.arange(len(model_ids), dtype=np.float64)
+    pmf = (ranks + 1.0) ** -float(zipf_s)
+    pmf /= pmf.sum()
+
+    bounds: Dict[str, tuple] = {}
+
+    def _default_sampler(rng, n, mid):
+        # Lazily captured per-model sampling box (resolving the handle
+        # under the lock readmits an evicted model — the serving path).
+        box = bounds.get(mid)
+        if box is None:
+            with lock:
+                idx = gateway.handle(mid).index
+                sel = (np.asarray(idx.labels)
+                       != np.iinfo(np.int32).max)
+                if sel.any():
+                    lo = idx.coords[:, sel].min(axis=1) - idx.eps
+                    hi = idx.coords[:, sel].max(axis=1) + idx.eps
+                    center = idx.center
+                else:
+                    lo = np.full(idx.d, -1.0)
+                    hi = np.full(idx.d, 1.0)
+                    center = np.zeros(idx.d)
+                box = bounds[mid] = (lo, hi, center, int(idx.d))
+        lo, hi, center, d = box
+        return rng.uniform(lo, hi, size=(n, d)) + center
+
+    if query_sampler is None:
+        query_sampler = _default_sampler
+
+    pending: deque = deque()  # (ticket, t_submit) for window classing
+    hist_all = Histogram()
+    hist_in = Histogram()   # reads completing inside evict/swap windows
+    hist_out = Histogram()
+    hist_vis = Histogram()
+    n_tickets = [0]
+    n_queries = [0]
+    n_failed = [0]
+    n_writes = [0]
+    shed_by_tenant = {t: 0 for t in tenant_names}
+    errors: list = []
+    stop = threading.Event()
+    t_start = time.perf_counter()
+    deadline = t_start + float(duration_s)
+
+    def _windows():
+        return list(gateway.evict_windows) + list(gateway.swap_windows)
+
+    def _sweep_resolved() -> None:
+        windows = _windows()
+        for _ in range(len(pending)):
+            t, t_sub = pending.popleft()
+            if not t.done:
+                pending.append((t, t_sub))
+                continue
+            if t.failed:
+                n_failed[0] += 1
+            else:
+                n_queries[0] += t.n
+            if t.latency_ms is not None:
+                hist_all.observe(t.latency_ms)
+                done_at = t_sub + t.latency_ms / 1e3
+                (hist_in if any(a <= done_at <= b
+                                for a, b in windows)
+                 else hist_out).observe(t.latency_ms)
+
+    def client(tenant: str, tidx: int, cid: int) -> None:
+        rng = np.random.default_rng(
+            seed * 10000 + tidx * 100 + cid
+        )
+        order = list(np.roll(model_ids, tidx))
+        while time.perf_counter() < deadline and not stop.is_set():
+            time.sleep(float(rng.exponential(1.0 / rate_hz)))
+            if time.perf_counter() >= deadline:
+                break
+            mid = order[int(rng.choice(len(order), p=pmf))]
+            try:
+                q = np.asarray(query_sampler(rng, batch_rows, mid))
+                if write_fraction > 0 and rng.random() < write_fraction:
+                    with lock:
+                        h = gateway.handle(mid)
+                        if h.live is not None:
+                            t0 = time.perf_counter()
+                            h.live.insert(q[:1])
+                            gateway.predict(
+                                mid, q[:1], tenant=tenant,
+                                timeout_s=submit_timeout_s,
+                            )
+                            hist_vis.observe(
+                                (time.perf_counter() - t0) * 1e3
+                            )
+                            n_writes[0] += 1
+                            continue
+                with lock:
+                    t = gateway.submit(
+                        mid, q, tenant=tenant,
+                        timeout_s=submit_timeout_s,
+                    )
+                    pending.append((t, t._t_submit))
+                    n_tickets[0] += 1
+            except (TenantQuotaExceeded, QueueFull):
+                # Admission control working as designed: the open-loop
+                # client drops the request and keeps arriving.
+                shed_by_tenant[tenant] += 1
+            except Exception as e:  # noqa: BLE001 — harness must drain
+                errors.append(e)
+                stop.set()
+                return
+
+    refreshed = [False]
+
+    def pump() -> None:
+        while not stop.is_set():
+            try:
+                with lock:
+                    gateway.drain()
+                    _sweep_resolved()
+                if (
+                    refresher is not None and not refreshed[0]
+                    and refresh_at_s is not None
+                    and time.perf_counter() - t_start >= refresh_at_s
+                ):
+                    refreshed[0] = True
+                    refresher()
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+                stop.set()
+                return
+            time.sleep(0.0005)
+            if time.perf_counter() >= deadline:
+                return  # stragglers resolve in the final drain below
+
+    threads = [
+        threading.Thread(
+            target=client, args=(tenant, tidx, cid), daemon=True
+        )
+        for tidx, tenant in enumerate(tenant_names)
+        for cid in range(int(clients_per_tenant))
+    ]
+    pump_t = threading.Thread(target=pump, daemon=True)
+    for t in threads:
+        t.start()
+    pump_t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    pump_t.join()
+    if refresher is not None and not refreshed[0]:
+        refreshed[0] = True
+        refresher()  # a short run must still exercise the swap
+    with lock:
+        gateway.drain()
+        _sweep_resolved()
+    wall = time.perf_counter() - t_start
+    if errors:
+        raise errors[0]
+
+    dropped = len(pending)
+    p99_in = hist_in.percentile(99, window=False) \
+        if hist_in.count else 0.0
+    p99_out = hist_out.percentile(99, window=False) \
+        if hist_out.count else 0.0
+    report = gateway.gateway_report()
+    return {
+        "arrival": "poisson-zipf",
+        "zipf_s": float(zipf_s),
+        "tenants": int(tenants),
+        "clients_per_tenant": int(clients_per_tenant),
+        "models": len(model_ids),
+        "duration_s": round(wall, 3),
+        "rate_hz": float(rate_hz),
+        "requests": int(n_tickets[0]) + int(n_writes[0]),
+        "queries": int(n_queries[0]),
+        "writes": int(n_writes[0]),
+        "write_fraction": float(write_fraction),
+        "qps": round(n_queries[0] / wall, 1) if wall > 0 else 0.0,
+        "p50_ms": hist_all.percentile(50),
+        "p99_ms": hist_all.percentile(99),
+        "latency_hist": hist_all.snapshot(),
+        "update_visible_p50_ms": hist_vis.percentile(50),
+        "update_visible_p99_ms": hist_vis.percentile(99),
+        "shed": int(sum(shed_by_tenant.values())),
+        "shed_by_tenant": {
+            t: int(n) for t, n in shed_by_tenant.items()
+        },
+        "deadline_failures": int(n_failed[0]),
+        "submit_timeout_s": (
+            float(submit_timeout_s) if submit_timeout_s else 0.0
+        ),
+        # The zero-dropped-tickets contract across eviction,
+        # readmission, AND the mid-run epoch swap.
+        "dropped_tickets": dropped,
+        # Residency-churn / swap overlap: read p99 completing inside an
+        # eviction-or-swap window vs fully outside one.
+        "read_p99_in_window_ms": p99_in,
+        "read_p99_outside_ms": p99_out,
+        "window_degradation": (
+            round(p99_in / p99_out, 3)
+            if p99_in > 0 and p99_out > 0 else 0.0
+        ),
+        "gateway": report,
+    }

@@ -161,6 +161,27 @@ _DECLARATIONS: Tuple[EnvVar, ...] = (
     EnvVar("PYPARDIS_COMPACT_SLAB_BYTES", "int", "67108864",
            "Compact once the index's appended slabs hold this many "
            "bytes."),
+    # -- serving gateway ----------------------------------------------
+    EnvVar("PYPARDIS_GATEWAY_BUDGET_BYTES", "int", "0 (unlimited)",
+           "Device-slab byte budget across a gateway's resident "
+           "model indexes; registering past it evicts LRU models "
+           "(save_index spill, byte-identical reload on demand)."),
+    EnvVar("PYPARDIS_GATEWAY_EVICTION", "str", "lru",
+           "Gateway eviction policy under budget pressure: `lru` "
+           "(least recently served first) or `largest` (biggest "
+           "resident index first)."),
+    EnvVar("PYPARDIS_GATEWAY_SPILL_DIR", "path",
+           "~/.cache/pypardis_tpu/gateway",
+           "Directory for evicted-model index spills (one npz per "
+           "evicted model, reloaded byte-identical on readmission)."),
+    EnvVar("PYPARDIS_GATEWAY_TENANT_BURST", "float", "8",
+           "Default token-bucket burst capacity (requests) per "
+           "tenant — how far a tenant may briefly exceed its QPS "
+           "quota."),
+    EnvVar("PYPARDIS_GATEWAY_TENANT_QPS", "float", "0 (unlimited)",
+           "Default per-tenant admission quota in requests/s "
+           "(token bucket); 0 disables quota shedding for tenants "
+           "without an explicit quota."),
     # -- fault tolerance ----------------------------------------------
     EnvVar("PYPARDIS_FAULTS", "spec", "unset",
            "Deterministic fault-injection plan: "

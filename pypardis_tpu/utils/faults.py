@@ -62,6 +62,10 @@ very machinery a real fault would exercise):
 ``compact.phase``      each streaming-ingest compaction phase boundary
                        (snapshot / refit / build / swap, occurrences
                        1..4 per cycle — ``serve.ingest.Compactor``)
+``gateway.admit``      every gateway admission decision
+                       (``serve.gateway.ModelGateway`` — fired before
+                       the quota check, so an injected fault is shed
+                       upstream and no engine state mutates)
 ===================== ====================================================
 
 Zero-cost when unset: ``maybe_fail`` is one module-global ``is None``
@@ -100,6 +104,7 @@ KNOWN_SITES = (
     "serve.drain",
     "ingest.batch",
     "compact.phase",
+    "gateway.admit",
 )
 
 _ENTRY_RE = re.compile(

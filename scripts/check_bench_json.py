@@ -440,6 +440,95 @@ def main() -> None:
         if "live" not in tel:
             fail("ingest_mixed_load row without telemetry.live block")
 
+    # Multi-tenant gateway contract (ISSUE 19): the fleet row is the
+    # "many models, many tenants, one accelerator" artifact — a
+    # registry of >= 8 models under a byte budget that actually forced
+    # eviction, readmission proven byte-identical, >= 1 hot-swap epoch
+    # swap landed mid-traffic with ZERO dropped tickets, and per-tenant
+    # windowed latency (plus the inside/outside eviction+swap-window
+    # split) carried as measured histograms, not prose.
+    if str(row["metric"]) == "gateway_fleet_load":
+        if row.get("schema") != "pypardis_tpu/gateway@1":
+            fail(f"gateway row schema is {row.get('schema')!r}")
+        if row.get("reload_byte_identical") is not True:
+            fail(
+                f"reload_byte_identical is "
+                f"{row.get('reload_byte_identical')!r}; readmitted "
+                f"models must answer bitwise equal to pre-eviction"
+            )
+        load = row.get("load")
+        if not isinstance(load, dict):
+            fail("gateway_fleet_load row without the load payload")
+        if load.get("arrival") != "poisson-zipf":
+            fail(f"load.arrival is {load.get('arrival')!r}")
+        if int(load.get("tenants", 0)) < 2:
+            fail(f"gateway load ran {load.get('tenants')!r} "
+                 f"tenant(s), need >= 2")
+        gwrep = load.get("gateway")
+        if not isinstance(gwrep, dict):
+            fail("gateway load without the gateway_report block")
+        if gwrep.get("schema") != "pypardis_tpu/gateway_report@1":
+            fail(
+                f"gateway_report schema is {gwrep.get('schema')!r}"
+            )
+        if int(gwrep.get("models_registered", 0)) < 8:
+            fail(
+                f"gateway served {gwrep.get('models_registered')!r} "
+                f"model(s), need >= 8"
+            )
+        if int(gwrep.get("budget_bytes", 0)) <= 0:
+            fail("gateway ran without a residency byte budget")
+        if int(gwrep.get("resident_bytes", -1)) > \
+                int(gwrep.get("budget_bytes", 0)):
+            fail(
+                f"resident bytes {gwrep.get('resident_bytes')!r} "
+                f"exceed the budget {gwrep.get('budget_bytes')!r}"
+            )
+        for key in ("evictions", "reloads", "epoch_swaps"):
+            if int(gwrep.get(key, 0)) < 1:
+                fail(f"gateway load saw no {key}; the budget/swap "
+                     f"machinery did not exercise")
+        if int(load.get("dropped_tickets", -1)) != 0:
+            fail(
+                f"gateway load dropped "
+                f"{load.get('dropped_tickets')!r} ticket(s); "
+                f"eviction, readmission, and the epoch swap must "
+                f"drain, never drop"
+            )
+        if int(load.get("deadline_failures", 0)) != 0:
+            fail(
+                f"gateway load failed "
+                f"{load.get('deadline_failures')!r} ticket(s)"
+            )
+        for key in ("qps", "p50_ms", "p99_ms",
+                    "read_p99_in_window_ms", "read_p99_outside_ms"):
+            v = load.get(key)
+            if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                    or v != v or v in (float("inf"), float("-inf")):
+                fail(f"load.{key} is {v!r}, expected a finite number")
+        check_hist(load.get("latency_hist"), "load.latency_hist")
+        tenants = gwrep.get("tenants")
+        if not isinstance(tenants, dict) or len(tenants) < 2:
+            fail(
+                f"gateway report carries "
+                f"{len(tenants) if isinstance(tenants, dict) else 0} "
+                f"tenant stat block(s), need >= 2"
+            )
+        for name, st in tenants.items():
+            for key in ("p50_ms", "p99_ms"):
+                v = st.get(key)
+                if not isinstance(v, (int, float)) \
+                        or isinstance(v, bool) or v != v \
+                        or v in (float("inf"), float("-inf")):
+                    fail(
+                        f"tenant {name!r} {key} is {v!r}, expected a "
+                        f"finite number"
+                    )
+            check_hist(
+                st.get("latency_hist"),
+                f"tenant {name!r} latency_hist",
+            )
+
     # Live-observability contract (ISSUE 16): a monitor row proves the
     # export plane actually answered DURING the fit — the probe must
     # have scraped the OpenMetrics endpoint mid-run (>= 1 scrape with

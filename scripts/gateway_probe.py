@@ -1,0 +1,199 @@
+#!/usr/bin/env python
+"""Multi-tenant gateway probe (``make gateway-probe``, wired into
+``bench-smoke``): registry residency under a byte budget, eviction/
+readmission byte-identity, admission control, and the hot-swap row.
+
+Asserted end to end (exits nonzero on any violation):
+
+1. **budgeted residency** — a registry of GATEWAY_MODELS (>= 8 for the
+   gate) fitted models under a device-slab byte budget sized to hold
+   all but ~1.5 of them: registration forces >= 1 LRU eviction and the
+   resident byte total never exceeds the budget;
+2. **byte-identical readmission** — a model's (labels, distances)
+   answered before its eviction equal its post-reload answers bitwise
+   (``save_index`` spill -> ``load_index`` restore);
+3. **admission control** — an over-quota tenant's requests shed with
+   ``TenantQuotaExceeded`` while the same gateway's unlimited tenants
+   shed nothing;
+4. **fleet traffic + hot swap** — Zipf-distributed multi-tenant load
+   (every tenant a different hot model, the long tail churning through
+   eviction/readmission) across >= 1 mid-run ``refresh()`` epoch swap,
+   zero dropped tickets, per-tenant latency histograms — emitted as
+   the schema'd ``gateway@1`` row (``gateway_fleet_load``), piped
+   through ``bench_diff --annotate`` into ``check_bench_json`` by the
+   make target.
+
+Env knobs: GATEWAY_MODELS (default 10), GATEWAY_N (600),
+GATEWAY_DIM (4), GATEWAY_TENANTS (4), GATEWAY_SECONDS (2.0).
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def fail(msg: str) -> None:
+    print(f"gateway probe FAILED: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main():
+    import numpy as np
+
+    from benchdata import make_separated_blob_data
+    from pypardis_tpu import DBSCAN
+    from pypardis_tpu.parallel.mesh import default_mesh
+    from pypardis_tpu.serve import (
+        ModelGateway,
+        TenantQuotaExceeded,
+        gateway_load,
+    )
+
+    n_models = int(os.environ.get("GATEWAY_MODELS", 10))
+    n = int(os.environ.get("GATEWAY_N", 600))
+    dim = int(os.environ.get("GATEWAY_DIM", 4))
+    tenants = int(os.environ.get("GATEWAY_TENANTS", 4))
+    seconds = float(os.environ.get("GATEWAY_SECONDS", 2.0))
+    eps, min_samples = 1.1 * (dim / 4) ** 0.5, 8
+    mesh = default_mesh(1)
+
+    def fit_model(seed):
+        X, _truth, _centers = make_separated_blob_data(
+            n, dim, n_centers=6, std=0.4,
+            min_sep=2 * eps + 6 * 0.4 + 1.0, spread=12.0, seed=seed,
+        )
+        m = DBSCAN(
+            eps=eps, min_samples=min_samples, block=256, mesh=mesh,
+        ).fit(X)
+        return m, X
+
+    # Identical shapes across the fleet: every model's engine reuses
+    # the same jitted query kernels — residency churn pays transfer
+    # cost, never recompilation.
+    fleet = {f"m{i:02d}": fit_model(seed=i) for i in range(n_models)}
+
+    spill_dir = tempfile.mkdtemp(prefix="pypardis_gateway_")
+    gw = ModelGateway(budget_bytes=0, spill_dir=spill_dir)
+    first = next(iter(fleet))
+    gw.register(first, fleet[first][0])
+    per = gw.handle(first).index_bytes
+    # Budget holds all but ~1.5 models: registering the full fleet
+    # MUST evict, and the gate's >= 8 registered models stay served.
+    gw.budget_bytes = int(per * (n_models - 1.5))
+    for mid, (m, _X) in fleet.items():
+        if mid != first:
+            gw.register(mid, m)
+
+    rep = gw.gateway_report()
+    if rep["models_registered"] != n_models:
+        fail(f"registered {rep['models_registered']} of {n_models}")
+    if rep["evictions"] < 1:
+        fail("budget forced no eviction at registration")
+    if rep["resident_bytes"] > rep["budget_bytes"]:
+        fail(
+            f"resident bytes {rep['resident_bytes']} exceed the "
+            f"budget {rep['budget_bytes']}"
+        )
+
+    # -- 2: eviction -> readmission byte-identity -------------------------
+    probe_mid = first
+    _m0, X0 = fleet[probe_mid]
+    Q = X0[:64]
+    pre = gw.predict(probe_mid, Q, return_distance=True)
+    # Touch every other model; the budget squeezes the probe model
+    # (now least-recently-served) out.
+    for mid, (_m, X) in fleet.items():
+        if mid != probe_mid:
+            gw.predict(mid, X[:8])
+    if gw.gateway_report()["models"][probe_mid]["resident"]:
+        fail("LRU did not evict the least-recently-served model")
+    post = gw.predict(probe_mid, Q, return_distance=True)
+    byte_identical = bool(
+        np.array_equal(pre[0], post[0])
+        and np.array_equal(pre[1], post[1])
+    )
+    if not byte_identical:
+        fail("readmitted model's answers differ from pre-eviction")
+    rep = gw.gateway_report()
+    if rep["reloads"] < 1:
+        fail("readmission did not reload the spilled index")
+    print(
+        f"gateway probe: {n_models} models under "
+        f"{gw.budget_bytes} B budget -> {rep['resident_models']} "
+        f"resident, {rep['evictions']} evictions, {rep['reloads']} "
+        f"reloads, readmission byte-identical",
+        file=sys.stderr,
+    )
+
+    # -- 3: admission control --------------------------------------------
+    gw.set_quota("spiky", qps=0.001, burst=2)
+    quota_sheds = 0
+    for _ in range(6):
+        try:
+            gw.predict(probe_mid, Q[:4], tenant="spiky")
+        except TenantQuotaExceeded:
+            quota_sheds += 1
+    if quota_sheds != 4:
+        fail(f"quota bucket(burst=2) shed {quota_sheds} of 6, "
+             f"expected 4")
+    if gw.gateway_report()["tenants"].get("default", {}).get("shed", 0):
+        fail("quota shedding leaked onto an unlimited tenant")
+
+    # -- 4: Zipf fleet traffic across a mid-run hot swap ------------------
+    swap_mid = f"m{n_models // 2:02d}"
+    m_new, X_new = fit_model(seed=1000 + n_models // 2)
+
+    res = gateway_load(
+        gw, list(fleet), tenants=tenants, clients_per_tenant=2,
+        duration_s=seconds, rate_hz=60.0, batch_rows=8,
+        zipf_s=1.2, seed=11,
+        refresh_at_s=seconds * 0.4,
+        refresher=lambda: gw.refresh(swap_mid, m_new),
+    )
+    if res["dropped_tickets"] != 0:
+        fail(
+            f"fleet load dropped {res['dropped_tickets']} ticket(s); "
+            f"eviction/readmission/swap must drain, never drop"
+        )
+    if res["deadline_failures"] != 0:
+        fail(f"fleet load failed {res['deadline_failures']} ticket(s)")
+    gwrep = res["gateway"]
+    if gwrep["epoch_swaps"] < 1:
+        fail("fleet load completed no epoch swap")
+    if gwrep["evictions"] < 1 or gwrep["reloads"] < 1:
+        fail(
+            f"fleet load saw {gwrep['evictions']} evictions / "
+            f"{gwrep['reloads']} reloads, need >= 1 of each"
+        )
+    if gwrep["resident_bytes"] > gwrep["budget_bytes"]:
+        fail(
+            f"post-load resident bytes {gwrep['resident_bytes']} "
+            f"exceed the budget {gwrep['budget_bytes']}"
+        )
+    # The swapped handle serves the refreshed clustering.
+    got = gw.predict(swap_mid, X_new[:32])
+    if not np.array_equal(got, m_new.predict(X_new[:32])):
+        fail("post-swap predictions diverge from the refreshed model")
+
+    row = {
+        "metric": "gateway_fleet_load",
+        "value": res["qps"],
+        "unit": "queries/sec",
+        "schema": "pypardis_tpu/gateway@1",
+        "models": n_models,
+        "budget_bytes": int(gw.budget_bytes),
+        "reload_byte_identical": byte_identical,
+        "quota_shed_demo": int(quota_sheds),
+        "load": res,
+        "telemetry": fleet[first][0].report(),
+    }
+    print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,228 @@
+"""Multi-tenant serving gateway (ISSUE 19): model registry under a
+device-slab byte budget, per-tenant admission control, hot swap.
+
+Contracts pinned here:
+
+* **Eviction round-trips byte-identical**: forcing a resident model
+  past the budget spills it (``save_index``) and frees its device
+  slabs; the next request readmits it (``load_index``) and its
+  predictions are bitwise equal to pre-eviction — LRU picks the
+  least-recently-served victim;
+* **epoch swap drops nothing**: ``refresh()`` under concurrent
+  multi-tenant load lands a new generation with zero dropped tickets,
+  and post-swap answers match the refreshed model's own ``predict``;
+* **quota shedding isolates tenants**: a hot tenant over its token
+  bucket sheds with :class:`TenantQuotaExceeded` while a quiet tenant
+  on the same gateway sheds nothing and resolves everything;
+* **staleness is refused, never silently served**: a refit after
+  registration raises :class:`StaleModelHandle` until ``refresh()``;
+  likewise unknown models raise :class:`ModelNotRegistered`;
+* the ``gateway.admit`` fault site fires at the front door — an
+  injected fault sheds the request before any engine state mutates.
+"""
+
+import numpy as np
+import pytest
+
+from benchdata import make_separated_blob_data
+from pypardis_tpu import DBSCAN
+from pypardis_tpu.parallel.mesh import default_mesh
+from pypardis_tpu.serve import (
+    GatewayError,
+    ModelGateway,
+    ModelNotRegistered,
+    StaleModelHandle,
+    TenantQuotaExceeded,
+    gateway_load,
+)
+from pypardis_tpu.utils import faults
+from pypardis_tpu.utils.faults import FaultInjected
+
+EPS, MS = 1.0, 5
+
+
+def _fit(seed=0, n=300, dim=4):
+    X, _truth, _centers = make_separated_blob_data(
+        n, dim, n_centers=4, std=0.35,
+        min_sep=2 * EPS + 6 * 0.35 + 1.0, spread=10.0, seed=seed,
+    )
+    m = DBSCAN(eps=EPS, min_samples=MS, mesh=default_mesh(1),
+               block=128).fit(X)
+    return m, X
+
+
+def _fleet(gw, k=3):
+    fleet = {}
+    for i in range(k):
+        m, X = _fit(seed=i)
+        mid = f"m{i:02d}"
+        gw.register(mid, m)
+        fleet[mid] = (m, X)
+    return fleet
+
+
+def test_eviction_reload_byte_identity(tmp_path):
+    gw = ModelGateway(spill_dir=str(tmp_path))
+    fleet = _fleet(gw, 3)
+    pre = {mid: m.predict(X[:40]) for mid, (m, X) in fleet.items()}
+    for mid, (m, X) in fleet.items():
+        np.testing.assert_array_equal(gw.predict(mid, X[:40]), pre[mid])
+
+    # Budget fits ~2 of the 3 residents; enforcement must evict
+    # exactly the least-recently-served model (m00: the serve loop
+    # above touched models in registration order, m02 last).
+    per = gw.handle("m01").index_bytes
+    gw.budget_bytes = int(per * 2.5)
+    gw._ensure_budget(keep="m02")
+    rep = gw.gateway_report()
+    assert rep["evictions"] == 1
+    assert rep["resident_models"] == 2
+    evicted = [m for m, b in rep["models"].items() if not b["resident"]]
+    assert evicted == ["m00"]
+    spills = list(tmp_path.glob("*.npz"))
+    assert len(spills) == 1 and spills[0].stem == "m00"
+
+    # Readmission on demand: answers bitwise equal to pre-eviction
+    # (load_index restores the slabs byte-identical), and the reload
+    # displaced the new least-recently-served resident.
+    np.testing.assert_array_equal(
+        gw.predict("m00", fleet["m00"][1][:40]), pre["m00"]
+    )
+    rep = gw.gateway_report()
+    assert rep["reloads"] == 1
+    assert rep["evictions"] == 2  # the new LRU resident made room
+    assert rep["models"]["m00"]["resident"]
+    # m02 is the victim: the handle("m01") byte probe above touched
+    # m01, leaving m02 least-recently-served among the residents.
+    assert not rep["models"]["m02"]["resident"]
+    assert rep["models"]["m01"]["resident"]
+
+
+def test_pinned_models_never_evicted(tmp_path):
+    gw = ModelGateway(spill_dir=str(tmp_path))
+    m0, X0 = _fit(seed=0)
+    m1, _ = _fit(seed=1)
+    gw.register("keep", m0, pin=True)
+    gw.register("spare", m1)
+    gw.budget_bytes = 1  # nothing fits; only the unpinned spills
+    gw._ensure_budget(keep="")
+    rep = gw.gateway_report()
+    assert rep["models"]["keep"]["resident"]
+    assert not rep["models"]["spare"]["resident"]
+    # The pinned model keeps serving without a reload.
+    np.testing.assert_array_equal(
+        gw.predict("keep", X0[:8]), m0.predict(X0[:8])
+    )
+    assert gw.gateway_report()["reloads"] == 0
+
+
+def test_epoch_swap_under_load_zero_drops(tmp_path):
+    gw = ModelGateway(spill_dir=str(tmp_path))
+    fleet = _fleet(gw, 3)
+    refreshed, _X2 = fleet["m02"]
+    m_new, X_new = _fit(seed=7)
+
+    res = gateway_load(
+        gw, list(fleet), tenants=3, clients_per_tenant=1,
+        duration_s=1.2, rate_hz=120.0, batch_rows=4, seed=3,
+        refresh_at_s=0.4,
+        refresher=lambda: gw.refresh("m02", m_new),
+    )
+    assert res["dropped_tickets"] == 0
+    assert res["deadline_failures"] == 0
+    assert res["gateway"]["epoch_swaps"] == 1
+    assert res["queries"] > 0
+    # Post-swap the handle serves the REFRESHED clustering.
+    np.testing.assert_array_equal(
+        gw.predict("m02", X_new[:30]), m_new.predict(X_new[:30])
+    )
+    # Per-tenant latency stats materialized for every tenant.
+    tenants = res["gateway"]["tenants"]
+    assert {"t00", "t01", "t02"} <= set(tenants)
+    for st in tenants.values():
+        assert np.isfinite(st["p99_ms"])
+
+
+def test_quota_shedding_isolates_tenants(tmp_path):
+    gw = ModelGateway(spill_dir=str(tmp_path))
+    m, X = _fit(seed=0)
+    gw.register("m00", m)
+    # Hot tenant: bucket of 3 then dry (refill is negligible within
+    # the loop); quiet tenant: unlimited.
+    gw.set_quota("hot", qps=0.001, burst=3)
+    hot_ok = hot_shed = 0
+    for _ in range(10):
+        try:
+            gw.predict("m00", X[:4], tenant="hot")
+            hot_ok += 1
+        except TenantQuotaExceeded:
+            hot_shed += 1
+    for _ in range(10):
+        gw.predict("m00", X[:4], tenant="quiet")  # never sheds
+    assert hot_ok == 3 and hot_shed == 7
+    rep = gw.gateway_report()
+    assert rep["tenants"]["hot"]["shed"] == 7
+    assert rep["tenants"]["quiet"]["shed"] == 0
+    assert rep["tenants"]["quiet"]["admitted"] == 10
+    assert rep["tenants"]["quiet"]["failed"] == 0
+    assert rep["admission_sheds"] == 7
+
+
+def test_stale_handle_rejected_after_refit(tmp_path):
+    gw = ModelGateway(spill_dir=str(tmp_path))
+    m, X = _fit(seed=0)
+    gw.register("m00", m)
+    gw.predict("m00", X[:4])
+    m.fit(X)  # refit bumps the model's fit generation
+    with pytest.raises(StaleModelHandle, match="refit after"):
+        gw.predict("m00", X[:4])
+    # refresh() adopts the new generation; serving resumes.
+    gw.refresh("m00")
+    np.testing.assert_array_equal(
+        gw.predict("m00", X[:30]), m.predict(X[:30])
+    )
+
+
+def test_unknown_model_and_double_register(tmp_path):
+    gw = ModelGateway(spill_dir=str(tmp_path))
+    m, X = _fit(seed=0)
+    with pytest.raises(ModelNotRegistered, match="no model 'nope'"):
+        gw.predict("nope", X[:2])
+    gw.register("m00", m)
+    with pytest.raises(GatewayError, match="already registered"):
+        gw.register("m00", m)
+    gw.unregister("m00")
+    with pytest.raises(ModelNotRegistered):
+        gw.predict("m00", X[:2])
+
+
+def test_admit_fault_site_sheds_upstream(tmp_path):
+    gw = ModelGateway(spill_dir=str(tmp_path))
+    m, X = _fit(seed=0)
+    gw.register("m00", m)
+    with faults.plan("gateway.admit:2=error"):
+        gw.predict("m00", X[:4], tenant="a")  # occurrence 1: clean
+        with pytest.raises(FaultInjected):
+            gw.predict("m00", X[:4], tenant="a")
+        # The injected fault landed BEFORE admission bookkeeping and
+        # before any engine touch: nothing shed, nothing failed, and
+        # the next request serves normally.
+        gw.predict("m00", X[:4], tenant="a")
+    rep = gw.gateway_report()
+    assert rep["admission_sheds"] == 0
+    assert rep["tenants"]["a"]["failed"] == 0
+    assert rep["tenants"]["a"]["admitted"] == 2
+
+
+def test_live_handle_is_pinned_and_writable(tmp_path):
+    gw = ModelGateway(spill_dir=str(tmp_path))
+    m, X = _fit(seed=0)
+    h = gw.register("m00", m, live=True)
+    assert h.pinned and h.live is not None
+    q = X[:1] + 0.05
+    h.live.insert(q)
+    labs = gw.predict("m00", q)
+    assert labs[0] == h.live.labels()[-1]
+    # Live handles refuse refresh(): the Compactor owns their swaps.
+    with pytest.raises(GatewayError, match="live handle"):
+        gw.refresh("m00")
