@@ -8,7 +8,7 @@ PY ?= python
 	gateway-probe global-morton-probe fault-probe bench-diff \
 	flight-check northstar northstar-smoke streammem-probe \
 	sort-probe kernel-probe sweep-probe hierarchy-probe tune-probe \
-	sketch-probe monitor monitor-probe demo clean
+	sketch-probe monitor monitor-probe multihost-probe demo clean
 
 all: native test
 
@@ -64,7 +64,8 @@ bench:
 bench-smoke: lint partition-probe serve-probe live-probe ingest-probe \
 		gateway-probe global-morton-probe fault-probe bench-diff \
 		flight-check northstar-smoke kernel-probe sweep-probe \
-		hierarchy-probe tune-probe sketch-probe monitor-probe
+		hierarchy-probe tune-probe sketch-probe monitor-probe \
+		multihost-probe
 	JAX_PLATFORMS=cpu BENCH_N=2000 BENCH_DIM=4 BENCH_REPS=1 \
 	BENCH_DEV_REPS=1 $(PY) bench.py \
 	| $(PY) scripts/bench_diff.py --annotate --baseline-dir . \
@@ -289,6 +290,21 @@ monitor:
 # outruns the scraper).
 monitor-probe:
 	MONITOR_N=$${MONITOR_N:-40000} $(PY) scripts/monitor_probe.py \
+	| $(PY) scripts/bench_diff.py --annotate --baseline-dir . \
+	| $(PY) scripts/check_bench_json.py --require-diff
+
+# Pod-scale execution probe (ISSUE 20): a localhost jax.distributed
+# fleet (2 processes x 4 faked CPU devices = the reference 8-device
+# mesh) — fit parity byte-identical to the single-process run under
+# both merges + the KD route, the shared-store streaming build's
+# pass 2/3 partition across processes (byte-identical; the >= 1.8x
+# P=4 speedup gate applies only on hosts with >= 4 cores), a SIGKILL-
+# mid-fixpoint drill resumed from the coordinator's jobstate snapshot
+# back to byte parity, and the per-process flight files merged by
+# obs.replay with the clock-skew flag quiet — one schema'd
+# multihost@1 row through the bench_diff cross-round gate.
+multihost-probe:
+	MH_N=$${MH_N:-3000} $(PY) scripts/multihost_probe.py \
 	| $(PY) scripts/bench_diff.py --annotate --baseline-dir . \
 	| $(PY) scripts/check_bench_json.py --require-diff
 

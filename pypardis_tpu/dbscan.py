@@ -996,6 +996,8 @@ class DBSCAN:
         # joins the sampler and seals the flight file with the error.
         flight = obs.open_flight(self.flight)
         if flight is not None:
+            from .parallel import dist as _dist
+
             rec.attach_flight(flight)
             flight.header(
                 params={
@@ -1008,6 +1010,8 @@ class DBSCAN:
                 n_points=int(len(points)),
                 n_dims=int(points.shape[1]),
                 n_devices=int(n_devices if sharded else 1),
+                n_processes=int(_dist.process_count()),
+                process_index=int(_dist.process_index()),
                 backend=jax_backend_name(),
             )
         # Live export plane (opt-in via PYPARDIS_METRICS_PORT /
@@ -2796,7 +2800,9 @@ class DBSCAN:
         # fetch), so cluster_mapping() and the parity surface reflect
         # the real partition structure.  One stable argsort, not a
         # boolean scan per partition (O(N log N), not O(P*N)).
-        pid_np = np.asarray(pid)
+        from .parallel import dist as _dist
+
+        pid_np = _dist.fetch_np(pid)
         self.metrics_["partition_levels_s"] = [
             round(float(t), 6) for t in part.level_times_s
         ]

@@ -112,6 +112,20 @@ class FleetReplay:
             (h["offset_s"] + h["last_t_s"] for h in self.hosts),
             default=0.0,
         )
+        # Clock-skew sanity: the alignment trusts each member's t_unix
+        # anchor, so a fleet whose anchors spread wider than the fit
+        # itself plausibly has unsynchronized host clocks — the merged
+        # timeline is still deterministic, but cross-host orderings are
+        # suspect.  Threshold is the registered knob (seconds).
+        from ..utils import envreg
+
+        self.clock_skew_s = round(
+            (max(anchors) - min(anchors)) if len(anchors) >= 2 else 0.0,
+            6,
+        )
+        raw = envreg.raw("PYPARDIS_FLEET_SKEW_WARN_S")
+        self.skew_warn_s = float(raw) if raw else 5.0
+        self.clock_skew_warning = self.clock_skew_s > self.skew_warn_s
 
     # -- merged surfaces ---------------------------------------------------
 
@@ -220,6 +234,8 @@ class FleetReplay:
             "bad_lines": self.bad_lines,
             "complete": self.complete,
             "partial": not self.complete,
+            "clock_skew_s": self.clock_skew_s,
+            "clock_skew_warning": self.clock_skew_warning,
             "last_t_s": round(self.last_t_s, 6),
             "per_host": self.hosts,
             "heartbeats": self.heartbeats(),
@@ -240,6 +256,12 @@ class FleetReplay:
                 "" if rep["complete"] else " — PARTIAL",
             )
         ]
+        if rep["clock_skew_warning"]:
+            lines.append(
+                "  WARNING: member wall-clock anchors spread %.3fs "
+                "(> %.1fs) — host clocks look unsynchronized"
+                % (rep["clock_skew_s"], self.skew_warn_s)
+            )
         for h in self.hosts:
             status = h["status"] or (
                 "killed" if not h["complete"] else "?"

@@ -276,13 +276,25 @@ def open_flight(spec=None) -> Optional[FlightRecorder]:
     if not spec:
         return None
     spec = str(spec)
+    # Multi-process fleet: every process records its OWN file — a
+    # shared file path would interleave raw JSONL appends from N
+    # writers.  A file spec gains a rank infix (the directory is the
+    # shared store, so ``obs.replay(dir)`` merges the set); a directory
+    # spec gains the rank in the generated name (pids alone collide
+    # across hosts of a real pod).
+    from ..parallel import dist
+
+    rank = dist.process_index() if dist.is_distributed() else None
     if spec.endswith(".jsonl"):
+        if rank is not None:
+            spec = "%s.p%02d.jsonl" % (spec[: -len(".jsonl")], rank)
         d = os.path.dirname(spec)
         if d:
             os.makedirs(d, exist_ok=True)
         return FlightRecorder(spec)
     os.makedirs(spec, exist_ok=True)
-    name = "flight-%d-%s-%d.jsonl" % (
+    name = "flight-%s%d-%s-%d.jsonl" % (
+        "" if rank is None else "r%02d-" % rank,
         os.getpid(), time.strftime("%Y%m%d-%H%M%S"), _next_seq()
     )
     return FlightRecorder(os.path.join(spec, name))

@@ -283,7 +283,15 @@ def build_run_report(
     sharding.setdefault("owner_computes", False)
 
     psizes = metrics.get("partition_sizes")
-    devices: Dict = {"count": int(n_devices)}
+    from ..parallel import dist
+
+    devices: Dict = {
+        "count": int(n_devices),
+        # Controller processes the fit spanned (1 = classic
+        # single-process; >1 = a jax.distributed fleet whose devices
+        # this count aggregates).
+        "processes": int(dist.process_count()),
+    }
     if psizes is not None:
         if n_devices > 0 and len(psizes) % n_devices == 0:
             per_dev = len(psizes) // n_devices

@@ -91,7 +91,10 @@ class Tracer:
             elif sync:
                 import jax
 
-                for dev in jax.devices():
+                # local_devices, not devices: a multi-process fit's
+                # global mesh includes devices this controller cannot
+                # device_put to.
+                for dev in jax.local_devices():
                     # graftlint: disable=device-put-aliasing -- scalar
                     # transfer barrier; no host buffer involved
                     jax.device_put(0, dev).block_until_ready()

@@ -1,0 +1,383 @@
+"""Multi-process execution layer (``jax.distributed``).
+
+One fit spanning N controller processes — the pod-scale seam ROADMAP
+item 4 names.  Every process runs the identical host-side Python
+(multi-controller SPMD): the same partition, the same per-round
+host-stepped loops (the GM boundary ring, the pmin merge fixpoint),
+the same jitted ``shard_map`` programs — only now over a mesh built
+from EVERY process's devices, so ``ppermute`` rounds and the
+convergence all-reduce span processes with no new ladder machinery.
+
+The contract that keeps this safe to land from a CPU container: a
+P-process fit is **byte-identical** to the single-process fit with the
+same total device count.  The only code that may observe the process
+boundary is here:
+
+* :func:`init_distributed` — ``jax.distributed.initialize`` driven by
+  the registered ``PYPARDIS_DIST_*`` knobs (CI: N localhost processes
+  x ``--xla_force_host_platform_device_count`` faked CPU devices each,
+  gloo TCP collectives, coordinator on an ephemeral port).
+* :func:`fetch_np` — the one sanctioned device→host fetch for driver
+  code.  Single-process (and fully-replicated arrays anywhere) it is
+  exactly the historical ``np.asarray``; a ``P("p")``-sharded array in
+  a multi-process fit is allgathered so every process sees the same
+  full value and the host-side control flow cannot diverge.
+* :func:`touch` — the tiny-slice dispatch-fence idiom
+  (``np.asarray(x[:1])``) generalized: slicing a non-addressable array
+  is illegal, so multi-process fences via ``block_until_ready``.
+* :func:`broadcast_bytes` / :func:`broadcast_arrays` — process-0
+  rendezvous for host-side decisions (the streaming build's splitter
+  keys and spill-dir name — the NOWSort broadcast).
+* :func:`launch_fleet` — the localhost subprocess launcher the tests
+  and ``scripts/multihost_probe.py`` share: ephemeral coordinator
+  port with bind-collision retry, whole-fleet teardown when any
+  worker dies (surviving workers would otherwise block forever in a
+  collective).
+
+Single-process fits never pay for any of this: every helper's first
+branch is a ``process_count() == 1`` check against a cached count.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import socket
+import subprocess
+import time
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils import envreg
+
+# Resolved once jax.distributed is (maybe) initialized; cached so the
+# hot-path helpers don't re-enter jax.process_count() per fetch.
+_PROCESS_COUNT: Optional[int] = None
+_INITIALIZED = False
+
+
+def init_distributed(
+    coordinator: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Join a multi-process fleet; returns True when distributed.
+
+    Arguments fall back to the registered env knobs
+    (``PYPARDIS_DIST_COORD`` / ``_NPROCS`` / ``_PROC_ID``), so a worker
+    launched by :func:`launch_fleet` needs only
+    ``init_distributed()`` before its first jax use.  With no
+    coordinator configured this is a no-op returning False — the
+    single-process path.  Idempotent.
+    """
+    global _PROCESS_COUNT, _INITIALIZED
+    if _INITIALIZED:
+        return True
+    coord = coordinator or envreg.raw("PYPARDIS_DIST_COORD")
+    nprocs = num_processes
+    if nprocs is None:
+        env = envreg.raw("PYPARDIS_DIST_NPROCS")
+        nprocs = int(env) if env else None
+    pid = process_id
+    if pid is None:
+        env = envreg.raw("PYPARDIS_DIST_PROC_ID")
+        pid = int(env) if env not in (None, "") else None
+    if not coord or not nprocs or nprocs < 2 or pid is None:
+        return False
+    import jax
+
+    # CPU fleets need a real inter-process transport; gloo-over-TCP is
+    # the jaxlib one.  Guarded: the option only exists on jax versions
+    # that split it out, and TPU pods use their native interconnect.
+    if "jax_cpu_collectives_implementation" in getattr(
+        jax.config, "_value_holders", {}
+    ):
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(
+        coordinator_address=coord,
+        num_processes=int(nprocs),
+        process_id=int(pid),
+    )
+    _INITIALIZED = True
+    _PROCESS_COUNT = None  # re-resolve below
+    return True
+
+
+def process_count() -> int:
+    """Processes in the fleet (1 on the single-process path), cached."""
+    global _PROCESS_COUNT
+    if _PROCESS_COUNT is None:
+        import jax
+
+        _PROCESS_COUNT = int(jax.process_count())
+    return _PROCESS_COUNT
+
+
+def process_index() -> int:
+    """This process's rank in [0, process_count())."""
+    if process_count() == 1:
+        return 0
+    import jax
+
+    return int(jax.process_index())
+
+
+def is_distributed() -> bool:
+    return process_count() > 1
+
+
+def is_coordinator() -> bool:
+    """Process 0: the one that writes shared state (jobstate
+    snapshots, spill-dir creation) for the whole fleet."""
+    return process_index() == 0
+
+
+def fetch_np(x) -> np.ndarray:
+    """Device→host fetch that every process can trust.
+
+    Single-process: exactly ``np.asarray(x)`` (byte-identical to the
+    historical fetch — the zero-overhead contract).  Multi-process: a
+    fully-replicated array (the ``out_specs=P()`` convergence probes,
+    final label maps) is addressable everywhere and fetches directly;
+    a ``P("p")``-sharded array is allgathered (tiled) so the host sees
+    the same FULL value on every process — per-round capacity plans,
+    overflow flags, and pair stats must drive identical host control
+    flow fleet-wide or the lockstep trace diverges.
+    """
+    if process_count() == 1:
+        return np.asarray(x)
+    import jax
+
+    if not isinstance(x, jax.Array) or x.is_fully_replicated:
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+
+def touch(x) -> None:
+    """Dispatch fence: make sure ``x``'s computation has been enqueued
+    (single-process keeps the historical tiny-slice fetch; slicing a
+    non-addressable multi-process array is illegal, so the fleet path
+    blocks on readiness instead)."""
+    if process_count() == 1:
+        np.asarray(x[(slice(0, 1),) * getattr(x, "ndim", 1)])
+        return
+    x.block_until_ready()
+
+
+def barrier(tag: str) -> None:
+    """Fleet-wide rendezvous (no-op single-process).  The streaming
+    build's pass boundaries and spill-dir teardown use it."""
+    if process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(tag)
+
+
+def broadcast_bytes(data: Optional[bytes]) -> bytes:
+    """Process 0's byte string, on every process.
+
+    Rides int32 device arrays (``broadcast_one_to_all`` widens narrow
+    integer dtypes, and 64-bit dtypes are unsafe without x64), length
+    first so shapes agree fleet-wide.  Non-coordinators may pass
+    ``None``/``b""``.
+    """
+    if process_count() == 1:
+        return data or b""
+    from jax.experimental import multihost_utils
+
+    head = np.zeros((1,), np.int32)
+    if is_coordinator():
+        head[0] = len(data or b"")
+    n = int(np.asarray(multihost_utils.broadcast_one_to_all(head))[0])
+    pad = (-n) % 4
+    words = max((n + pad) // 4, 1)
+    buf = np.zeros((words,), np.int32)
+    if is_coordinator() and n:
+        buf = np.frombuffer(
+            (data or b"") + b"\0" * pad, np.int32
+        ).copy()
+    out = np.asarray(
+        multihost_utils.broadcast_one_to_all(buf), np.int32
+    )
+    return out.tobytes()[:n]
+
+
+def broadcast_str(s: Optional[str]) -> str:
+    """Process 0's string, everywhere (spill-dir rendezvous)."""
+    if process_count() == 1:
+        return s or ""
+    payload = (s or "").encode("utf-8") if is_coordinator() else None
+    return broadcast_bytes(payload).decode("utf-8")
+
+
+def broadcast_arrays(arrays) -> List[np.ndarray]:
+    """Process 0's numpy arrays, everywhere — dtype and shape ride in
+    the payload (npz), so uint64 Morton words and float32 centers
+    cross intact.  Non-coordinators may pass ``None``.
+    """
+    if process_count() == 1:
+        return [np.asarray(a) for a in arrays]
+    payload = None
+    if is_coordinator():
+        bio = io.BytesIO()
+        np.savez(
+            bio, **{f"a{i}": np.asarray(a) for i, a in enumerate(arrays)}
+        )
+        payload = bio.getvalue()
+    blob = broadcast_bytes(payload)
+    with np.load(io.BytesIO(blob)) as z:
+        return [z[f"a{i}"] for i in range(len(z.files))]
+
+
+# ---------------------------------------------------------------------------
+# Localhost fleet launcher (tests + scripts/multihost_probe.py)
+# ---------------------------------------------------------------------------
+
+
+def pick_port() -> int:
+    """An ephemeral localhost TCP port (bind-probe; racy by nature,
+    which is why :func:`launch_fleet` retries bind collisions)."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    try:
+        s.bind(("127.0.0.1", 0))
+        return int(s.getsockname()[1])
+    finally:
+        s.close()
+
+
+_BIND_ERR_MARKERS = (
+    "address already in use",
+    "Address already in use",
+    "Failed to bind",
+    "bind failed",
+    "UNKNOWN: Could not start",
+)
+
+# gloo's TCP transport can abort the whole process (SIGABRT, C++
+# uncaught EnforceNotMet) on transient wire trouble — e.g. another
+# fleet's lingering sockets during CI churn.  A relaunch on a fresh
+# coordinator port rebuilds every pair from scratch.
+_TRANSPORT_ERR_MARKERS = (
+    "gloo::EnforceNotMet",
+    "Connection reset by peer",
+    "Connection refused",
+)
+
+
+def _looks_like_bind_collision(text: str) -> bool:
+    return any(m in (text or "") for m in _BIND_ERR_MARKERS)
+
+
+def _looks_like_transport_abort(rcs, tails) -> bool:
+    """A worker died on gloo transport trouble (not a Python error, not
+    a kill): SIGABRT plus a transport marker in its stderr."""
+    return any(
+        rc == -6 and any(m in (t or "") for m in _TRANSPORT_ERR_MARKERS)
+        for rc, t in zip(rcs, tails)
+    )
+
+
+def fleet_env(
+    port: int, num_processes: int, process_id: int,
+    devices_per_process: int, base: Optional[dict] = None,
+) -> dict:
+    """The env one worker needs: coordinator knobs + the faked-device
+    CPU platform (mirrors the test harness's conftest idiom)."""
+    env = dict(base if base is not None else os.environ)
+    env["PYPARDIS_DIST_COORD"] = f"127.0.0.1:{port}"
+    env["PYPARDIS_DIST_NPROCS"] = str(num_processes)
+    env["PYPARDIS_DIST_PROC_ID"] = str(process_id)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={devices_per_process}"
+    )
+    return env
+
+
+def launch_fleet(
+    argv: Sequence[str],
+    num_processes: int,
+    devices_per_process: int,
+    *,
+    env: Optional[dict] = None,
+    port: Optional[int] = None,
+    timeout_s: float = 900.0,
+    retries: int = 3,
+    stderr_tail: int = 4096,
+) -> Tuple[List[int], int, int, List[str]]:
+    """Run ``argv`` as ``num_processes`` lockstep workers on localhost.
+
+    Returns ``(returncodes, port, attempts, stderr_tails)``.  Each
+    worker gets :func:`fleet_env`; a coordinator-port bind collision
+    (another service grabbed the ephemeral port between probe and
+    ``jax.distributed.initialize``) tears the fleet down and retries on
+    a fresh port — up to ``retries`` times.  Any worker dying for a
+    non-bind reason also tears the whole fleet down (survivors block
+    forever inside collectives otherwise) and reports its real exit
+    codes; timeouts kill and report -9.
+    """
+    attempts = 0
+    while True:
+        attempts += 1
+        use_port = port if port is not None else pick_port()
+        procs = []
+        errfiles = []
+        import tempfile
+
+        for pid in range(num_processes):
+            ef = tempfile.TemporaryFile(mode="w+")
+            errfiles.append(ef)
+            procs.append(
+                subprocess.Popen(
+                    list(argv),
+                    env=fleet_env(
+                        use_port, num_processes, pid,
+                        devices_per_process, base=env,
+                    ),
+                    stderr=ef,
+                )
+            )
+        deadline = time.time() + timeout_s
+        rcs: List[Optional[int]] = [None] * num_processes
+        while time.time() < deadline:
+            for i, p in enumerate(procs):
+                if rcs[i] is None:
+                    rcs[i] = p.poll()
+            if not any(rc is None for rc in rcs):
+                break
+            if any(rc not in (None, 0) for rc in rcs):
+                break  # early failure: tear the survivors down
+            time.sleep(0.05)
+        for p in procs:  # teardown: timeout or early failure
+            if p.poll() is None:
+                p.kill()
+        for i, p in enumerate(procs):
+            p.wait()
+            rcs[i] = p.returncode
+        tails = []
+        for ef in errfiles:
+            ef.seek(0, os.SEEK_END)
+            size = ef.tell()
+            ef.seek(max(0, size - stderr_tail))
+            tails.append(ef.read())
+            ef.close()
+        # Retry on the failure *signature*, not on an early-failure
+        # flag: when every rank aborts inside one poll window (gloo
+        # tears down both ends of a broken pair at once) the loop
+        # exits via the nobody-live branch, which must retry too.
+        if port is None and attempts <= retries and any(
+            rc != 0 for rc in rcs
+        ) and (
+            any(
+                _looks_like_bind_collision(t)
+                for rc, t in zip(rcs, tails)
+                if rc not in (0, None)
+            )
+            or _looks_like_transport_abort(rcs, tails)
+        ):
+            continue  # fresh ephemeral port next round
+        return [int(rc) for rc in rcs], use_port, attempts, tails

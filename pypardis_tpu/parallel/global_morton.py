@@ -110,7 +110,7 @@ from ..utils.retry import (
     note_giveup,
     note_retry,
 )
-from . import staging
+from . import dist, staging
 from .halo import boundary_send_select, ring_tile_round
 from .mesh import shard_map
 from .sharded import (
@@ -386,7 +386,16 @@ def build_morton_shards_streaming(points, n_shards, block, sharding,
                 hi[s] = rhi + split.center
         cap = round_up(max(plens + [1]), block)
         parts = ([], [], [])
+        my_proc = dist.process_index()
         for s in range(n_shards):
+            # Multi-process fleet: each controller assembles ONLY the
+            # shards living on its own devices (device_put to a
+            # non-addressable device is illegal, and reading remote
+            # shards' spill ranges would be wasted IO anyway —
+            # make_array_from_single_device_arrays wants exactly the
+            # addressable shards).
+            if int(devices[s].process_index) != my_proc:
+                continue
             # Device-side slab assembly: the host never allocates a
             # cap-sized buffer — spill pieces ship as they are read
             # and scatter into the device-resident slab, so peak host
@@ -809,7 +818,7 @@ def _gm_exchange(arrays, eps, *, mesh, axis, gtile, bt, bc,
                         *state, my_lo, my_hi, np.float32(eps),
                         mesh=mesh, axis=axis,
                     )
-                    np.asarray(out[-1])
+                    dist.fetch_np(out[-1])
                     return out
 
                 state = Retrier("gm.ring_round").run(one_round)
@@ -825,16 +834,16 @@ def _gm_exchange(arrays, eps, *, mesh, axis, gtile, bt, bc,
             state[5], state[6], state[7], state[8], my_lo, my_hi,
             np.float32(eps), mesh=mesh,
         )
-        n_send_np = np.asarray(n_send)
-        recv_ovf_np = np.asarray(state[-1])
-        tiles_np = np.asarray(tiles)
-        rows_np = np.asarray(rows)
+        n_send_np = dist.fetch_np(n_send)
+        recv_ovf_np = dist.fetch_np(state[-1])
+        tiles_np = dist.fetch_np(tiles)
+        rows_np = dist.fetch_np(rows)
         # Compact the boundary slab to the mesh max of SURVIVING tiles
         # (the flatten sinks empty tiles to the tail): the receive
         # ladder's capacity headroom would otherwise ride into the
         # cluster step as permanently-masked column tiles — box-pruned,
         # but still per-tile scan iterations in every kernel pass.
-        mt = max(1, int(np.asarray(kept_tiles).max()))
+        mt = max(1, int(dist.fetch_np(kept_tiles).max()))
         gtile_rows = mt * gtile
         if gtile_rows < bnd.shape[1]:
             bnd = bnd[:, :gtile_rows]
@@ -842,7 +851,7 @@ def _gm_exchange(arrays, eps, *, mesh, axis, gtile, bt, bc,
             bgid = bgid[:, :gtile_rows]
         sent_tiles = int(np.minimum(n_send_np, bt).sum())
         sent_tiles_box = int(
-            np.minimum(np.asarray(n_send_box), bt).sum()
+            np.minimum(dist.fetch_np(n_send_box), bt).sum()
         )
         xstats = {
             "boundary_tiles": int(tiles_np.sum()),
@@ -930,9 +939,9 @@ def _gm_boundary_tiles(arrays, eps, *, mesh, axis, block, btcap, base,
             arrays[0], arrays[1], np.float32(eps),
             gtile=gtile, mesh=mesh, axis=axis,
         )
-        bt = min(max(1, int(np.asarray(n_send_pd).max())), nt)
+        bt = min(max(1, int(dist.fetch_np(n_send_pd).max())), nt)
         bc = min(
-            round_up(max(1, int(np.asarray(n_recv_pd).max())), bstep),
+            round_up(max(1, int(dist.fetch_np(n_recv_pd).max())), bstep),
             bc_hard,
         )
     attempts = 6
@@ -1148,6 +1157,13 @@ def _gm_fixpoint(home_label, core_g, bgid, b_glab, *, mesh, axis,
     converged = False
     t0 = _time.perf_counter()
     while rounds < merge_rounds:
+        # Pod fault drill site: whole-WORKER faults (a process dying
+        # or stalling mid-fixpoint).  Outside the per-round Retrier on
+        # purpose — in-process retry cannot recover a dead controller;
+        # the recovery path is the launcher tearing the fleet down and
+        # relaunching with train(resume=) against the coordinator's
+        # jobstate snapshot (monotone pmin resumes byte-identically).
+        faults.maybe_fail("dist.worker")
         with obs_span("gm.fixpoint_round", round=rounds):
 
             def one_round(lab_map=lab_map):
@@ -1846,7 +1862,9 @@ def global_morton_dbscan(
             if counts_dev is None:
                 counts_dev, cstats_dev = _dispatch_counts(pb_owned)
             try:
-                return np.asarray(counts_dev), np.asarray(cstats_dev)
+                return (
+                    dist.fetch_np(counts_dev), dist.fetch_np(cstats_dev)
+                )
             except Exception:
                 # A transient execution fault poisons the in-flight
                 # arrays — drop them so the retry redispatches.
@@ -1910,7 +1928,7 @@ def global_morton_dbscan(
         halo_cap=brows,
     )
 
-    omsk_np = np.asarray(omsk) if overlap else None
+    omsk_np = dist.fetch_np(omsk) if overlap else None
 
     def _overlap_core(pb, b2):
         """Boundary-column delta + threshold: the second half of the
@@ -1923,14 +1941,14 @@ def global_morton_dbscan(
         c_np = counts_np
         if b2 != counts_backend[0]:
             cdev, _sdev = _dispatch_counts(pb_owned, b=b2)
-            c_np = np.asarray(cdev)
+            c_np = dist.fetch_np(cdev)
         delta_dev, dstats_dev = _gm_counts_delta_step(
             owned, omsk, bnd, bmsk, eps=float(eps), metric=metric,
             block=block, mesh=mesh, axis=axis, precision=precision,
             backend=b2, pair_budget=pb,
         )
-        dstats = np.asarray(dstats_dev)
-        total = c_np + np.asarray(delta_dev)
+        dstats = dist.fetch_np(dstats_dev)
+        total = c_np + dist.fetch_np(delta_dev)
         # Same self-count clamp as the fused counts pass: a valid
         # point is always within eps of itself.
         core_np = (np.maximum(total, 1) >= int(min_samples)) & omsk_np
@@ -1945,7 +1963,7 @@ def global_morton_dbscan(
         the propagate rows; the owned-slab pass has its own pre-ladder
         exact retry, so its larger/smaller budget never muddies the
         max-total-vs-max-budget check)."""
-        pstats = np.array(pstats, dtype=np.int64)
+        pstats = np.array(dist.fetch_np(pstats), dtype=np.int64)
         pstats = pstats.reshape(-1, pstats.shape[-1])
         if dstats is None:
             return pstats
@@ -2188,10 +2206,10 @@ def sweep_graph_global_morton(
     # One host gather per slab family — per-shard indexing of the
     # mesh-sharded arrays would dispatch a collective program per
     # slice (see sweep_graph_sharded).
-    owned_h, omsk_h, ogid_h = (np.asarray(a) for a in arrays)
+    owned_h, omsk_h, ogid_h = (dist.fetch_np(a) for a in arrays)
     if brows:
         bnd_h, bmsk_h, bgid_h = (
-            np.asarray(bnd), np.asarray(bmsk), np.asarray(bgid)
+            dist.fetch_np(bnd), dist.fetch_np(bmsk), dist.fetch_np(bgid)
         )
     with obs_span("sweep.extract", mode="global_morton",
                   shards=int(n_shards)):

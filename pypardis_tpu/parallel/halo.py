@@ -22,6 +22,12 @@ the owner-computes step (``sharded._device_cluster_merge_oc``) the
 received halo rows serve only as neighbor-count evidence and relay
 nodes, so the exchange's byte volume is the whole duplication cost the
 ring path pays.
+
+On a multi-process mesh (``parallel.dist``) nothing here changes: the
+ring is a ``ppermute`` over the global 1-D axis, so hops whose
+neighbor lives in another process become inter-host sends (gloo TCP on
+CPU fleets, ICI/DCN on pods) compiled into the same program — the
+fixed-capacity contract and the overflow ladder are process-agnostic.
 """
 
 from __future__ import annotations

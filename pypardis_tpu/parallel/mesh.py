@@ -32,6 +32,15 @@ def default_mesh(n_devices: int | None = None, axis_name: str = "p") -> Mesh:
     Spatial data parallelism with halo overlap — the reference's one
     distribution strategy (SURVEY §2) — needs a single mesh axis; the
     KD-partition → device mapping rides on it.
+
+    Multi-process fleets (``parallel.dist.init_distributed``) need no
+    variant: after ``jax.distributed.initialize``, ``jax.devices()``
+    is the GLOBAL device list in a process-count-independent order, so
+    the same 1-D mesh spans every process's chips and ``ppermute``
+    rings / ``psum`` probes cross the process boundary transparently.
+    Host code must then fetch sharded arrays through
+    ``dist.fetch_np`` (a local ``np.asarray`` of a non-addressable
+    array is illegal and would diverge the lockstep trace).
     """
     devices = jax.devices()
     if n_devices is not None:

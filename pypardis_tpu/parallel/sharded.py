@@ -66,7 +66,7 @@ from ..partition import spatial_order
 from ..utils import clamp_block, envreg, faults, round_up
 from ..utils.budget import run_ladders
 from ..utils.retry import Retrier, is_degradable_error, note_degraded
-from . import staging
+from . import dist, staging
 from .halo import ring_halo_exchange_multi
 from .mesh import shard_map
 
@@ -1658,8 +1658,8 @@ def _oc_host_tables(
             metric=metric, block=block, mesh=mesh, axis=axis,
             precision=precision, backend=backend, pair_budget=pair_budget,
         )
-        own_core = np.asarray(own_core_dev)
-        counts_band_np = np.asarray(counts_band).reshape(-1, 2)
+        own_core = dist.fetch_np(own_core_dev)
+        counts_band_np = dist.fetch_np(counts_band).reshape(-1, 2)
     else:
         own_core = np.asarray(own_core)
         # graftlint: disable=device-put-aliasing -- own_core is a
@@ -1668,10 +1668,10 @@ def _oc_host_tables(
             own_core, NamedSharding(mesh, P(axis))
         )
         counts_band_np = np.zeros((own_core.shape[0], 2), np.int64)
-    if overflow is not None and int(np.asarray(overflow).sum()) != 0:
+    if overflow is not None and int(dist.fetch_np(overflow).sum()) != 0:
         raise _HaloOverflow()
-    og_np = np.asarray(owned_gid)
-    hg_np = np.asarray(halo_gid)
+    og_np = dist.fetch_np(owned_gid)
+    hg_np = dist.fetch_np(halo_gid)
     n = int(n_points)
     core_full = np.zeros(n + 1, bool)
     og_flat = og_np.reshape(-1)
@@ -1689,7 +1689,7 @@ def _oc_host_tables(
     # Fold the counts program's band columns into the per-device rows
     # (host-side: the two passes are separate programs on this route).
     cb = counts_band_np
-    pstats_np = np.array(pstats).reshape(cb.shape[0], -1)
+    pstats_np = np.array(dist.fetch_np(pstats)).reshape(cb.shape[0], -1)
     pstats_np[:, 3:5] += cb
     return own_glab, own_core_dev, halo_glab, pstats_np
 
@@ -1876,7 +1876,7 @@ def _exec_stats(stats, *, oc_on, pstats, block, k, precision, n,
     stats["staged_bytes_reused"] = int(reused)
     stats["staged_bytes"] = int(shipped)
     if pstats is not None:
-        ps = np.asarray(pstats)
+        ps = dist.fetch_np(pstats)
         ps = ps.reshape(-1, ps.shape[-1])
         stats["live_pairs"] = int(ps[:, 0].max())
         if ps.shape[1] > 2:
@@ -1920,16 +1920,17 @@ def _host_merge_finish(n, og, own_glab, own_core, halo_gid, halo_glab):
     halo occurrence tables (:func:`merge.merge_occurrences`)."""
     from .merge import merge_occurrences
 
-    own_glab = np.asarray(own_glab).reshape(-1)
-    own_core = np.asarray(own_core).reshape(-1)
-    og_flat = np.asarray(og).reshape(-1)
+    own_glab = dist.fetch_np(own_glab).reshape(-1)
+    own_core = dist.fetch_np(own_core).reshape(-1)
+    og_flat = dist.fetch_np(og).reshape(-1)
     sel = og_flat < n
     home_label = np.full(n, -1, np.int32)
     home_label[og_flat[sel]] = own_glab[sel]
     core = np.zeros(n, bool)
     core[og_flat[sel]] = own_core[sel]
     labels, _mapping = merge_occurrences(
-        home_label, core, np.asarray(halo_gid), np.asarray(halo_glab)
+        home_label, core, dist.fetch_np(halo_gid),
+        dist.fetch_np(halo_glab)
     )
     return labels, core
 
@@ -2165,7 +2166,7 @@ def sharded_dbscan(
             merge_rounds=int(m_rounds), merge_converged=True,
             halo_bytes=_ring_halo_bytes(stats, used_hcap, k),
         )
-        labels, core = np.asarray(labels), np.asarray(core)
+        labels, core = dist.fetch_np(labels), dist.fetch_np(core)
         _exec_stats(stats, oc_on=oc_on, pstats=pstats, block=block,
                     k=k, precision=precision, n=n, metric=metric)
         staging.give_back_after_put(host_bufs)
@@ -2304,7 +2305,7 @@ def sharded_dbscan(
         stats, merge="device", merge_rounds=int(m_rounds),
         merge_converged=True,
     )
-    labels, core = np.asarray(labels), np.asarray(core)
+    labels, core = dist.fetch_np(labels), dist.fetch_np(core)
     _exec_stats(stats, oc_on=oc_on, pstats=pstats, block=block,
                 k=k, precision=precision, n=n, metric=metric)
     staging.give_back_after_put(host_bufs)
@@ -2402,7 +2403,7 @@ def _ring_ladder(
                         backend,
                     )
                 )
-                if int(np.asarray(overflow).sum()) != 0:
+                if int(dist.fetch_np(overflow).sum()) != 0:
                     raise _HaloOverflow()
                 # The host union-find merge is exact — no rounds ladder.
                 return (
@@ -2431,7 +2432,7 @@ def _ring_ladder(
             )
             # Halo capacity is checked FIRST: with dropped in-box
             # points the pair stats and merge result are moot.
-            if int(np.asarray(overflow).sum()) != 0:
+            if int(dist.fetch_np(overflow).sum()) != 0:
                 raise _HaloOverflow()
             return (labels, core, m_rounds), pstats, converged
 
@@ -2550,7 +2551,7 @@ def sharded_dbscan_device(
 
     pid = device_route(points, *map(jnp.asarray, tree_arrays(part.tree)))
     counts_dev = device_partition_counts(pid, p_total=p_total)
-    max_count = int(np.asarray(counts_dev).max())
+    max_count = int(dist.fetch_np(counts_dev).max())
     block = clamp_block(block, max_count)
     cap = round_up(max(max_count, 1), block)
 
@@ -2601,7 +2602,7 @@ def sharded_dbscan_device(
         "owned_cap": cap,
         "n_shard_partitions": p_total,
         "pad_waste": float(p_total * cap) / max(n, 1) - 1.0,
-        "partition_sizes": [int(c) for c in np.asarray(counts_dev)],
+        "partition_sizes": [int(c) for c in dist.fetch_np(counts_dev)],
         "input": "device",
         "halo_exchange": "ring",
     }
@@ -2624,7 +2625,7 @@ def sharded_dbscan_device(
         merge_converged=True,
         halo_bytes=_ring_halo_bytes(stats, used_hcap, k),
     )
-    labels, core = np.asarray(labels), np.asarray(core)
+    labels, core = dist.fetch_np(labels), dist.fetch_np(core)
     _exec_stats(stats, oc_on=oc_on, pstats=pstats, block=block,
                 k=k, precision=precision, n=n, metric=metric)
     return _canonicalize_roots(labels, core), core, stats, part, pid
@@ -2789,7 +2790,7 @@ def sweep_graph_sharded(
     # per slice (measured seconds each on the faked CPU mesh); the
     # emission pass runs per shard on the default device anyway, so
     # feeding it host slices keeps the loop collective-free.
-    slabs = [np.asarray(a) for a in arrays]
+    slabs = [dist.fetch_np(a) for a in arrays]
     owned_h, omsk_h, ogid_h, halo_h, hmsk_h, hgid_h = slabs
     out_i, out_j, out_d = [], [], []
     eb, pb = edge_budget, pair_budget

@@ -39,6 +39,15 @@ _VALID_SPLIT_METHODS = ("min_var", "rotation", "mean_var", "median_search")
 _VALID_BUILDERS = ("auto", "level", "legacy")
 
 
+def _dist():
+    # Lazy: partition is imported by parallel.sharded, so a module-top
+    # import of parallel.dist would cycle through a half-initialized
+    # package.
+    from .parallel import dist
+
+    return dist
+
+
 def median_search_split(values: np.ndarray):
     """Exact-median boundary along one axis.
 
@@ -542,7 +551,7 @@ class MortonStreamSplit:
 
     def __init__(self, n: int, k: int, starts: np.ndarray,
                  center: np.ndarray, spill_dir: str, sorted_path: str,
-                 rec2, tile_lo, tile_hi, stats: Dict):
+                 rec2, tile_lo, tile_hi, stats: Dict, segments=None):
         self.n = int(n)
         self.k = int(k)
         self.starts = np.asarray(starts, dtype=np.int64)
@@ -553,6 +562,11 @@ class MortonStreamSplit:
         self._spill_dir = spill_dir
         self._sorted_path = sorted_path
         self._rec2 = rec2
+        # Multi-process fleets: the sorted spill is striped over one
+        # segment file per process; ``segments`` is the global span
+        # table [(gstart, gend, path, file_offset), ...] ascending in
+        # gstart.  None = the single sorted.bin file.
+        self._segments = segments
         self._closed = False
 
     @property
@@ -563,10 +577,25 @@ class MortonStreamSplit:
         if self._closed:
             raise RuntimeError("MortonStreamSplit is closed")
         itemsize = self._rec2.itemsize
-        with open(self._sorted_path, "rb") as f:
-            f.seek(a * itemsize)
-            buf = f.read((b - a) * itemsize)
-        return np.frombuffer(buf, dtype=self._rec2)
+        if self._segments is None:
+            with open(self._sorted_path, "rb") as f:
+                f.seek(a * itemsize)
+                buf = f.read((b - a) * itemsize)
+            return np.frombuffer(buf, dtype=self._rec2)
+        # Striped spill: gather the [a, b) span from every overlapping
+        # per-process segment (all on the shared store — any process
+        # reads any segment).  Spans partition the global order, so the
+        # pieces tile the output exactly.
+        out = np.empty(b - a, self._rec2)
+        for gs, ge, path, fo in self._segments:
+            if ge <= a or gs >= b:
+                continue
+            s0, s1 = max(a, gs), min(b, ge)
+            with open(path, "rb") as f:
+                f.seek(fo + (s0 - gs) * itemsize)
+                buf = f.read((s1 - s0) * itemsize)
+            out[s0 - a:s1 - a] = np.frombuffer(buf, dtype=self._rec2)
+        return out
 
     def range_rows(self, s: int):
         """(ids int32, rows f32 (m, k)) of range ``s`` — the recentred
@@ -597,10 +626,21 @@ class MortonStreamSplit:
         arr = self._read(int(a), int(b))
         return arr["id"].astype(np.int32), arr["x"]
 
-    def close(self) -> None:
-        if not self._closed:
-            self._closed = True
-            shutil.rmtree(self._spill_dir, ignore_errors=True)
+    def close(self, sync: bool = True) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        dist = _dist()
+        if dist.process_count() > 1:
+            # Fleet close: every process reaches here at the same
+            # program point; the barrier keeps the coordinator from
+            # removing the shared spill while a slower process still
+            # reads its last range, then only the coordinator unlinks.
+            if sync:
+                dist.barrier("stream.close")
+            if not dist.is_coordinator():
+                return
+        shutil.rmtree(self._spill_dir, ignore_errors=True)
 
     def __enter__(self):
         return self
@@ -610,7 +650,10 @@ class MortonStreamSplit:
 
     def __del__(self):  # best-effort: tempdir never outlives the handle
         try:
-            self.close()
+            # No collective from a destructor — at interpreter teardown
+            # a barrier could hang the fleet; an unsynced coordinator
+            # rmtree on an abandoned handle has no readers to race.
+            self.close(sync=False)
         except Exception:  # noqa: BLE001 — interpreter teardown
             pass
 
@@ -673,6 +716,19 @@ def morton_range_split_streaming(
     the same axes as the in-RAM split up to f32-vs-f64 variance
     rounding on near-tied axes; byte parity is pinned for d <= 32
     (every axis keyed).
+
+    **Multi-process fleets** (``parallel.dist``): the build partitions
+    across processes — pass 1 runs on the coordinator alone and its
+    tiny products (frame constants, splitter keys, spill-dir name)
+    broadcast; pass 2 splits by chunk index and pass 3 by bucket index
+    (build wall ∝ 1/P), each process appending to its own per-bucket /
+    sorted-segment files in ONE shared spill directory every process
+    can read (``PYPARDIS_SPILL_DIR`` on a shared store for real
+    multi-host fleets; localhost fleets share the system tempdir).
+    The unique (words..., id) composite key makes each bucket's sort
+    independent of segment arrival order, so range reads stay
+    byte-identical to the single-process build — pinned by
+    tests/test_multihost.py.
     """
     n, k = points.shape
     n_ranges = max(1, int(n_ranges))
@@ -680,19 +736,32 @@ def morton_range_split_streaming(
         raise ValueError(
             "morton_range_split_streaming: N must fit int32 gids"
         )
-    center = _chunked_center(points, n, k)
+    dist = _dist()
+    n_procs = dist.process_count()
+    my_proc = dist.process_index()
 
-    # -- pass 1: exact extrema (+ moments for the d>32 axis subset) ----
-    lo_raw = np.full(k, np.inf)
-    hi_raw = np.full(k, -np.inf)
-    sumsq = np.zeros(k, np.float64)
-    for s in range(0, n, _CENTER_CHUNK):
-        c = np.asarray(points[s:min(s + _CENTER_CHUNK, n)])
-        np.minimum(lo_raw, c.min(axis=0), out=lo_raw)
-        np.maximum(hi_raw, c.max(axis=0), out=hi_raw)
-        if k > 32:
-            d = c.astype(np.float64) - center
-            sumsq += np.sum(d * d, axis=0)
+    # -- pass 1: center + exact extrema (+ moments for the d>32 axis
+    # subset).  Coordinator-only in a fleet: the products are tiny and
+    # broadcasting them keys every process against bit-identical frame
+    # constants without P redundant full-data scans.
+    if n_procs == 1 or dist.is_coordinator():
+        center = _chunked_center(points, n, k)
+        lo_raw = np.full(k, np.inf)
+        hi_raw = np.full(k, -np.inf)
+        sumsq = np.zeros(k, np.float64)
+        for s in range(0, n, _CENTER_CHUNK):
+            c = np.asarray(points[s:min(s + _CENTER_CHUNK, n)])
+            np.minimum(lo_raw, c.min(axis=0), out=lo_raw)
+            np.maximum(hi_raw, c.max(axis=0), out=hi_raw)
+            if k > 32:
+                d = c.astype(np.float64) - center
+                sumsq += np.sum(d * d, axis=0)
+        frame = (center, lo_raw, hi_raw, sumsq)
+    else:
+        frame = None
+    if n_procs > 1:
+        frame = dist.broadcast_arrays(frame)
+    center, lo_raw, hi_raw, sumsq = frame
     ka, bits = morton_plan(k)
     axes = np.arange(k)
     if k > ka:
@@ -729,33 +798,64 @@ def morton_range_split_streaming(
     n_sample = int(min(n, max(4096, sample_per_bucket * n_buckets)))
     sampled = 0
     if n_buckets > 1 and n:
-        sample_ids = np.unique(rng.integers(0, n, n_sample))
-        sampled = len(sample_ids)
-        sw = _keys(_recentred_rows_at(points, sample_ids, center, k))
-        s_order = np.lexsort(
-            (sample_ids,) + tuple(sw[::-1])
-        )
-        pos = (np.arange(1, n_buckets)
-               * len(sample_ids)) // n_buckets
-        sel = s_order[pos]
-        spl_cols = [w[sel] for w in sw] + [sample_ids[sel].astype(
-            np.int64)]
+        # Coordinator samples and keys; the splitter columns broadcast
+        # (the NOWSort move) so every process buckets identically.
+        if n_procs == 1 or dist.is_coordinator():
+            sample_ids = np.unique(rng.integers(0, n, n_sample))
+            sampled = len(sample_ids)
+            sw = _keys(_recentred_rows_at(points, sample_ids, center, k))
+            s_order = np.lexsort(
+                (sample_ids,) + tuple(sw[::-1])
+            )
+            pos = (np.arange(1, n_buckets)
+                   * len(sample_ids)) // n_buckets
+            sel = s_order[pos]
+            spl_cols = [w[sel] for w in sw] + [sample_ids[sel].astype(
+                np.int64)]
+        else:
+            spl_cols = None
+        if n_procs > 1:
+            payload = None
+            if dist.is_coordinator():
+                payload = list(spl_cols) + [np.int64(sampled)]
+            out = dist.broadcast_arrays(payload)
+            spl_cols, sampled = [np.asarray(a) for a in out[:-1]], int(
+                out[-1]
+            )
     else:
         n_buckets = 1
         spl_cols = None
 
     # -- pass 2: bucket-append spill -----------------------------------
+    # Fleet: one shared spill dir (coordinator mkdtemp, name
+    # broadcast); chunks partition round-robin by chunk index and each
+    # process appends to its OWN per-bucket segment files, so pass-2
+    # wall drops ∝ 1/P with zero write contention.
     base_dir = spill_dir or envreg.raw("PYPARDIS_SPILL_DIR")
-    sdir = tempfile.mkdtemp(prefix="pypardis_gm_spill_", dir=base_dir)
+    if n_procs == 1 or dist.is_coordinator():
+        sdir = tempfile.mkdtemp(prefix="pypardis_gm_spill_", dir=base_dir)
+    else:
+        sdir = None
+    if n_procs > 1:
+        # The broadcast doubles as the "dir exists" rendezvous.
+        sdir = dist.broadcast_str(sdir)
     rec = np.dtype([("w", "<u8", (n_words,)), ("id", "<i8"),
                     ("x", "<f4", (k,))])
     rec2 = np.dtype([("id", "<i8"), ("x", "<f4", (k,))])
+
+    def _bucket_path(b: int, p: int) -> str:
+        if n_procs == 1:
+            return os.path.join(sdir, f"b{b:04d}.bin")
+        return os.path.join(sdir, f"b{b:04d}.p{p:02d}.bin")
+
     try:
         counts = np.zeros(n_buckets, np.int64)
-        files = [open(os.path.join(sdir, f"b{b:04d}.bin"), "wb")
+        files = [open(_bucket_path(b, my_proc), "wb")
                  for b in range(n_buckets)]
         try:
-            for s in range(0, n, chunk):
+            for ci, s in enumerate(range(0, n, chunk)):
+                if n_procs > 1 and ci % n_procs != my_proc:
+                    continue
                 e = min(s + chunk, n)
                 sub = _recentred(s, e)
                 words = _keys(sub)
@@ -782,18 +882,59 @@ def morton_range_split_streaming(
         finally:
             for f in files:
                 f.close()
+        if n_procs > 1:
+            # Nobody sorts a bucket a peer is still appending to; then
+            # GLOBAL bucket counts come off the shared store's file
+            # sizes (exact — records are fixed-width).
+            dist.barrier("stream.pass2")
+            counts = np.zeros(n_buckets, np.int64)
+            for b in range(n_buckets):
+                for p in range(n_procs):
+                    try:
+                        sz = os.path.getsize(_bucket_path(b, p))
+                    except OSError:
+                        sz = 0
+                    counts[b] += sz // rec.itemsize
+            # Second rendezvous: pass 3 unlinks each segment right
+            # after reading it, so nobody may start sorting until every
+            # peer has finished SIZING — a fast process's unlink would
+            # zero a slow peer's counts for the buckets it doesn't own.
+            dist.barrier("stream.counts")
 
         # -- pass 3: sort each bucket alone, stream tile boxes ---------
+        # Fleet: buckets partition round-robin; bucket b's records are
+        # the concatenation of every process's segment, and the UNIQUE
+        # (words..., id) lexsort key makes the sorted bucket
+        # independent of segment order — byte-identical to the
+        # single-process sort.  Global write positions come from the
+        # exclusive bucket-count scan, so tile-box accumulation and
+        # range reads see the same global layout.
         nt = -(-n // block) if block else 0
         tlo = np.full((nt, k), np.float32(np.inf)) if nt else None
         thi = np.full((nt, k), np.float32(-np.inf)) if nt else None
-        sorted_path = os.path.join(sdir, "sorted.bin")
-        gpos = 0
+        offsets = np.concatenate(([0], np.cumsum(counts)))
+        if n_procs == 1:
+            sorted_path = os.path.join(sdir, "sorted.bin")
+        else:
+            sorted_path = os.path.join(
+                sdir, f"sorted.p{my_proc:02d}.bin"
+            )
         with open(sorted_path, "wb") as out:
             for b in range(n_buckets):
-                path = os.path.join(sdir, f"b{b:04d}.bin")
-                raw = np.fromfile(path, dtype=rec)
-                os.unlink(path)
+                if n_procs > 1 and b % n_procs != my_proc:
+                    continue
+                segs = []
+                for p in range(n_procs):
+                    path = _bucket_path(b, p)
+                    if os.path.exists(path):
+                        segs.append(np.fromfile(path, dtype=rec))
+                        os.unlink(path)
+                raw = (
+                    segs[0] if len(segs) == 1
+                    else np.concatenate(segs) if segs
+                    else np.empty(0, rec)
+                )
+                del segs
                 if len(raw) == 0:
                     continue
                 perm = np.lexsort(
@@ -816,9 +957,47 @@ def morton_range_split_streaming(
                     out.write(o2.tobytes())
                     del o2
                 if nt:
-                    _accum_tile_boxes(tlo, thi, srt["x"], gpos, block)
-                gpos += len(srt)
+                    _accum_tile_boxes(
+                        tlo, thi, srt["x"], int(offsets[b]), block
+                    )
                 del srt
+        segments = None
+        if n_procs > 1:
+            if nt:
+                np.savez(
+                    os.path.join(sdir, f"boxes.p{my_proc:02d}.npz"),
+                    tlo=tlo, thi=thi,
+                )
+            dist.barrier("stream.pass3")
+            if nt:
+                # Elementwise-merge every process's tile boxes: each
+                # tile's true box is the min/max over the buckets that
+                # touched it, wherever they sorted.
+                for p in range(n_procs):
+                    if p == my_proc:
+                        continue
+                    with np.load(os.path.join(
+                        sdir, f"boxes.p{p:02d}.npz"
+                    )) as z:
+                        np.minimum(tlo, z["tlo"], out=tlo)
+                        np.maximum(thi, z["thi"], out=thi)
+            # Global span table — derivable on every process from the
+            # shared counts: bucket b lives in process (b mod P)'s
+            # segment file at the running offset of that process's
+            # earlier buckets.
+            seg_pos = [0] * n_procs
+            segments = []
+            for b in range(n_buckets):
+                c = int(counts[b])
+                if not c:
+                    continue
+                p = b % n_procs
+                segments.append((
+                    int(offsets[b]), int(offsets[b]) + c,
+                    os.path.join(sdir, f"sorted.p{p:02d}.bin"),
+                    seg_pos[p] * rec2.itemsize,
+                ))
+                seg_pos[p] += c
 
         # -- starts: the in-RAM formulas, verbatim ---------------------
         if eps is not None and block is not None and n_ranges > 1 and n:
@@ -833,14 +1012,19 @@ def morton_range_split_streaming(
             "stream_buckets": int(n_buckets),
             "stream_max_bucket_rows": int(counts.max()) if n else 0,
             "stream_sample_rows": int(sampled),
+            "stream_procs": int(n_procs),
             "spill_bytes": int(n * (rec.itemsize + rec2.itemsize)),
         }
         return MortonStreamSplit(
             n, k, starts, center, sdir, sorted_path, rec2, tlo, thi,
-            stats,
+            stats, segments=segments,
         )
     except BaseException:
-        shutil.rmtree(sdir, ignore_errors=True)
+        # A fleet member failing mid-build is a whole-fleet failure
+        # (peers block at the next barrier until the launcher tears
+        # them down); only the coordinator owns the shared dir.
+        if n_procs == 1 or dist.is_coordinator():
+            shutil.rmtree(sdir, ignore_errors=True)
         raise
 
 

@@ -29,7 +29,9 @@ def _stat_rows(pstats) -> np.ndarray:
     ladder only reads the first two columns; the rest ride through for
     the drivers' FLOP model and the mixed-precision band telemetry.
     """
-    ps = np.asarray(pstats)
+    from ..parallel import dist
+
+    ps = dist.fetch_np(pstats)
     return ps.reshape(-1, ps.shape[-1] if ps.ndim else 1)
 
 

@@ -96,6 +96,17 @@ _DECLARATIONS: Tuple[EnvVar, ...] = (
            "Seed of the sparse random-projection matrix; fixed per "
            "(d, k, seed) so sketches are reproducible across hosts."),
     # -- distributed execution ----------------------------------------
+    EnvVar("PYPARDIS_DIST_COORD", "str", "unset (single-process)",
+           "jax.distributed coordinator address (`host:port`); set on "
+           "every worker of a multi-process fleet, unset runs the "
+           "classic single-process path."),
+    EnvVar("PYPARDIS_DIST_NPROCS", "int", "unset (single-process)",
+           "Total controller processes in the fleet "
+           "(`jax.distributed.initialize(num_processes=)`)."),
+    EnvVar("PYPARDIS_DIST_PROC_ID", "int", "unset (single-process)",
+           "This worker's rank in [0, PYPARDIS_DIST_NPROCS); process "
+           "0 is the coordinator (writes jobstate snapshots and the "
+           "shared spill dir for the whole fleet)."),
     EnvVar("PYPARDIS_CHAINED_OVERLAP", "bool", "1",
            "Double-buffered host build/ship overlap on the 1-device "
            "chained route."),
@@ -189,6 +200,10 @@ _DECLARATIONS: Tuple[EnvVar, ...] = (
     EnvVar("PYPARDIS_RETRY_DEADLINE_S", "float", "unset",
            "Wall-clock deadline across a retry ladder's attempts."),
     # -- observability ------------------------------------------------
+    EnvVar("PYPARDIS_FLEET_SKEW_WARN_S", "float", "5",
+           "FleetReplay clock-skew warning threshold: member flight "
+           "files whose `t_unix` anchors spread wider than this flag "
+           "`clock_skew_warning` in the fleet report."),
     EnvVar("PYPARDIS_FLIGHT", "path", "unset",
            "Flight-recorder JSONL file (or directory for one file "
            "per fit); unset disables."),

@@ -66,6 +66,13 @@ very machinery a real fault would exercise):
                        (``serve.gateway.ModelGateway`` — fired before
                        the quota check, so an injected fault is shed
                        upstream and no engine state mutates)
+``dist.worker``        each fixpoint round of a MULTI-PROCESS fit, on
+                       every worker (fired before the round's
+                       collective, so plans scoped to one worker's
+                       PYPARDIS_FAULTS kill/stall that worker mid-
+                       fixpoint — the pod fault drill: tear down the
+                       fleet, relaunch with ``train(resume=)``, labels
+                       byte-identical)
 ===================== ====================================================
 
 Zero-cost when unset: ``maybe_fail`` is one module-global ``is None``
@@ -105,6 +112,7 @@ KNOWN_SITES = (
     "ingest.batch",
     "compact.phase",
     "gateway.admit",
+    "dist.worker",
 )
 
 _ENTRY_RE = re.compile(

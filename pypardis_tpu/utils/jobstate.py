@@ -182,6 +182,16 @@ class JobState:
     def flush(self, force: bool = False) -> None:
         if not force and not self.due():
             return
+        # Multi-process fleets: every worker tracks the same snapshot
+        # state (the fixpoint fetches are allgathered), but only the
+        # coordinator writes — N workers racing os.replace on one
+        # shared-store path would tear it.  Resume reads the shared
+        # path on every worker.
+        from ..parallel import dist
+
+        if not dist.is_coordinator():
+            self._last_write = time.monotonic()
+            return
         payload: Dict = {"meta": json.dumps(self.meta)}
         if self._chained:
             ps = sorted(self._chained)

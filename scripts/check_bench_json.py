@@ -529,6 +529,77 @@ def main() -> None:
                 f"tenant {name!r} latency_hist",
             )
 
+    # Pod-scale execution contract (ISSUE 20): the multihost row proves
+    # the multi-process mesh is not a demo — a >= 2-process fleet whose
+    # fits land BYTE-IDENTICAL to the single-process run of the same
+    # global device count (both merges + the KD route + the streaming
+    # build), a SIGKILL-mid-fixpoint drill that resumed from the
+    # coordinator's snapshot back to parity with the injected fault
+    # visible in the merged fleet flight, and a same-host fleet whose
+    # clock-skew flag stayed quiet.  The P=4 streaming-build speedup
+    # (>= 1.8x) is enforced only when the probe had the cores to gate
+    # it (build.gated) — a 1-core CI box reports, it does not gate.
+    if str(row["metric"]) == "multihost_pod_parity":
+        if row.get("schema") != "pypardis_tpu/multihost@1":
+            fail(f"multihost row schema is {row.get('schema')!r}")
+        if int(row.get("processes", 0)) < 2:
+            fail(f"multihost row ran {row.get('processes')!r} "
+                 f"process(es), need >= 2")
+        par = row.get("parity")
+        if not isinstance(par, dict):
+            fail("multihost row without the parity block")
+        for key in ("gm_device", "gm_host", "kd", "stream"):
+            if par.get(key) is not True:
+                fail(
+                    f"multihost parity.{key} is {par.get(key)!r}; the "
+                    f"fleet fit must be byte-identical to the "
+                    f"single-process run"
+                )
+        drill = row.get("drill")
+        if not isinstance(drill, dict):
+            fail("multihost row without the fault-drill block")
+        if drill.get("parity") is not True:
+            fail(f"multihost drill.parity is {drill.get('parity')!r}")
+        if int(drill.get("restored_rounds", 0)) < 1:
+            fail(
+                f"multihost drill restored "
+                f"{drill.get('restored_rounds')!r} round(s); the "
+                f"resume must replay snapshotted work, not refit"
+            )
+        if int(drill.get("fault_injected_seen", 0)) < 1:
+            fail("multihost drill saw no fault_injected event in the "
+                 "killed run's merged flight")
+        build = row.get("build")
+        if not isinstance(build, dict):
+            fail("multihost row without the build block")
+        for key in ("solo_s", "fleet_s", "speedup"):
+            v = build.get(key)
+            if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                    or v != v or v in (float("inf"), float("-inf")):
+                fail(f"build.{key} is {v!r}, expected a finite number")
+        if build.get("gated") is True and float(build["speedup"]) < 1.8:
+            fail(
+                f"gated P={build.get('procs')!r} streaming-build "
+                f"speedup {build['speedup']!r} < 1.8x"
+            )
+        ff = row.get("fleet_flight")
+        if not isinstance(ff, dict):
+            fail("multihost row without the fleet_flight block")
+        if int(ff.get("members", 0)) != int(row.get("processes", 0)):
+            fail(
+                f"fleet flight merged {ff.get('members')!r} member "
+                f"file(s) for {row.get('processes')!r} process(es)"
+            )
+        if ff.get("complete") is not True:
+            fail("fleet flight merge is incomplete (a member flight "
+                 "is missing its seal)")
+        if ff.get("clock_skew_warning") is not False:
+            fail(
+                f"fleet clock_skew_warning is "
+                f"{ff.get('clock_skew_warning')!r} on a same-host "
+                f"fleet; expected False"
+            )
+
     # Live-observability contract (ISSUE 16): a monitor row proves the
     # export plane actually answered DURING the fit — the probe must
     # have scraped the OpenMetrics endpoint mid-run (>= 1 scrape with

@@ -424,6 +424,78 @@ def main() -> None:
         parity and restored_compact >= 1,
     )
 
+    # -- 7: pod-scale drill — a fleet WORKER SIGKILLed mid-fixpoint -------
+    # (ISSUE 20) The in-process kills above never exercise the multi-
+    # controller failure mode: one rank of a jax.distributed fleet
+    # dying mid-collective while its peers block.  Reuse the probe's
+    # faultfit worker: rank 1 arms dist.worker:3=error and converts it
+    # to a real SIGKILL; launch_fleet tears the survivors down; a
+    # resumed fleet replays the coordinator's shared snapshot back to
+    # byte parity, and the killed rank's fault_injected event is
+    # recovered from its unsealed flight file.
+    from pypardis_tpu import obs
+    from pypardis_tpu.parallel import dist
+
+    probe = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "multihost_probe.py"
+    )
+    assert _N_DEV % 2 == 0, "fleet drill splits the mesh across 2 procs"
+    tmp7 = tempfile.mkdtemp(prefix="fault_probe_fleet_")
+    ckpt7 = os.path.join(tmp7, "fleet.ckpt.npz")
+    base7 = os.path.join(tmp7, "drill")
+    flight7 = os.path.join(tmp7, "flight")
+    fleet_env = dict(os.environ)
+    fleet_env["PYTHONPATH"] = os.pathsep.join(
+        [sys.path[0]] + [p for p in [fleet_env.get("PYTHONPATH")] if p]
+    )
+    fleet_env.pop("XLA_FLAGS", None)  # launch_fleet sets the workers'
+    fleet_env.pop("PYPARDIS_FAULTS", None)
+    fleet_env.update({
+        "MH_N": str(n), "MH_CKPT": ckpt7, "PYPARDIS_CKPT_EVERY_S": "0",
+    })
+    argv7 = [sys.executable, probe, "--worker", "faultfit", base7]
+    rcs, kill_port, _, _ = dist.launch_fleet(
+        argv7, 2, _N_DEV // 2,
+        env=dict(fleet_env, MH_KILL_RANK="1", MH_KILL_OCC="3",
+                 MH_FLIGHT_BASE=flight7),
+        timeout_s=float(os.environ.get("FAULT_TIMEOUT_S", 300)),
+    )
+    check(f"fleet drill: injected kill took the fleet down "
+          f"(rcs={rcs})", any(rc != 0 for rc in rcs))
+    check("fleet drill: coordinator snapshot survived",
+          os.path.exists(ckpt7))
+    rcs, _, _, tails = dist.launch_fleet(
+        argv7, 2, _N_DEV // 2, env=fleet_env,
+        timeout_s=float(os.environ.get("FAULT_TIMEOUT_S", 300)),
+    )
+    if any(rcs):
+        for t in tails:
+            print(t[-2000:], file=sys.stderr)
+    check("fleet drill: resumed fleet completed", not any(rcs))
+    fleet_parity = True
+    restored_fleet = 0
+    for r in range(2):
+        with np.load(f"{base7}.p{r:02d}.npz") as z:
+            fleet_parity &= (
+                np.array_equal(z["labels"], base_labels)
+                and np.array_equal(z["core"], clean_gm.core_sample_mask_)
+            )
+            restored_fleet = max(restored_fleet,
+                                 int(z["restored_rounds"]))
+    injected_fleet = sum(
+        1 for r in obs.replay(
+            os.path.join(flight7, f"a{kill_port}")
+        ).merged_records()
+        if r.get("k") == "ev" and r.get("kind") == "fault_injected"
+        and r.get("f", {}).get("site") == "dist.worker"
+    )
+    passed += check(
+        f"fleet kill/resume parity: resumed 2-process labels "
+        f"byte-identical (restored_rounds={restored_fleet}, "
+        f"injected_event_recovered={injected_fleet})",
+        fleet_parity and restored_fleet >= 1 and injected_fleet >= 1,
+    )
+
     row = {
         "metric": "fault_probe_scenarios",
         "value": passed,
@@ -441,6 +513,12 @@ def main() -> None:
         "kill_resume_compaction": {
             "restored_rounds": restored_compact,
             "index_byte_identical": True,
+        },
+        "kill_resume_fleet": {
+            "processes": 2,
+            "restored_rounds": restored_fleet,
+            "fault_injected_seen": injected_fleet,
+            "labels_match": True,
         },
         "telemetry": rep,
     }

@@ -1,0 +1,419 @@
+#!/usr/bin/env python
+"""Pod-scale execution probe (``make multihost-probe``, in bench-smoke).
+
+Proves the PR-20 multi-process contract end to end on a localhost
+fleet of ``jax.distributed`` controller processes with faked CPU
+devices (2 processes x 4 devices — the same 8 global devices the
+in-process reference mesh uses):
+
+1. **fit parity** — a 2-process global-Morton fit is BYTE-IDENTICAL to
+   the single-process 8-device fit, under BOTH merges (``device`` and
+   ``host``), and the KD route likewise;
+2. **shared-store streaming build** — the external sample-sort's
+   pass 2/3 partition across processes; starts / center / tile boxes /
+   sorted order byte-identical to the solo build, with the measured
+   build walls reported (the >= 1.8x P=4 speedup gate applies only
+   when the host actually has >= 4 cores — report-only on 1-core CI);
+3. **fault drill** — one worker SIGKILLs itself mid-fixpoint
+   (``dist.worker`` injection), the launcher tears the fleet down, and
+   a relaunch with ``train(resume=)`` against the coordinator's
+   jobstate snapshot lands labels byte-identical to the clean run;
+4. **fleet flight merge** — every process records its own flight file
+   into one shared dir; ``obs.replay(dir)`` merges them, the killed
+   worker's ``fault_injected`` event survives in the merged stream,
+   and the clock-skew flag stays quiet on a same-host fleet.
+
+Emits ONE bench-style JSON row (``schema="pypardis_tpu/multihost@1"``,
+``metric="multihost_pod_parity"``) whose telemetry block is the CLEAN
+in-process reference fit's report, so the row rides the
+``bench_diff --annotate`` / ``check_bench_json --require-diff`` gate
+like every other probe.
+
+Workers re-enter this file via ``--worker <task>`` (shared with
+``tests/test_multihost.py`` and ``scripts/fault_probe.py`` so there is
+exactly one fleet-worker body).  Geometry via env: MH_N (default
+3000), MH_STREAM_N (default 20000).
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+_DEV_PER_PROC = 4
+_N_PROCS = 2
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+KW = dict(eps=0.45, min_samples=5, block=64)
+STREAM_KW = dict(eps=0.4, block=64, bucket_bytes=100_000, chunk=3000)
+
+
+def _force_cpu_mesh(n_dev: int) -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_dev}"
+        ).strip()
+
+
+def chain_data(n: int):
+    """One cluster threading every Morton shard: the pmin fixpoint
+    needs several rounds, so the ``dist.worker`` injection window is
+    wide and deterministic (same geometry as fault_probe)."""
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    X = np.stack(
+        [np.arange(n) * 0.1, rng.normal(0, 0.05, n)], axis=1
+    )
+    return X.astype(np.float32)
+
+
+def stream_data(n: int):
+    import numpy as np
+
+    rng = np.random.default_rng(1)
+    return rng.normal(size=(n, 4)).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# worker body (one per fleet process; tests and fault_probe reuse it)
+# ---------------------------------------------------------------------------
+
+
+def worker(task: str, out_base: str) -> None:
+    """Fleet worker: join via the PYPARDIS_DIST_* env knobs
+    (launch_fleet sets them), run ``task``, save
+    ``<out_base>.p<rank>.npz``."""
+    import numpy as np
+
+    from pypardis_tpu.parallel import dist
+
+    if not dist.init_distributed():
+        # A 1-process "fleet" (the parity reference in tests) runs the
+        # classic single-process path on its faked devices.
+        assert os.environ.get("PYPARDIS_DIST_NPROCS") == "1", \
+            "worker needs PYPARDIS_DIST_* set"
+    rank = dist.process_index()
+    # Per-ATTEMPT flight dir: launch_fleet relaunches the whole fleet
+    # on a fresh coordinator port after a bind collision or a gloo
+    # transport abort, and a dead first attempt's half-written flight
+    # files must not pollute the final fleet's merge — so key the dir
+    # by the port, which the launcher reports back to the driver.
+    if os.environ.get("MH_FLIGHT_BASE"):
+        port = os.environ["PYPARDIS_DIST_COORD"].rsplit(":", 1)[1]
+        os.environ["PYPARDIS_FLIGHT"] = os.path.join(
+            os.environ["MH_FLIGHT_BASE"], f"a{port}"
+        )
+    out = {}
+    if task == "fits":
+        from pypardis_tpu import DBSCAN
+
+        X = chain_data(int(os.environ.get("MH_N", 3000)))
+        for mode, merge in (("global_morton", "device"),
+                            ("global_morton", "host"),
+                            ("kd", "device")):
+            m = DBSCAN(mode=mode, merge=merge, **KW)
+            m.fit(X)
+            out[f"labels_{mode}.{merge}"] = m.labels_
+            out[f"core_{mode}.{merge}"] = m.core_sample_mask_
+    elif task == "stream":
+        from pypardis_tpu.partition import morton_range_split_streaming
+
+        X = stream_data(int(os.environ.get("MH_STREAM_N", 20000)))
+        t0 = time.perf_counter()
+        sp = morton_range_split_streaming(X, 4, **STREAM_KW)
+        out["build_s"] = np.float64(time.perf_counter() - t0)
+        ids, _rows = sp.row_span(0, sp.n)
+        out.update(
+            starts=sp.starts, center=sp.center,
+            tlo=sp.tile_lo, thi=sp.tile_hi, ids=ids,
+        )
+        sp.close()
+    elif task == "faultfit":
+        # The drill: the designated rank arms a terminal dist.worker
+        # fault and converts it to a REAL SIGKILL (no cleanup, no
+        # flight seal) — the harshest mid-fixpoint death.  A resumed
+        # relaunch (MH_KILL_RANK unset) replays the coordinator's
+        # snapshot.
+        import signal
+
+        from pypardis_tpu import DBSCAN
+        from pypardis_tpu.utils import faults
+
+        X = chain_data(int(os.environ.get("MH_N", 3000)))
+        kill_rank = int(os.environ.get("MH_KILL_RANK", -1))
+        if rank == kill_rank:
+            faults.install(
+                "dist.worker:%s=error"
+                % os.environ.get("MH_KILL_OCC", "3")
+            )
+        m = DBSCAN(mode="global_morton", merge="device", **KW)
+        try:
+            m.train(X, resume=os.environ["MH_CKPT"])
+        except faults.FaultInjected:
+            os.kill(os.getpid(), signal.SIGKILL)
+        out["labels"] = m.labels_
+        out["core"] = m.core_sample_mask_
+        out["restored_rounds"] = np.int64(
+            m._jobstate.restored_rounds if m._jobstate else 0
+        )
+    else:
+        raise SystemExit(f"unknown worker task {task!r}")
+    np.savez(f"{out_base}.p{rank:02d}.npz", **out)
+
+
+# ---------------------------------------------------------------------------
+# probe driver
+# ---------------------------------------------------------------------------
+
+
+def check(msg: str, ok: bool) -> bool:
+    print(f"multihost-probe: {msg}: {'ok' if ok else 'FAILED'}",
+          file=sys.stderr)
+    if not ok:
+        sys.exit(1)
+    return True
+
+
+def _fleet(task: str, out_base: str, n_procs: int, env_extra=None,
+           expect_fail: bool = False):
+    from pypardis_tpu.parallel import dist
+
+    env = dict(os.environ)
+    # Workers must import the repo regardless of the launch cwd.
+    env["PYTHONPATH"] = os.pathsep.join(
+        [sys.path[0]] + [p for p in [env.get("PYTHONPATH")] if p]
+    )
+    # The launcher sets the fleet's own XLA_FLAGS/JAX_PLATFORMS.
+    env.pop("XLA_FLAGS", None)
+    env.update(env_extra or {})
+    rcs, port, attempts, tails = dist.launch_fleet(
+        [sys.executable, os.path.abspath(__file__), "--worker", task,
+         out_base],
+        n_procs, _DEV_PER_PROC, env=env,
+        timeout_s=float(os.environ.get("MH_TIMEOUT_S", 600)),
+    )
+    if attempts > 1:
+        print(f"multihost-probe: fleet task {task!r} relaunched "
+              f"({attempts} attempts)", file=sys.stderr)
+    if not expect_fail and any(rcs):
+        for t in tails:
+            print(t[-2000:], file=sys.stderr)
+        check(f"fleet task {task!r} exited {rcs}", False)
+    return rcs, port
+
+
+def main() -> None:
+    if len(sys.argv) > 2 and sys.argv[1] == "--worker":
+        worker(sys.argv[2], sys.argv[3])
+        return
+
+    _force_cpu_mesh(_N_PROCS * _DEV_PER_PROC)
+    import numpy as np
+
+    from pypardis_tpu import DBSCAN, obs
+    from pypardis_tpu.partition import morton_range_split_streaming
+
+    n = int(os.environ.get("MH_N", 3000))
+    X = chain_data(n)
+    tmp = tempfile.mkdtemp(prefix="multihost_probe_")
+
+    # -- in-process reference (8 devices, 1 process) -----------------------
+    ref = {}
+    for mode, merge in (("global_morton", "device"),
+                        ("global_morton", "host"), ("kd", "device")):
+        m = DBSCAN(mode=mode, merge=merge, **KW)
+        m.fit(X)
+        ref[f"{mode}.{merge}"] = (
+            np.asarray(m.labels_), np.asarray(m.core_sample_mask_),
+        )
+        if (mode, merge) == ("global_morton", "device"):
+            rep = m.report()
+    assert rep["faults"]["injected"] == 0
+
+    # -- 1: fleet fit parity, both merges + KD -----------------------------
+    fit_base = os.path.join(tmp, "fits")
+    _fleet("fits", fit_base, _N_PROCS)
+    parity = {}
+    for r in range(_N_PROCS):
+        with np.load(f"{fit_base}.p{r:02d}.npz") as z:
+            for key, (labels, core) in ref.items():
+                ok = (
+                    np.array_equal(z[f"labels_{key}"], labels)
+                    and np.array_equal(z[f"core_{key}"], core)
+                )
+                parity[key] = parity.get(key, True) and ok
+    for key, ok in parity.items():
+        check(f"2-process {key} fit byte-identical to 1-process "
+              f"8-device", ok)
+
+    # -- 2: shared-store streaming build -----------------------------------
+    sn = int(os.environ.get("MH_STREAM_N", 20000))
+    SX = stream_data(sn)
+    t0 = time.perf_counter()
+    sp = morton_range_split_streaming(SX, 4, **STREAM_KW)
+    solo_s = time.perf_counter() - t0
+    solo_ids, _ = sp.row_span(0, sp.n)
+    cores = os.cpu_count() or 1
+    build_procs = 4 if cores >= 4 else _N_PROCS
+    st_base = os.path.join(tmp, "stream")
+    tf0 = time.perf_counter()
+    _fleet("stream", st_base, build_procs,
+           env_extra={"MH_STREAM_N": str(sn)})
+    fleet_wall = time.perf_counter() - tf0
+    stream_ok, fleet_s = True, 0.0
+    for r in range(build_procs):
+        with np.load(f"{st_base}.p{r:02d}.npz") as z:
+            stream_ok &= (
+                np.array_equal(z["starts"], sp.starts)
+                and np.array_equal(z["center"], sp.center)
+                and np.array_equal(z["tlo"], sp.tile_lo)
+                and np.array_equal(z["thi"], sp.tile_hi)
+                and np.array_equal(z["ids"], solo_ids)
+            )
+            fleet_s = max(fleet_s, float(z["build_s"]))
+    sp.close()
+    check(f"{build_procs}-process streaming build byte-identical "
+          f"(starts/center/boxes/order)", stream_ok)
+    speedup = solo_s / max(fleet_s, 1e-9)
+    speedup_gated = cores >= 4 and build_procs >= 4
+    if speedup_gated:
+        check(f"P=4 streaming build speedup {speedup:.2f}x >= 1.8x "
+              f"({cores} cores)", speedup >= 1.8)
+    else:
+        print(
+            f"multihost-probe: build speedup {speedup:.2f}x at "
+            f"P={build_procs} (report-only: {cores} core(s))",
+            file=sys.stderr,
+        )
+
+    # -- 3: fault drill — SIGKILL mid-fixpoint, fleet resume --------------
+    # Two flight dirs: one per launch — a fleet merge spans ONE fleet's
+    # members; merging two launches minutes apart is exactly what the
+    # clock-skew flag exists to call out.
+    flight_kill = os.path.join(tmp, "flight_kill")
+    flight_resume = os.path.join(tmp, "flight_resume")
+    ckpt = os.path.join(tmp, "drill.ckpt.npz")
+    drill_base = os.path.join(tmp, "drill")
+    rcs, kill_port = _fleet(
+        "faultfit", drill_base, _N_PROCS,
+        env_extra={
+            "MH_CKPT": ckpt, "MH_KILL_RANK": "1", "MH_KILL_OCC": "3",
+            "PYPARDIS_CKPT_EVERY_S": "0",
+            "MH_FLIGHT_BASE": flight_kill,
+        },
+        expect_fail=True,
+    )
+    check(f"drill fleet died from the injected kill (rcs={rcs})",
+          any(rc != 0 for rc in rcs))
+    check("coordinator jobstate snapshot survived the kill",
+          os.path.exists(ckpt))
+    _, resume_port = _fleet(
+        "faultfit", drill_base, _N_PROCS,
+        env_extra={
+            "MH_CKPT": ckpt, "PYPARDIS_CKPT_EVERY_S": "0",
+            "MH_FLIGHT_BASE": flight_resume,
+        },
+    )
+    # The workers nested each attempt's flights under a<port>; the
+    # launcher's returned port names the attempt that actually ran.
+    flight_kill = os.path.join(flight_kill, f"a{kill_port}")
+    flight_resume = os.path.join(flight_resume, f"a{resume_port}")
+    base_labels, base_core = ref["global_morton.device"]
+    restored = 0
+    drill_ok = True
+    for r in range(_N_PROCS):
+        with np.load(f"{drill_base}.p{r:02d}.npz") as z:
+            drill_ok &= (
+                np.array_equal(z["labels"], base_labels)
+                and np.array_equal(z["core"], base_core)
+            )
+            restored = max(restored, int(z["restored_rounds"]))
+    check(
+        f"fleet resume labels byte-identical to the clean run "
+        f"(restored_rounds={restored})",
+        drill_ok and restored >= 1,
+    )
+
+    # -- 4: fleet flight merge --------------------------------------------
+    fleet_rep = obs.replay(flight_resume).report()
+    injected = sum(
+        1 for r in obs.replay(flight_kill).merged_records()
+        if r.get("k") == "ev" and r.get("kind") == "fault_injected"
+        and r.get("f", {}).get("site") == "dist.worker"
+    )
+    check(
+        f"fleet flight merge: {fleet_rep['hosts']} members, "
+        f"{fleet_rep['records']} records, killed run's injected event "
+        f"survived (count={injected})",
+        fleet_rep["hosts"] == _N_PROCS and fleet_rep["complete"]
+        and fleet_rep["records"] > 0 and injected >= 1,
+    )
+    check("same-host fleet clock-skew flag quiet",
+          fleet_rep["clock_skew_warning"] is False)
+    # And the flag's positive side: merging the kill-run and resume-run
+    # files as if they were ONE fleet puts the anchors a full fit wall
+    # apart — the default 5s threshold must call that out.
+    import glob as _glob
+
+    both = sorted(
+        _glob.glob(os.path.join(flight_kill, "*.jsonl"))
+        + _glob.glob(os.path.join(flight_resume, "*.jsonl"))
+    )
+    skew_trips = obs.fleet_replay(both).report()["clock_skew_warning"]
+    check("skew flag trips on a cross-launch merge", skew_trips is True)
+
+    row = {
+        "schema": "pypardis_tpu/multihost@1",
+        "metric": "multihost_pod_parity",
+        "value": _N_PROCS,
+        "unit": "processes",
+        "n": n,
+        "processes": _N_PROCS,
+        "devices_per_process": _DEV_PER_PROC,
+        "parity": {
+            "gm_device": bool(parity["global_morton.device"]),
+            "gm_host": bool(parity["global_morton.host"]),
+            "kd": bool(parity["kd.device"]),
+            "stream": bool(stream_ok),
+        },
+        "ring": {
+            "boundary_tile_bytes":
+                rep["sharding"]["boundary_tile_bytes"],
+            "ring_rounds": rep["sharding"]["ring_rounds"],
+            "fixpoint_rounds": rep["sharding"]["fixpoint_rounds"],
+        },
+        "drill": {
+            "resume_used": True,
+            "restored_rounds": restored,
+            "fault_injected_seen": injected,
+            "parity": bool(drill_ok),
+        },
+        "build": {
+            "solo_s": round(solo_s, 4),
+            "fleet_s": round(fleet_s, 4),
+            "fleet_wall_s": round(fleet_wall, 4),
+            "procs": build_procs,
+            "speedup": round(speedup, 4),
+            "gated": bool(speedup_gated),
+        },
+        "fleet_flight": {
+            "members": fleet_rep["hosts"],
+            "records": fleet_rep["records"],
+            "complete": fleet_rep["complete"],
+            "clock_skew_s": fleet_rep["clock_skew_s"],
+            "clock_skew_warning": fleet_rep["clock_skew_warning"],
+        },
+        "telemetry": rep,
+    }
+    print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,349 @@
+"""Pod-scale execution (pypardis_tpu.parallel.dist, ISSUE 20).
+
+Cheap tier-1 coverage of the multi-process seams — the single-process
+degenerate forms of the collectives (every host-stepped loop calls
+them unconditionally), the launcher's failure-signature classifiers
+and its retry loop (driven by tiny stub workers, no jax), the
+per-rank flight naming, the fleet clock-skew flag, and the env-knob /
+fault-site registrations — plus ``slow``-marked real-fleet tests that
+reuse ``scripts/multihost_probe.py``'s worker body: 2-process fit
+parity against this harness's in-process 8-device mesh and the
+shared-store streaming build's byte parity.
+"""
+
+import json
+import os
+import socket
+import sys
+import tempfile
+
+import numpy as np
+import pytest
+
+from pypardis_tpu.parallel import dist
+from pypardis_tpu.utils import envreg, faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+import multihost_probe  # noqa: E402  (scripts/ shim above)
+
+PROBE = os.path.join(REPO, "scripts", "multihost_probe.py")
+
+
+# ---------------------------------------------------------------------------
+# single-process degenerate forms (tier-1: every fit crosses these)
+# ---------------------------------------------------------------------------
+
+
+def test_single_process_identity():
+    assert not dist.is_distributed()
+    assert dist.is_coordinator()
+    assert dist.process_count() == 1
+    assert dist.process_index() == 0
+
+
+def test_fetch_np_single_process_is_asarray():
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from pypardis_tpu.parallel.mesh import default_mesh
+
+    x = np.arange(24, dtype=np.float32).reshape(8, 3)
+    staged = jax.device_put(
+        x, NamedSharding(default_mesh(), PartitionSpec("p"))
+    )
+    np.testing.assert_array_equal(dist.fetch_np(staged), x)
+    np.testing.assert_array_equal(dist.fetch_np(x), x)
+
+
+def test_broadcast_single_process_roundtrip():
+    assert dist.broadcast_bytes(b"abc") == b"abc"
+    assert dist.broadcast_str("sp/ill") == "sp/ill"
+    arrs = [np.arange(5), np.eye(2, dtype=np.float32)]
+    out = dist.broadcast_arrays(arrs)
+    assert len(out) == 2
+    for a, b in zip(arrs, out):
+        np.testing.assert_array_equal(a, b)
+        assert a.dtype == b.dtype
+    dist.barrier("test.noop")  # no fleet: must be a no-op, not a hang
+
+
+# ---------------------------------------------------------------------------
+# launcher plumbing: ports, env, failure-signature classifiers
+# ---------------------------------------------------------------------------
+
+
+def test_pick_port_is_bindable():
+    port = dist.pick_port()
+    assert 0 < port < 65536
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    try:
+        s.bind(("127.0.0.1", port))  # just vacated: still free
+    finally:
+        s.close()
+
+
+def test_fleet_env_knobs():
+    env = dist.fleet_env(12345, 2, 1, 4, base={})
+    assert env["PYPARDIS_DIST_COORD"] == "127.0.0.1:12345"
+    assert env["PYPARDIS_DIST_NPROCS"] == "2"
+    assert env["PYPARDIS_DIST_PROC_ID"] == "1"
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert "host_platform_device_count=4" in env["XLA_FLAGS"]
+
+
+def test_failure_signature_classifiers():
+    assert dist._looks_like_bind_collision(
+        "E0000 ... Address already in use"
+    )
+    assert not dist._looks_like_bind_collision("Segmentation fault")
+    # transport abort: SIGABRT (-6) AND a gloo marker, jointly
+    assert dist._looks_like_transport_abort(
+        [-6, 0], ["gloo::EnforceNotMet: op.preamble.length", ""]
+    )
+    assert dist._looks_like_transport_abort(
+        [0, -6], ["", "Connection reset by peer"]
+    )
+    # a SIGKILL'd worker (fault drill) must NEVER look like transport
+    assert not dist._looks_like_transport_abort(
+        [-9, -9], ["gloo::EnforceNotMet", ""]
+    )
+    # an abort without wire markers is a real bug, not a flake
+    assert not dist._looks_like_transport_abort(
+        [-6], ["assertion failed"]
+    )
+
+
+def _stub_argv(body: str):
+    return [sys.executable, "-c", body]
+
+
+def test_launch_fleet_retries_bind_collision():
+    rcs, _port, attempts, tails = dist.launch_fleet(
+        _stub_argv(
+            "import sys; sys.stderr.write('Failed to bind'); "
+            "sys.exit(1)"
+        ),
+        2, 1, retries=2, timeout_s=60,
+    )
+    assert rcs == [1, 1]
+    assert attempts == 3  # initial + 2 retries, then reported
+    assert all("Failed to bind" in t for t in tails)
+
+
+def test_launch_fleet_retries_simultaneous_transport_abort():
+    # BOTH ranks SIGABRT inside one poll window — the regression that
+    # used to skip the retry (the early-failure flag was never set
+    # when nobody was left alive).
+    rcs, _port, attempts, _tails = dist.launch_fleet(
+        _stub_argv(
+            "import os, signal, sys; "
+            "sys.stderr.write('gloo::EnforceNotMet: preamble'); "
+            "sys.stderr.flush(); "
+            "os.kill(os.getpid(), signal.SIGABRT)"
+        ),
+        2, 1, retries=1, timeout_s=60,
+    )
+    assert rcs == [-6, -6]
+    assert attempts == 2
+
+
+def test_launch_fleet_no_retry_on_real_failures():
+    # A Python error is a bug: report it once, never relaunch.
+    rcs, _port, attempts, tails = dist.launch_fleet(
+        _stub_argv("import sys; sys.stderr.write('boom'); sys.exit(3)"),
+        2, 1, retries=3, timeout_s=60,
+    )
+    assert rcs == [3, 3] and attempts == 1
+    # A pinned port disables retry even for a bind signature: the
+    # caller asked for THAT port, a fresh one would not be it.
+    rcs, port, attempts, _ = dist.launch_fleet(
+        _stub_argv(
+            "import sys; sys.stderr.write('Failed to bind'); "
+            "sys.exit(1)"
+        ),
+        2, 1, port=45678, retries=3, timeout_s=60,
+    )
+    assert rcs == [1, 1] and attempts == 1 and port == 45678
+
+
+def test_launch_fleet_success_and_teardown():
+    with tempfile.TemporaryDirectory() as d:
+        out = os.path.join(d, "r")
+        rcs, _port, attempts, _ = dist.launch_fleet(
+            _stub_argv(
+                "import os; "
+                "open(r'%s' + os.environ['PYPARDIS_DIST_PROC_ID'], "
+                "'w').write(os.environ['PYPARDIS_DIST_NPROCS'])" % out
+            ),
+            2, 1, retries=0, timeout_s=60,
+        )
+        assert rcs == [0, 0] and attempts == 1
+        for pid in range(2):
+            with open(f"{out}{pid}") as f:
+                assert f.read() == "2"
+
+
+# ---------------------------------------------------------------------------
+# registrations + per-rank surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_env_knobs_registered():
+    for name in ("PYPARDIS_DIST_COORD", "PYPARDIS_DIST_NPROCS",
+                 "PYPARDIS_DIST_PROC_ID", "PYPARDIS_SPILL_DIR",
+                 "PYPARDIS_FLEET_SKEW_WARN_S"):
+        assert name in envreg.REGISTRY, name
+
+
+def test_dist_worker_fault_site_known():
+    assert "dist.worker" in faults.KNOWN_SITES
+
+
+def test_open_flight_rank_suffix(tmp_path, monkeypatch):
+    from pypardis_tpu.obs import flight as flight_mod
+
+    monkeypatch.setattr(dist, "is_distributed", lambda: True)
+    monkeypatch.setattr(dist, "process_index", lambda: 2)
+    rec = flight_mod.open_flight(str(tmp_path / "fit.jsonl"))
+    rec.close()
+    assert (tmp_path / "fit.p02.jsonl").exists()
+    rec = flight_mod.open_flight(str(tmp_path / "d"))
+    rec.close()
+    names = os.listdir(tmp_path / "d")
+    assert len(names) == 1 and names[0].startswith("flight-r02-")
+
+
+def _write_flight(path, t_unix):
+    lines = [
+        {"k": "header", "schema": "pypardis_tpu/flight@1",
+         "pid": 1, "t_unix": t_unix},
+        {"k": "so", "id": 0, "name": "fit", "t": 0.01, "depth": 0,
+         "a": {}},
+        {"k": "sc", "id": 0, "name": "fit", "t": 0.01, "dur": 0.1,
+         "a": {}},
+        {"k": "fin", "status": "ok", "t": 0.2},
+    ]
+    path.write_text(
+        "\n".join(json.dumps(r) for r in lines) + "\n", encoding="utf-8"
+    )
+
+
+def test_fleet_clock_skew_flag(tmp_path, monkeypatch):
+    from pypardis_tpu import obs
+
+    _write_flight(tmp_path / "flight-a.jsonl", 1000.0)
+    _write_flight(tmp_path / "flight-b.jsonl", 1010.0)
+    rep = obs.replay(str(tmp_path)).report()
+    assert rep["clock_skew_s"] == pytest.approx(10.0)
+    assert rep["clock_skew_warning"] is True  # default threshold 5s
+    monkeypatch.setenv("PYPARDIS_FLEET_SKEW_WARN_S", "30")
+    rep = obs.replay(str(tmp_path)).report()
+    assert rep["clock_skew_warning"] is False
+    summary = obs.replay(str(tmp_path)).summary()
+    assert "WARNING" not in summary
+
+
+# ---------------------------------------------------------------------------
+# real localhost fleets (slow: spawn jax.distributed worker processes)
+# ---------------------------------------------------------------------------
+
+
+def _run_fleet(task, out_base, n_procs, dev_per_proc, env_extra=None):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO] + [p for p in [env.get("PYTHONPATH")] if p]
+    )
+    env.pop("XLA_FLAGS", None)  # fleet_env sets the workers' own
+    env.update(env_extra or {})
+    return dist.launch_fleet(
+        [sys.executable, PROBE, "--worker", task, out_base],
+        n_procs, dev_per_proc, env=env, timeout_s=600,
+    )
+
+
+@pytest.mark.slow
+def test_fleet_fit_parity_both_merges():
+    """2 processes x 4 devices must land byte-identical to THIS
+    harness's in-process 8-device mesh — global-Morton under both
+    merges, plus the KD route."""
+    from pypardis_tpu import DBSCAN
+
+    n = 1500
+    X = multihost_probe.chain_data(n)
+    ref = {}
+    for mode, merge in (("global_morton", "device"),
+                        ("global_morton", "host"), ("kd", "device")):
+        m = DBSCAN(mode=mode, merge=merge, **multihost_probe.KW)
+        m.fit(X)
+        ref[f"{mode}.{merge}"] = (
+            np.asarray(m.labels_), np.asarray(m.core_sample_mask_),
+        )
+    with tempfile.TemporaryDirectory() as d:
+        base = os.path.join(d, "fits")
+        rcs, _port, _attempts, tails = _run_fleet(
+            "fits", base, 2, 4, env_extra={"MH_N": str(n)}
+        )
+        assert rcs == [0, 0], tails
+        for rank in range(2):
+            with np.load(f"{base}.p{rank:02d}.npz") as z:
+                for key, (labels, core) in ref.items():
+                    np.testing.assert_array_equal(
+                        z[f"labels_{key}"], labels, err_msg=key
+                    )
+                    np.testing.assert_array_equal(
+                        z[f"core_{key}"], core, err_msg=key
+                    )
+
+
+@pytest.mark.slow
+def test_fleet_2x2_matches_single_process_1x4():
+    """The ISSUE-20 pinned geometry: 2 processes x 2 devices vs ONE
+    process x 4 devices — same global device count, byte-identical
+    labels, both merges + KD.  Both runs are subprocess fleets (this
+    harness's own mesh is 8-wide), compared file-to-file."""
+    n = 1500
+    with tempfile.TemporaryDirectory() as d:
+        solo, duo = os.path.join(d, "solo"), os.path.join(d, "duo")
+        rcs, _p, _a, tails = _run_fleet(
+            "fits", solo, 1, 4, env_extra={"MH_N": str(n)}
+        )
+        assert rcs == [0], tails
+        rcs, _p, _a, tails = _run_fleet(
+            "fits", duo, 2, 2, env_extra={"MH_N": str(n)}
+        )
+        assert rcs == [0, 0], tails
+        with np.load(f"{solo}.p00.npz") as ref:
+            for rank in range(2):
+                with np.load(f"{duo}.p{rank:02d}.npz") as z:
+                    for key in ref.files:
+                        np.testing.assert_array_equal(
+                            z[key], ref[key], err_msg=key
+                        )
+
+
+@pytest.mark.slow
+def test_fleet_streaming_build_parity():
+    """The shared-store external sort partitioned across 2 processes
+    reproduces the solo build byte for byte."""
+    from pypardis_tpu.partition import morton_range_split_streaming
+
+    n = 8000
+    SX = multihost_probe.stream_data(n)
+    sp = morton_range_split_streaming(SX, 4, **multihost_probe.STREAM_KW)
+    solo_ids, _ = sp.row_span(0, sp.n)
+    with tempfile.TemporaryDirectory() as d:
+        base = os.path.join(d, "stream")
+        rcs, _port, _attempts, tails = _run_fleet(
+            "stream", base, 2, 4, env_extra={"MH_STREAM_N": str(n)}
+        )
+        assert rcs == [0, 0], tails
+        for rank in range(2):
+            with np.load(f"{base}.p{rank:02d}.npz") as z:
+                np.testing.assert_array_equal(z["starts"], sp.starts)
+                np.testing.assert_array_equal(z["center"], sp.center)
+                np.testing.assert_array_equal(z["tlo"], sp.tile_lo)
+                np.testing.assert_array_equal(z["thi"], sp.tile_hi)
+                np.testing.assert_array_equal(z["ids"], solo_ids)
+    sp.close()
